@@ -1,0 +1,39 @@
+"""h2o_kubernetes_tpu — a TPU-native rebuild of the H2O-3 + h2o-kubernetes
+capability surface: distributed columnar Frames as sharded JAX arrays, an
+MRTask-style map/reduce runtime on ICI collectives, histogram tree learners
+(GBM/DRF/XGBoost-hist) and GLM/DeepLearning/Word2Vec on JAX/Pallas, AutoML
+with stacked ensembles, and a C++ Kubernetes operator/CLI (native/).
+
+See SURVEY.md for the reference blueprint this is built against.
+"""
+
+from .frame import Frame, Vec
+from .runtime import (global_mesh, initialize_distributed, make_mesh,
+                      set_global_mesh, use_mesh)
+
+__version__ = "0.1.0"
+
+
+def init(coordinator: str | None = None, **kw) -> None:
+    """Connect/boot the cluster (analog of h2o.init()).
+
+    On TPU the 'cluster' is the pod slice this process can see; multi-host
+    formation goes through the JAX distributed runtime using env injected
+    by the operator (see runtime/mesh.py).
+    """
+    initialize_distributed(coordinator, **kw)
+    global_mesh()
+
+
+def cluster_status() -> dict:
+    """Analog of GET /3/Cloud."""
+    import jax
+
+    mesh = global_mesh()
+    return {
+        "version": __version__,
+        "cloud_size": len(mesh.devices.flat),
+        "mesh_shape": dict(mesh.shape),
+        "process_count": jax.process_count(),
+        "devices": [str(d) for d in mesh.devices.flat],
+    }

@@ -1,0 +1,3 @@
+from .frame import Frame, Vec, NA_ENUM
+
+__all__ = ["Frame", "Vec", "NA_ENUM"]

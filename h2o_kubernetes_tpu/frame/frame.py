@@ -1,0 +1,305 @@
+"""Frame: distributed columnar table with HBM-resident sharded columns.
+
+The reference's Fluid-Vector store (water/fvec: Frame → Vec → Chunk,
+SURVEY.md §2b C5) keeps each column as a chain of compressed Chunks spread
+over the node ring via the DKV. The TPU-native design collapses all of
+that: a column IS one `jax.Array`, row-sharded over the mesh ROWS axis.
+There is no chunk zoo — XLA memory layouts replace per-chunk compression —
+and no DKV — addressing is the NamedSharding.
+
+Column kinds (mirroring H2O Vec types):
+  numeric — float32, NA = NaN
+  int     — float32 storage too (H2O stores ints in compressed chunks but
+            exposes doubles at the API; we keep one numeric device dtype)
+  enum    — int32 category codes + host-side `domain` (vocab), NA = -1
+  time    — float64 epoch-millis, NA = NaN
+  string  — host-resident list (no device array; used for vocab building)
+
+Rows are padded to a multiple of the ROWS-axis size; padding is encoded as
+NA so NA-aware reductions ignore it. `nrows` is the logical row count.
+
+Rollups (lazy cached per-Vec min/max/mean/σ/NA-count — the analog of
+water/fvec/RollupStats.java, SURVEY.md §2b C6) are computed by one MRTask
+`doall` on first access and invalidated on mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime import mesh as meshlib
+from ..runtime.mrtask import doall, shard_rows
+
+NA_ENUM = -1  # NA/pad sentinel for enum codes
+
+
+class Vec:
+    """One column: a row-sharded device array plus host-side metadata."""
+
+    def __init__(self, data: jax.Array, nrows: int, kind: str = "numeric",
+                 domain: list[str] | None = None, name: str = "",
+                 origin: float = 0.0):
+        self.data = data          # padded, sharded over ROWS
+        self.nrows = nrows
+        self.kind = kind          # numeric | enum | time
+        self.domain = domain
+        self.name = name
+        # time columns store float32 millis RELATIVE to `origin` (a float64
+        # epoch-ms) — at absolute 2026 epoch magnitudes a float32 ulp is
+        # ~131s, so the shift is what keeps timestamps exact.
+        self.origin = origin
+        self._rollups: dict[str, float] | None = None
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_numpy(x: np.ndarray, name: str = "", domain=None,
+                   kind: str | None = None) -> "Vec":
+        x = np.asarray(x)
+        if kind is None:
+            if domain is not None:
+                kind = "enum"
+            elif x.dtype.kind == "M":
+                kind = "time"
+            else:
+                kind = "numeric"
+        origin = 0.0
+        if kind == "enum":
+            if x.dtype.kind == "f":  # pre-encoded float codes: NaN is NA
+                x = np.where(np.isnan(x), NA_ENUM, x)
+            arr = x.astype(np.int32)
+            data = shard_rows(arr, pad_value=NA_ENUM)
+        elif kind == "time":
+            if x.dtype.kind == "M":
+                ms = x.astype("datetime64[ms]").astype(np.float64)
+                ms[np.isnat(x)] = np.nan  # NaT would otherwise become 2^63-
+            else:
+                ms = x.astype(np.float64)
+            origin = float(np.nanmin(ms)) if len(ms) else 0.0
+            arr = (ms - origin).astype(np.float32)
+            data = shard_rows(arr, pad_value=np.nan)
+        else:
+            arr = x.astype(np.float32)
+            data = shard_rows(arr, pad_value=np.nan)
+        return Vec(data, nrows=len(x), kind=kind, domain=domain, name=name,
+                   origin=origin)
+
+    # -- basics -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.nrows
+
+    @property
+    def padded_len(self) -> int:
+        return self.data.shape[0]
+
+    def is_enum(self) -> bool:
+        return self.kind == "enum"
+
+    def cardinality(self) -> int:
+        return len(self.domain) if self.domain is not None else -1
+
+    def as_float(self) -> jax.Array:
+        """Device column as float32 with NA→NaN (pads included as NaN)."""
+        if self.kind == "enum":
+            d = self.data
+            return jnp.where(d == NA_ENUM, jnp.nan, d.astype(jnp.float32))
+        return self.data.astype(jnp.float32)
+
+    def to_numpy(self) -> np.ndarray:
+        a = np.asarray(self.data)[: self.nrows]
+        if self.kind == "time":
+            return a.astype(np.float64) + self.origin
+        return a
+
+    # -- rollups ------------------------------------------------------------
+
+    def _compute_rollups(self) -> dict[str, float]:
+        col = self.as_float()
+
+        def m(x):
+            ok = ~jnp.isnan(x)
+            xz = jnp.where(ok, x, 0.0)
+            return dict(
+                cnt=jnp.sum(ok, dtype=jnp.float32),
+                sum=jnp.sum(xz, dtype=jnp.float32),
+                sumsq=jnp.sum(xz * xz),
+                min=jnp.min(jnp.where(ok, x, jnp.inf)),
+                max=jnp.max(jnp.where(ok, x, -jnp.inf)),
+                zeros=jnp.sum(ok & (x == 0.0), dtype=jnp.float32),
+            )
+
+        r = doall(m, col, reduce=dict(cnt="sum", sum="sum", sumsq="sum",
+                                      min="min", max="max", zeros="sum"))
+        r = {k: float(v) for k, v in r.items()}
+        n = r["cnt"]
+        mean = r["sum"] / n if n > 0 else float("nan")
+        var = r["sumsq"] / n - mean * mean if n > 1 else 0.0
+        sigma = float(np.sqrt(max(var * n / (n - 1), 0.0))) if n > 1 else 0.0
+        shift = self.origin if (self.kind == "time" and n) else 0.0
+        return dict(  # time stats shift back to absolute epoch-ms;
+            min=(r["min"] + shift) if n else float("nan"),  # sigma invariant
+            max=(r["max"] + shift) if n else float("nan"),
+            mean=mean + shift, sigma=sigma,
+            nacnt=int(self.nrows - n), zeros=int(r["zeros"]), rows=int(n),
+        )
+
+    def rollups(self) -> dict[str, float]:
+        if self._rollups is None:
+            self._rollups = self._compute_rollups()
+        return self._rollups
+
+    def invalidate(self) -> None:
+        self._rollups = None
+
+    def min(self): return self.rollups()["min"]
+    def max(self): return self.rollups()["max"]
+    def mean(self): return self.rollups()["mean"]
+    def sigma(self): return self.rollups()["sigma"]
+    def nacnt(self): return self.rollups()["nacnt"]
+
+
+class Frame:
+    """An ordered collection of equal-length Vecs (row-aligned shards)."""
+
+    def __init__(self, vecs: Mapping[str, Vec] | None = None):
+        self._vecs: dict[str, Vec] = dict(vecs or {})
+        ns = {v.nrows for v in self._vecs.values()}
+        if len(ns) > 1:
+            raise ValueError(f"ragged columns: nrows {ns}")
+
+    # -- construction -------------------------------------------------------
+
+    @staticmethod
+    def from_arrays(cols: Mapping[str, Any],
+                    domains: Mapping[str, list[str]] | None = None) -> "Frame":
+        """Build from {name: array-like}. Object/str columns become enums."""
+        domains = dict(domains or {})
+        vecs: dict[str, Vec] = {}
+        for name, col in cols.items():
+            arr = np.asarray(col)
+            if name in domains:
+                if arr.dtype.kind in "OUS":  # encode against given domain
+                    codes, _ = _factorize(arr, domain=domains[name])
+                else:
+                    codes = arr
+                vecs[name] = Vec.from_numpy(codes, name, domain=domains[name])
+            elif arr.dtype.kind in "OUS":  # strings -> enum with built vocab
+                codes, domain = _factorize(arr)
+                vecs[name] = Vec.from_numpy(codes, name, domain=domain)
+            elif arr.dtype.kind == "b":
+                vecs[name] = Vec.from_numpy(arr.astype(np.float32), name)
+            else:
+                vecs[name] = Vec.from_numpy(arr, name)
+        return Frame(vecs)
+
+    @staticmethod
+    def from_pandas(df) -> "Frame":
+        return Frame.from_arrays({c: df[c].to_numpy() for c in df.columns})
+
+    # -- basics -------------------------------------------------------------
+
+    @property
+    def names(self) -> list[str]:
+        return list(self._vecs)
+
+    @property
+    def nrows(self) -> int:
+        return next(iter(self._vecs.values())).nrows if self._vecs else 0
+
+    @property
+    def ncols(self) -> int:
+        return len(self._vecs)
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return (self.nrows, self.ncols)
+
+    def vec(self, name: str) -> Vec:
+        return self._vecs[name]
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self._vecs[key]
+        if isinstance(key, (list, tuple)):
+            return Frame({k: self._vecs[k] for k in key})
+        raise TypeError(f"bad key {key!r}")
+
+    def __setitem__(self, name: str, vec: Vec):
+        if self._vecs and vec.nrows != self.nrows:
+            raise ValueError("nrows mismatch")
+        self._vecs[name] = vec
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._vecs
+
+    def drop(self, names: str | Sequence[str]) -> "Frame":
+        if isinstance(names, str):
+            names = [names]
+        return Frame({k: v for k, v in self._vecs.items() if k not in names})
+
+    # -- device views -------------------------------------------------------
+
+    def columns(self, names: Iterable[str] | None = None) -> list[Vec]:
+        return [self._vecs[n] for n in
+                (self.names if names is None else names)]
+
+    def to_matrix(self, names: Iterable[str] | None = None) -> jax.Array:
+        """[padded_rows, k] float32 matrix (enums as raw codes, NA→NaN)."""
+        cols = [v.as_float() for v in self.columns(names)]
+        return jnp.stack(cols, axis=1)
+
+    def valid_mask(self) -> jax.Array:
+        """float32 [padded_rows]: 1.0 for logical rows, 0.0 for padding."""
+        if not self._vecs:
+            raise ValueError("valid_mask() on an empty Frame")
+        v = next(iter(self._vecs.values()))
+        idx = jnp.arange(v.padded_len)
+        mask = (idx < v.nrows).astype(jnp.float32)
+        return jax.device_put(mask, meshlib.row_sharding())
+
+    def to_pandas(self):
+        import pandas as pd
+        out = {}
+        for n, v in self._vecs.items():
+            a = v.to_numpy()
+            if v.is_enum():
+                dom = np.asarray(list(v.domain) + [None], dtype=object)
+                col = dom[np.where(a >= 0, a, len(dom) - 1)]
+                out[n] = col
+            else:
+                out[n] = a
+        return pd.DataFrame(out)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {n: v.rollups() for n, v in self._vecs.items()}
+
+
+def _factorize(arr: np.ndarray,
+               domain: list[str] | None = None) -> tuple[np.ndarray, list[str]]:
+    """String column → (int32 codes, sorted vocab).
+
+    NA is only true missingness: None / float NaN cells in object arrays
+    and empty strings. Literal tokens like "NA" or "nan" stay categories —
+    parse-time NA-token handling is the CSV reader's job, not ours.
+    """
+    if arr.dtype.kind == "O":
+        isna = np.array([x is None or x != x for x in arr], dtype=bool)
+    else:
+        isna = np.zeros(len(arr), dtype=bool)
+    s = np.where(isna, "", arr.astype(str))
+    isna |= s == ""
+    if domain is None:
+        uniq, inv = np.unique(s[~isna], return_inverse=True)
+        domain = [str(d) for d in uniq]
+        codes = np.full(len(s), NA_ENUM, dtype=np.int32)
+        codes[~isna] = inv.astype(np.int32)
+    else:
+        lookup = {d: i for i, d in enumerate(domain)}
+        codes = np.array([lookup.get(x, NA_ENUM) for x in s], dtype=np.int32)
+        codes[isna] = NA_ENUM
+    return codes, domain

@@ -1,0 +1,10 @@
+from .mesh import (COLS, ROWS, global_mesh, initialize_distributed, make_mesh,
+                   n_row_shards, replicated, row_sharding, set_global_mesh,
+                   use_mesh)
+from .mrtask import doall, shard_rows
+
+__all__ = [
+    "COLS", "ROWS", "global_mesh", "initialize_distributed", "make_mesh",
+    "n_row_shards", "replicated", "row_sharding", "set_global_mesh",
+    "use_mesh", "doall", "shard_rows",
+]

@@ -1,0 +1,108 @@
+"""Device-mesh management — the TPU-native replacement for H2O's "cloud".
+
+In the reference, cluster membership is discovered via headless-Service DNS
+and locked by Paxos-style gossip (h2o-k8s KubernetesDnsLookup,
+water/Paxos.java — see SURVEY.md §3.3). On TPU the slice topology *is* the
+cluster: a `jax.sharding.Mesh` over the slice's chips, formed once at init
+and immutable thereafter — the same "cloud locks at formation" semantics,
+for free.
+
+Axes:
+  ROWS — the data axis. H2O distributes Chunks round-robin over the node
+         ring; we shard the row dimension of every Frame column over ROWS.
+  COLS — the feature/model axis (size 1 by default). Used for wide-feature
+         sharding (GLM Gram over many one-hot columns) — the reference has
+         no tensor parallelism (SURVEY.md §2d), this is our TP analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS = "rows"
+COLS = "cols"
+
+_global_mesh: Mesh | None = None
+
+
+def make_mesh(n_rows: int | None = None, n_cols: int = 1,
+              devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a (ROWS, COLS) mesh. Defaults to all devices on the row axis."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_rows is None:
+        n_rows = len(devices) // n_cols
+    if n_rows * n_cols > len(devices):
+        raise ValueError(
+            f"mesh {n_rows}x{n_cols} needs {n_rows * n_cols} devices, "
+            f"have {len(devices)}")
+    devs = np.array(devices[: n_rows * n_cols]).reshape(n_rows, n_cols)
+    return Mesh(devs, (ROWS, COLS))
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def global_mesh() -> Mesh:
+    """The process-wide mesh, created lazily over all visible devices."""
+    global _global_mesh
+    if _global_mesh is None:
+        _global_mesh = make_mesh()
+    return _global_mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Temporarily swap the process-wide mesh (not thread-safe)."""
+    global _global_mesh
+    prev = _global_mesh
+    _global_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _global_mesh = prev
+
+
+def n_row_shards(mesh: Mesh | None = None) -> int:
+    mesh = mesh or global_mesh()
+    return mesh.shape[ROWS]
+
+
+def row_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    """Sharding for a row-partitioned array (rank >= 1, rows leading)."""
+    mesh = mesh or global_mesh()
+    return NamedSharding(mesh, P(ROWS))
+
+
+def replicated(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or global_mesh()
+    return NamedSharding(mesh, P())
+
+
+def initialize_distributed(coordinator: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bring-up: DCN via the JAX distributed runtime.
+
+    The operator injects H2O_TPU_COORDINATOR / H2O_TPU_NUM_PROCESSES /
+    H2O_TPU_PROCESS_ID into the pod spec (the analog of the reference's
+    H2O_KUBERNETES_SERVICE_DNS / H2O_NODE_EXPECTED_COUNT contract,
+    SURVEY.md §1a). Single-process (or absent env) is a no-op.
+    """
+    coordinator = coordinator or os.environ.get("H2O_TPU_COORDINATOR")
+    if coordinator is None:
+        return
+    num_processes = num_processes or int(
+        os.environ.get("H2O_TPU_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("H2O_TPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)

@@ -1,0 +1,95 @@
+"""MRTask-equivalent: per-shard map + collective reduce.
+
+The reference's compute primitive is `MRTask.doAll(frame)` — map over each
+node's local Chunks, reduce locally, then reduce up a binary tree of RPCs
+over the node ring (water/MRTask.java, SURVEY.md §3.5). The TPU-native
+equivalent is exactly `shard_map`: the `map(Chunk[])` body becomes the
+per-shard function, and the software tree-allreduce becomes an ICI
+collective (`psum`/`pmin`/`pmax`).
+
+`doall(fn, *cols)` runs `fn` on each device's row-shard of the column
+arrays and reduces the returned pytree across shards. Per-leaf reduce ops
+are declared with a matching pytree of {"sum","min","max","mean","concat"}
+(a bare string applies to every leaf) — the analog of an MRTask subclass's
+`reduce()` method.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import ROWS, global_mesh
+
+_REDUCERS = {
+    "sum": lambda x: lax.psum(x, ROWS),
+    "min": lambda x: lax.pmin(x, ROWS),
+    "max": lambda x: lax.pmax(x, ROWS),
+    "mean": lambda x: lax.pmean(x, ROWS),
+    "concat": lambda x: lax.all_gather(x, ROWS, axis=0, tiled=True),
+    "none": lambda x: x,
+}
+
+
+def doall(map_fn: Callable[..., Any], *cols: jax.Array,
+          reduce: Any = "sum", mesh: Mesh | None = None,
+          donate: bool = False) -> Any:
+    """Map `map_fn` over aligned row-shards of `cols`, reduce across shards.
+
+    Returns the fully reduced pytree, replicated on every device (like
+    `MRTask.getResult()` returning the reduced task object to the caller).
+    """
+    mesh = mesh or global_mesh()
+
+    def body(*shards):
+        out = map_fn(*shards)
+        reds = reduce
+        if isinstance(reds, str):
+            reds = jax.tree.map(lambda _: reduce, out)
+        return jax.tree.map(lambda x, r: _REDUCERS[r](x), out, reds)
+
+    # shard_map needs out_specs up front; "none"/"concat" leaves differ.
+    # Trace map_fn (collective-free user code) on shard-shaped abstractions.
+    shard_shapes = tuple(
+        jax.ShapeDtypeStruct((c.shape[0] // mesh.shape[ROWS],) + c.shape[1:],
+                             c.dtype) for c in cols)
+    res = jax.eval_shape(map_fn, *shard_shapes)
+    reds = reduce if not isinstance(reduce, str) else jax.tree.map(
+        lambda _: reduce, res)
+    out_specs = jax.tree.map(
+        lambda _, r: P(ROWS) if r == "none" else P(), res, reds)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(ROWS), out_specs=out_specs)
+    return jax.jit(fn, donate_argnums=tuple(range(len(cols))) if donate else ())(*cols)
+
+
+@functools.lru_cache(maxsize=None)
+def _padded_len(n: int, shards: int) -> int:
+    return ((n + shards - 1) // shards) * shards
+
+
+def shard_rows(x, mesh: Mesh | None = None, pad_value=None) -> jax.Array:
+    """Pad the leading dim to a multiple of the ROWS axis and shard it.
+
+    Default padding is NaN for floats, -1 for signed ints, 0 otherwise
+    (np.full would silently turn NaN into INT_MIN for int dtypes).
+    """
+    import numpy as np
+
+    mesh = mesh or global_mesh()
+    shards = mesh.shape[ROWS]
+    n = x.shape[0]
+    m = _padded_len(n, shards)
+    if m != n:
+        if pad_value is None:
+            kind = np.dtype(x.dtype).kind
+            pad_value = (np.nan if kind == "f" else -1 if kind == "i" else 0)
+        pad = np.full((m - n,) + tuple(x.shape[1:]), pad_value, dtype=x.dtype)
+        x = np.concatenate([np.asarray(x), pad], axis=0)
+    from jax.sharding import NamedSharding
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(ROWS)))

@@ -1,0 +1,32 @@
+"""Test bootstrap: force an 8-device CPU platform BEFORE jax imports.
+
+Mirrors the reference's test trick (SURVEY.md §4): H2O tests boot a real
+multi-JVM cloud on localhost; we boot a real 8-device mesh on CPU so
+shard_map/psum semantics are exercised for real — no mocked collectives.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # env ships JAX_PLATFORMS=axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# sitecustomize may import jax at interpreter start (latching
+# jax_platforms=axon from the env); backends are still uninitialized at
+# conftest time, so overriding the live config takes effect.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from h2o_kubernetes_tpu.runtime import make_mesh, set_global_mesh
+
+    mesh = make_mesh()
+    set_global_mesh(mesh)
+    return mesh

@@ -1,0 +1,124 @@
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu.frame import NA_ENUM
+
+
+def _frame(mesh8):
+    rng = np.random.default_rng(1)
+    x = rng.normal(2.0, 3.0, size=1000).astype(np.float32)
+    x[::17] = np.nan
+    cat = np.array(["a", "b", "c"])[rng.integers(0, 3, size=1000)]
+    y = rng.integers(0, 2, size=1000).astype(np.float32)
+    return Frame.from_arrays({"x": x, "cat": cat, "y": y}), x, cat
+
+
+def test_shapes_and_names(mesh8):
+    fr, x, cat = _frame(mesh8)
+    assert fr.shape == (1000, 3)
+    assert fr.names == ["x", "cat", "y"]
+    assert fr["cat"].is_enum()
+    assert fr["cat"].domain == ["a", "b", "c"]
+
+
+def test_rollups_match_numpy(mesh8):
+    fr, x, cat = _frame(mesh8)
+    r = fr["x"].rollups()
+    valid = x[~np.isnan(x)]
+    np.testing.assert_allclose(r["mean"], valid.mean(), rtol=1e-4)
+    np.testing.assert_allclose(r["sigma"], valid.std(ddof=1), rtol=1e-3)
+    np.testing.assert_allclose(r["min"], valid.min(), rtol=1e-6)
+    np.testing.assert_allclose(r["max"], valid.max(), rtol=1e-6)
+    assert r["nacnt"] == int(np.isnan(x).sum())
+
+
+def test_enum_roundtrip_and_na(mesh8):
+    codes = np.array([0, 1, NA_ENUM, 2, 1], dtype=np.int32)
+    fr = Frame.from_arrays({"c": codes}, domains={"c": ["x", "y", "z"]})
+    v = fr["c"]
+    assert v.nacnt() == 1
+    assert v.cardinality() == 3
+    back = v.to_numpy()
+    np.testing.assert_array_equal(back, codes)
+
+
+def test_to_matrix_and_mask(mesh8):
+    fr, x, cat = _frame(mesh8)
+    m = fr.to_matrix(["x", "y"])
+    assert m.shape[1] == 2
+    mask = fr.valid_mask()
+    assert float(mask.sum()) == 1000
+
+
+def test_subframe_drop(mesh8):
+    fr, *_ = _frame(mesh8)
+    assert fr[["x", "y"]].names == ["x", "y"]
+    assert fr.drop("cat").names == ["x", "y"]
+
+
+def test_to_pandas(mesh8):
+    fr, x, cat = _frame(mesh8)
+    df = fr.to_pandas()
+    assert list(df.columns) == ["x", "cat", "y"]
+    assert df["cat"].iloc[0] in ("a", "b", "c")
+
+
+def test_explicit_domain_on_strings(mesh8):
+    fr = Frame.from_arrays({"g": np.array(["b", "a", "zz", "b"])},
+                           domains={"g": ["a", "b"]})
+    np.testing.assert_array_equal(fr["g"].to_numpy(),
+                                  [1, 0, NA_ENUM, 1])  # 'zz' not in domain
+
+
+def test_na_tokens_are_categories(mesh8):
+    fr = Frame.from_arrays({"g": np.array(["NA", "nan", "None", "x"])})
+    assert fr["g"].nacnt() == 0
+    assert "NA" in fr["g"].domain
+    fr2 = Frame.from_arrays({"g": np.array(["a", None, float("nan"), ""],
+                                           dtype=object)})
+    assert fr2["g"].nacnt() == 3
+
+
+def test_empty_selection_returns_empty(mesh8):
+    fr, *_ = _frame(mesh8)
+    assert fr.columns([]) == []
+
+
+def test_time_column_precision(mesh8):
+    t = np.array(["2026-07-29T00:00:00.123", "2026-07-29T00:00:01.456"],
+                 dtype="datetime64[ms]")
+    fr = Frame.from_arrays({"t": t})
+    v = fr["t"]
+    assert v.kind == "time"
+    back = v.to_numpy()
+    np.testing.assert_allclose(back[1] - back[0], 1333.0)  # exact ms delta
+    r = v.rollups()
+    np.testing.assert_allclose(r["max"] - r["min"], 1333.0)
+
+
+def test_int_shard_padding(mesh8):
+    from h2o_kubernetes_tpu.runtime import shard_rows
+    xs = shard_rows(np.arange(13, dtype=np.int32))
+    assert np.asarray(xs)[13:].tolist() == [-1, -1, -1]
+
+
+def test_time_nat_is_na(mesh8):
+    t = np.array(["2026-01-01", "NaT", "2026-01-02"], dtype="datetime64[ms]")
+    v = Frame.from_arrays({"t": t})["t"]
+    assert v.nacnt() == 1
+    r = v.rollups()
+    np.testing.assert_allclose(r["max"] - r["min"], 86400000.0)
+
+
+def test_to_pandas_all_na_enum(mesh8):
+    fr = Frame.from_arrays({"g": np.array([None, None], dtype=object)})
+    df = fr.to_pandas()
+    assert df["g"].isna().all()
+
+
+def test_float_codes_with_nan(mesh8):
+    fr = Frame.from_arrays({"c": np.array([0.0, np.nan, 1.0])},
+                           domains={"c": ["a", "b"]})
+    assert fr["c"].nacnt() == 1
+    np.testing.assert_array_equal(fr["c"].to_numpy(), [0, NA_ENUM, 1])

@@ -1,0 +1,52 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu.runtime import (ROWS, doall, make_mesh, n_row_shards,
+                                        shard_rows, use_mesh)
+
+
+def test_mesh_shape(mesh8):
+    assert n_row_shards(mesh8) == 8
+    assert len(jax.devices()) == 8
+
+
+def test_doall_sum_matches_numpy(mesh8):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=1600).astype(np.float32)
+    xs = shard_rows(x)
+    out = doall(lambda s: dict(total=jnp.sum(s), sq=jnp.sum(s * s)), xs)
+    np.testing.assert_allclose(float(out["total"]), x.sum(), rtol=1e-4)
+    np.testing.assert_allclose(float(out["sq"]), (x * x).sum(), rtol=1e-4)
+
+
+def test_doall_min_max(mesh8):
+    x = np.arange(64, dtype=np.float32) - 17
+    xs = shard_rows(x)
+    out = doall(lambda s: dict(lo=jnp.min(s), hi=jnp.max(s)),
+                xs, reduce=dict(lo="min", hi="max"))
+    assert float(out["lo"]) == -17.0
+    assert float(out["hi"]) == 46.0
+
+
+def test_doall_multiple_inputs(mesh8):
+    x = np.arange(80, dtype=np.float32)
+    w = np.full(80, 0.5, dtype=np.float32)
+    out = doall(lambda a, b: jnp.sum(a * b), shard_rows(x), shard_rows(w))
+    np.testing.assert_allclose(float(out), (x * 0.5).sum())
+
+
+def test_shard_rows_pads_to_multiple(mesh8):
+    x = np.ones(13, dtype=np.float32)
+    xs = shard_rows(x)
+    assert xs.shape[0] == 16
+    assert np.isnan(np.asarray(xs)[13:]).all()
+
+
+def test_submesh(mesh8):
+    with use_mesh(make_mesh(n_rows=4, devices=jax.devices()[:4])) as m:
+        assert n_row_shards(m) == 4
+        x = np.arange(8, dtype=np.float32)
+        out = doall(lambda s: jnp.sum(s), shard_rows(x))
+        assert float(out) == 28.0
