@@ -1,0 +1,75 @@
+"""Benchmark harness — GBM training throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.json "published": {}), so
+vs_baseline is the ratio against the first number this harness ever
+recorded on this hardware (BENCH_BASELINE.json, written on first run) —
+i.e. round-over-round speedup; 1.0 on the first run.
+
+North-star metric (BASELINE.json:2): GBM rows/sec/chip. We measure
+steady-state boosting throughput (binning + per-tree grow + margin
+update) on a synthetic airlines-like binary-classification table.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+
+    n_chips = len(jax.devices())
+    rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    ntrees = int(os.environ.get("BENCH_TREES", 10))
+    rng = np.random.default_rng(0)
+    F = 10
+    X = {f"x{i}": rng.normal(size=rows).astype(np.float32)
+         for i in range(F - 2)}
+    X["c1"] = np.array(["a", "b", "c", "d", "e", "f", "g", "h"])[
+        rng.integers(0, 8, size=rows)]
+    X["dep_delay"] = rng.exponential(10.0, size=rows).astype(np.float32)
+    logit = (1.2 * X["x0"] - 0.8 * X["x1"] + 0.05 * X["dep_delay"]
+             - 1.0 + rng.normal(scale=0.5, size=rows))
+    X["y"] = np.where(logit > 0, "late", "ontime")
+    fr = h2o.Frame.from_arrays(X)
+
+    def run(nt):
+        m = GBM(ntrees=nt, max_depth=5, learn_rate=0.2, seed=1).train(
+            y="y", training_frame=fr)
+        return m
+
+    run(2)  # warm-up: compile binning + tree build + predict
+    t0 = time.perf_counter()
+    run(ntrees)
+    dt = time.perf_counter() - t0
+    rows_per_sec_per_chip = rows * ntrees / dt / n_chips
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)["value"]
+    else:
+        base = rows_per_sec_per_chip
+        with open(base_path, "w") as f:
+            json.dump({"metric": "gbm_boosted_rows_per_sec_per_chip",
+                       "value": base}, f)
+
+    print(json.dumps({
+        "metric": "gbm_boosted_rows_per_sec_per_chip",
+        "value": round(rows_per_sec_per_chip, 1),
+        "unit": "rows*trees/s/chip",
+        "vs_baseline": round(rows_per_sec_per_chip / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -104,10 +104,17 @@ class Vec:
         return len(self.domain) if self.domain is not None else -1
 
     def as_float(self) -> jax.Array:
-        """Device column as float32 with NA→NaN (pads included as NaN)."""
+        """Device column as float32 with NA→NaN (pads included as NaN).
+
+        Time columns come back as ABSOLUTE epoch-ms (origin added, f32
+        rounded — fine for binning/modeling; use to_numpy()/rollups()
+        for exact timestamps).
+        """
         if self.kind == "enum":
             d = self.data
             return jnp.where(d == NA_ENUM, jnp.nan, d.astype(jnp.float32))
+        if self.kind == "time":
+            return (self.data + np.float32(self.origin)).astype(jnp.float32)
         return self.data.astype(jnp.float32)
 
     def to_numpy(self) -> np.ndarray:
@@ -119,7 +126,12 @@ class Vec:
     # -- rollups ------------------------------------------------------------
 
     def _compute_rollups(self) -> dict[str, float]:
-        col = self.as_float()
+        if self.kind == "time":
+            col = self.data  # origin-relative: full precision; shift below
+        elif self.kind == "enum":
+            col = self.as_float()
+        else:
+            col = self.data.astype(jnp.float32)
 
         def m(x):
             ok = ~jnp.isnan(x)

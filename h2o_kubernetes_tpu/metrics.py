@@ -1,0 +1,80 @@
+"""Model metrics — the analog of hex.ModelMetrics* in the reference
+(h2o-core hex/ModelMetricsBinomial, ModelMetricsRegression etc.,
+SURVEY.md §2b C9/C18): AUC, logloss, RMSE/MAE, confusion-style accuracy.
+
+All metrics are jittable jnp code; callers may pass device or host
+arrays. Distributed callers gather first (metrics are O(n) scalar
+reductions — cheap next to training).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def roc_auc(y_true, score) -> float:
+    """Exact AUC with average-rank tie handling (Mann-Whitney U)."""
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    s = jnp.asarray(score).astype(jnp.float32).ravel()
+    ss = jnp.sort(s)
+    lo = jnp.searchsorted(ss, s, side="left")
+    hi = jnp.searchsorted(ss, s, side="right")
+    rank = (lo + hi + 1).astype(jnp.float32) / 2.0  # 1-based average rank
+    npos = jnp.sum(y)
+    nneg = y.shape[0] - npos
+    auc = (jnp.sum(rank * y) - npos * (npos + 1) / 2.0) / (npos * nneg)
+    return float(auc)
+
+
+def logloss(y_true, p, eps: float = 1e-7) -> float:
+    # eps must stay f32-representable: with 1e-15, 1-eps rounds to 1.0 and
+    # the (1-y)*log1p(-1) term produces 0*inf = NaN
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    p = jnp.clip(jnp.asarray(p).astype(jnp.float32).ravel(), eps, 1 - eps)
+    return float(-jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log1p(-p)))
+
+
+def multinomial_logloss(y_true, probs, eps: float = 1e-7) -> float:
+    """y_true: int class ids [n]; probs: [n, K]."""
+    y = jnp.asarray(y_true).astype(jnp.int32).ravel()
+    p = jnp.clip(jnp.asarray(probs), eps, 1.0)
+    return float(-jnp.mean(jnp.log(p[jnp.arange(y.shape[0]), y])))
+
+
+def rmse(y_true, pred) -> float:
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    p = jnp.asarray(pred).astype(jnp.float32).ravel()
+    return float(jnp.sqrt(jnp.mean((y - p) ** 2)))
+
+
+def mae(y_true, pred) -> float:
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    p = jnp.asarray(pred).astype(jnp.float32).ravel()
+    return float(jnp.mean(jnp.abs(y - p)))
+
+
+def mean_residual_deviance(y_true, pred, distribution: str = "gaussian") -> float:
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    p = jnp.asarray(pred).astype(jnp.float32).ravel()
+    if distribution == "gaussian":
+        return float(jnp.mean((y - p) ** 2))
+    if distribution == "poisson":
+        p = jnp.clip(p, 1e-10, None)
+        yl = jnp.where(y > 0, y * jnp.log(y / p), 0.0)
+        return float(2.0 * jnp.mean(yl - (y - p)))
+    raise ValueError(distribution)
+
+
+def accuracy(y_true, label) -> float:
+    y = np.asarray(y_true).ravel()
+    l = np.asarray(label).ravel()
+    return float((y == l).mean())
+
+
+def r2(y_true, pred) -> float:
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    p = jnp.asarray(pred).astype(jnp.float32).ravel()
+    ss_res = jnp.sum((y - p) ** 2)
+    ss_tot = jnp.sum((y - jnp.mean(y)) ** 2)
+    return float(1.0 - ss_res / ss_tot)

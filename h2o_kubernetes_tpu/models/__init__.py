@@ -1,0 +1,4 @@
+from .drf import DRF, DRFModel
+from .gbm import GBM, GBMModel, GBMParams
+
+__all__ = ["DRF", "DRFModel", "GBM", "GBMModel", "GBMParams"]

@@ -1,0 +1,187 @@
+"""ModelBuilder/Model base plumbing shared by every algorithm.
+
+The analog of the reference's hex.ModelBuilder + hex.Model pair
+(h2o-core hex/ModelBuilder.java — parameter validation, response
+handling, training dispatch; SURVEY.md §2b C9/C10): resolves feature/
+response columns from a Frame, infers the distribution, and gives every
+model a uniform predict / model_performance surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import metrics as M
+from ..frame import Frame, Vec
+from ..runtime import mesh as meshlib
+
+
+@dataclass
+class TrainData:
+    """Device-ready training inputs resolved from a Frame."""
+
+    feature_names: list[str]
+    X: jax.Array                 # [padded, F] float32, NA→NaN, sharded
+    y: jax.Array                 # [padded] float32 (class id for enums)
+    w: jax.Array                 # [padded] float32 weights, 0 on padding
+    nrows: int
+    nclasses: int                # 1 for regression
+    response_domain: list[str] | None
+    distribution: str            # gaussian | bernoulli | multinomial | ...
+    feature_domains: dict[str, list[str]] = field(default_factory=dict)
+
+
+def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
+               ignored: Sequence[str] | None = None,
+               weights_column: str | None = None,
+               distribution: str = "auto") -> TrainData:
+    if y not in frame:
+        raise ValueError(f"response column '{y}' not in frame")
+    ignored = set(ignored or [])
+    ignored.add(y)
+    if weights_column:
+        ignored.add(weights_column)
+    names = list(x) if x else [n for n in frame.names if n not in ignored]
+    for n in names:
+        if n not in frame:
+            raise ValueError(f"feature column '{n}' not in frame")
+        if frame.vec(n).kind not in ("numeric", "enum", "time"):
+            raise ValueError(f"column '{n}' of kind {frame.vec(n).kind} "
+                             "cannot be a feature")
+    yv = frame.vec(y)
+    nclasses, domain = 1, None
+    if yv.is_enum():
+        domain = yv.domain
+        nclasses = yv.cardinality()
+        if nclasses < 2:
+            raise ValueError(f"response '{y}' has {nclasses} classes")
+    if distribution == "auto":
+        if nclasses == 2:
+            distribution = "bernoulli"
+        elif nclasses > 2:
+            distribution = "multinomial"
+        else:
+            distribution = "gaussian"
+    if distribution in ("bernoulli", "multinomial") and nclasses == 1:
+        raise ValueError(f"{distribution} needs a categorical response; "
+                         f"'{y}' is numeric (use .asfactor()-style enum)")
+
+    X = frame.to_matrix(names)
+    y_arr = yv.as_float()
+    w = frame.valid_mask()
+    if weights_column:
+        w = w * frame.vec(weights_column).as_float()
+    # response NAs are dropped by zeroing their weight (reference drops
+    # such rows during ModelBuilder init)
+    w = jnp.where(jnp.isnan(y_arr), 0.0, w)
+    y_arr = jnp.nan_to_num(y_arr)
+    fdoms = {n: list(frame.vec(n).domain) for n in names
+             if frame.vec(n).is_enum()}
+    return TrainData(names, X, y_arr, w, frame.nrows, nclasses, domain,
+                     distribution, fdoms)
+
+
+class Model:
+    """Base trained model: predict() + model_performance()."""
+
+    algo = "base"
+
+    def __init__(self, data: TrainData):
+        self.feature_names = data.feature_names
+        self.feature_domains = data.feature_domains
+        self.nclasses = data.nclasses
+        self.response_domain = data.response_domain
+        self.distribution = data.distribution
+        self.scoring_history: list[dict[str, Any]] = []
+
+    # subclasses implement: _score(X) -> margin/probs array
+    def _score_matrix(self, X: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def _design_matrix(self, frame: Frame) -> jax.Array:
+        """[padded, F] float32 in TRAINING value space.
+
+        Enum codes from a scoring frame are remapped to the training
+        domain (unseen levels → NA); the reference does the same domain
+        adaptation in Model.adaptTestForTrain (hex/Model.java).
+        """
+        cols = []
+        for name in self.feature_names:
+            v = frame.vec(name)
+            tdom = self.feature_domains.get(name)
+            if tdom is not None:
+                if not v.is_enum():
+                    raise ValueError(
+                        f"column '{name}' was categorical at training time "
+                        f"but is {v.kind} in the scoring frame")
+                if list(v.domain) == tdom:
+                    cols.append(v.as_float())
+                else:
+                    lut = {d: i for i, d in enumerate(tdom)}
+                    perm = np.array(
+                        [lut.get(d, -1) for d in v.domain] + [-1],
+                        dtype=np.int32)  # trailing slot = NA code
+                    idx = jnp.where(v.data < 0, len(perm) - 1, v.data)
+                    remap = jnp.asarray(perm)[idx]
+                    cols.append(jnp.where(remap < 0, jnp.nan,
+                                          remap.astype(jnp.float32)))
+            else:
+                if v.is_enum():
+                    raise ValueError(
+                        f"column '{name}' was numeric at training time "
+                        "but is categorical in the scoring frame")
+                cols.append(v.as_float())
+        return jnp.stack(cols, axis=1)
+
+    def predict_raw(self, frame: Frame) -> np.ndarray:
+        """[n, K] class probabilities, or [n] regression predictions."""
+        X = self._design_matrix(frame)
+        out = np.asarray(self._score_matrix(X))[: frame.nrows]
+        return out
+
+    def predict(self, frame: Frame) -> Frame:
+        """H2O-style prediction frame: `predict` (+ per-class probs)."""
+        out = self.predict_raw(frame)
+        if self.nclasses > 1:
+            labels = out.argmax(axis=1).astype(np.int32)
+            cols: dict[str, Any] = {"predict": labels}
+            dom = self.response_domain or [str(i) for i in
+                                           range(self.nclasses)]
+            pf = Frame.from_arrays(cols, domains={"predict": dom})
+            for k, name in enumerate(dom):
+                pf[f"p{name}"] = Vec.from_numpy(out[:, k])
+            return pf
+        return Frame.from_arrays({"predict": out})
+
+    def model_performance(self, frame: Frame, y: str) -> dict[str, float]:
+        yv = frame.vec(y)
+        out = self.predict_raw(frame)
+        ok = ~np.isnan(yv.as_float().__array__()[: frame.nrows]) \
+            if not yv.is_enum() else yv.to_numpy() >= 0
+        y_true = yv.to_numpy()[ok]
+        if self.nclasses == 2:
+            p1 = out[ok, 1]
+            return {
+                "auc": M.roc_auc(y_true, p1),
+                "logloss": M.logloss(y_true, p1),
+                "rmse": M.rmse(y_true, p1),
+            }
+        if self.nclasses > 2:
+            return {
+                "logloss": M.multinomial_logloss(y_true, out[ok]),
+                "accuracy": M.accuracy(y_true, out[ok].argmax(axis=1)),
+            }
+        pred = out[ok]
+        dist = "poisson" if self.distribution == "poisson" else "gaussian"
+        return {
+            "rmse": M.rmse(y_true, pred),
+            "mae": M.mae(y_true, pred),
+            "r2": M.r2(y_true, pred),
+            "mean_residual_deviance": M.mean_residual_deviance(
+                y_true, pred, dist),
+        }

@@ -1,0 +1,66 @@
+"""DRF — distributed random forest on the shared histogram tree core.
+
+Reference: hex/tree/drf/DRF.java (SURVEY.md §2b C10) — SharedTree with
+bootstrap row sampling, per-split feature sampling (`mtries`), and no
+boosting: trees fit the raw target independently and predictions
+average across trees. With g = -y, h = 1 the shared core's leaf value
+-G/H is exactly the in-leaf mean of y (CART variance-reduction splits),
+so classification leaves hold P(class) directly — no link function.
+
+Depth note: the reference allows max_depth up to 20 via dynamic row
+partitions; the dense-heap TPU layout is per-level O(2^d · F · B), so
+the practical default here is 12 with 64 bins (XRT-style capped depth).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Sequence
+
+import numpy as np
+
+from ..frame import Frame
+from .base import resolve_xy
+from .gbm import GBM, GBMModel, GBMParams
+
+
+class DRFModel(GBMModel):
+    algo = "drf"
+
+
+class DRF(GBM):
+    """H2ORandomForestEstimator analog."""
+
+    model_cls = DRFModel
+
+    def __init__(self, ntrees: int = 50, max_depth: int = 12,
+                 nbins: int = 64, sample_rate: float = 0.632,
+                 mtries: int = -2, min_rows: float = 1.0, **kw):
+        kw.setdefault("min_split_improvement", 1e-5)
+        super().__init__(ntrees=ntrees, max_depth=max_depth, nbins=nbins,
+                         sample_rate=sample_rate, min_rows=min_rows, **kw)
+        self.params._drf_mode = True
+        self.params.learn_rate = 1.0
+        self._mtries_arg = mtries
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None, **kw) -> DRFModel:
+        # resolve mtries default: sqrt(F) for classification, F/3 for
+        # regression (reference DRF defaults) — from column names only,
+        # without materializing the design matrix twice
+        ignored = set(kw.get("ignored_columns") or [])
+        ignored.add(y)
+        if kw.get("weights_column"):
+            ignored.add(kw["weights_column"])
+        names = list(x) if x else [
+            n for n in training_frame.names
+            if n not in ignored and
+            training_frame.vec(n).kind in ("numeric", "enum", "time")]
+        F = len(names)
+        classification = training_frame.vec(y).is_enum()
+        if self._mtries_arg == -2:
+            m = int(np.sqrt(F)) if classification else max(F // 3, 1)
+            self.params.mtries = max(m, 1)
+        elif self._mtries_arg > 0:
+            self.params.mtries = self._mtries_arg
+        return super().train(y=y, training_frame=training_frame, x=x, **kw)

@@ -1,0 +1,295 @@
+"""GBM — gradient boosting with the shared histogram tree core.
+
+Reference behavior: hex/tree/gbm/GBM.java driving SharedTree
+(SURVEY.md §3.4): per tree, score-and-update residuals, then per level
+one full-cluster histogram MRTask + split finding. Here the whole tree
+builds in one jitted shard_map (models/tree/core.py); the outer loop
+over trees is host-side Python, as in the reference's Driver.
+
+Distributions (hex/genmodel DistributionFamily analogs):
+  gaussian     g = f - y,            h = 1
+  bernoulli    g = p - y,            h = p(1-p)       (logit link)
+  multinomial  K trees/iter, softmax gradient
+  poisson      g = exp(f) - y,       h = exp(f)        (log link)
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..frame import Frame
+from ..runtime.mesh import global_mesh
+from .base import Model, TrainData, resolve_xy
+from .tree.binning import BinSpec, apply_bins, fit_bins
+from .tree.core import Tree, TreeParams, grow_tree, predict_tree
+
+
+@dataclass
+class GBMParams:
+    ntrees: int = 50
+    max_depth: int = 5
+    learn_rate: float = 0.1
+    min_rows: float = 10.0
+    nbins: int = 256
+    sample_rate: float = 1.0
+    col_sample_rate_per_tree: float = 1.0
+    mtries: int = -1                     # per-node feature sampling (DRF)
+    distribution: str = "auto"
+    reg_lambda: float = 0.0
+    reg_alpha: float = 0.0
+    min_split_improvement: float = 1e-5  # H2O default
+    seed: int = 0
+    score_every: int = 0                 # 0 = score only at end
+    # DRF mode: no shrinkage on margins, trees vote/average
+    _drf_mode: bool = False
+
+
+def _grad_hess(distribution: str, margin, y):
+    if distribution == "gaussian":
+        return margin - y, jnp.ones_like(margin)
+    if distribution == "bernoulli":
+        p = jax.nn.sigmoid(margin)
+        return p - y, p * (1.0 - p)
+    if distribution == "poisson":
+        mu = jnp.exp(margin)
+        return mu - y, mu
+    raise ValueError(distribution)
+
+
+def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
+    """Training metrics from the CURRENT boosting margin (no re-predict)."""
+    from .. import metrics as M
+
+    ok = np.asarray(w) > 0
+    yv = np.asarray(y)[ok]
+    if dist == "bernoulli":
+        p1 = np.asarray(jax.nn.sigmoid(margin))[ok]
+        return {"train_logloss": M.logloss(yv, p1),
+                "train_auc": M.roc_auc(yv, p1)}
+    if dist == "multinomial":
+        pr = np.asarray(jax.nn.softmax(margin, axis=1))[ok]
+        return {"train_logloss": M.multinomial_logloss(yv, pr)}
+    if dist == "poisson":
+        return {"train_rmse": M.rmse(yv, np.exp(np.asarray(margin))[ok])}
+    return {"train_rmse": M.rmse(yv, np.asarray(margin)[ok])}
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _stack_predict(trees: Tree, binned, max_depth: int, n_bins: int):
+    """Sum of leaf values over a stacked [T, ...] Tree pytree."""
+
+    def body(acc, tree):
+        return acc + predict_tree(tree, binned, max_depth, n_bins), None
+
+    init = jnp.zeros(binned.shape[0], dtype=jnp.float32)
+    total, _ = lax.scan(body, init, trees)
+    return total
+
+
+class GBMModel(Model):
+    algo = "gbm"
+
+    def __init__(self, data: TrainData, params: GBMParams,
+                 bin_spec: BinSpec, trees: list, init_score, varimp):
+        super().__init__(data)
+        self.params = params
+        self.bin_spec = bin_spec
+        # stacked pytree: leaves have leading tree axis [T(*K), N]
+        self.trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        self.ntrees = len(trees)
+        self.init_score = init_score
+        self._varimp = varimp
+        self._edges = jnp.asarray(bin_spec.edges_matrix())
+        self._enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
+
+    def _margins(self, X: jax.Array) -> jax.Array:
+        binned = apply_bins(X, self._edges, self._enum_mask,
+                            self.bin_spec.na_bin)
+        K = self.nclasses if self.nclasses > 2 else 1
+        p = self.params
+        if K == 1:
+            m = _stack_predict(self.trees, binned, p.max_depth, p.nbins)
+            if p._drf_mode:
+                m = m / self.ntrees
+            return self.init_score + m
+        # multinomial: trees interleaved [T*K]; de-interleave per class
+        outs = []
+        for k in range(K):
+            tk = jax.tree.map(lambda a: a[k::K], self.trees)
+            mk = _stack_predict(tk, binned, p.max_depth, p.nbins)
+            if p._drf_mode:
+                mk = mk / (self.ntrees // K)
+            outs.append(self.init_score[k] + mk)
+        return jnp.stack(outs, axis=1)
+
+    def _score_matrix(self, X: jax.Array) -> jax.Array:
+        m = self._margins(X)
+        d = self.distribution
+        if d == "bernoulli":
+            p1 = jnp.clip(m, 0.0, 1.0) if self.params._drf_mode \
+                else jax.nn.sigmoid(m)
+            return jnp.stack([1.0 - p1, p1], axis=1)
+        if d == "multinomial":
+            if self.params._drf_mode:
+                m = jnp.clip(m, 0.0, None)
+                return m / (jnp.sum(m, axis=1, keepdims=True) + 1e-10)
+            return jax.nn.softmax(m, axis=1)
+        if d == "poisson":
+            return jnp.exp(m)
+        return m
+
+    def varimp(self) -> dict[str, float]:
+        """Relative importance: per-feature summed split gain, scaled."""
+        v = self._varimp
+        top = max(v.values()) if v else 1.0
+        return {k: val / top if top > 0 else 0.0
+                for k, val in sorted(v.items(), key=lambda kv: -kv[1])}
+
+
+class GBM:
+    """H2OGradientBoostingEstimator analog."""
+
+    model_cls = GBMModel
+
+    def __init__(self, **kw):
+        self.params = GBMParams(**kw)
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              weights_column: str | None = None) -> GBMModel:
+        p = self.params
+        if p.ntrees < 1:
+            raise ValueError(f"ntrees must be >= 1, got {p.ntrees}")
+        data = resolve_xy(training_frame, y, x, ignored_columns,
+                          weights_column, p.distribution)
+        bin_spec = fit_bins(training_frame, data.feature_names,
+                            n_bins=p.nbins, seed=p.seed)
+        edges = jnp.asarray(bin_spec.edges_matrix())
+        enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
+        binned = jax.jit(apply_bins, static_argnums=3)(
+            data.X, edges, enum_mask, bin_spec.na_bin)
+
+        K = data.nclasses if data.nclasses > 2 else 1
+        tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,
+                        min_rows=p.min_rows, reg_lambda=p.reg_lambda,
+                        reg_alpha=p.reg_alpha,
+                        gamma=p.min_split_improvement, mtries=p.mtries)
+        key = jax.random.key(p.seed)
+        F = len(data.feature_names)
+
+        w_sum = float(jnp.sum(data.w))
+        if p._drf_mode:
+            # DRF: no boosting — leaves are in-leaf target means, init 0
+            init = np.zeros(K, dtype=np.float32) if K > 1 else 0.0
+            margin = jnp.zeros((data.y.shape[0], K)) if K > 1 \
+                else jnp.zeros_like(data.y)
+        elif data.distribution == "bernoulli":
+            p1 = float(jnp.sum(data.y * data.w)) / w_sum
+            p1 = min(max(p1, 1e-6), 1 - 1e-6)
+            init = np.log(p1 / (1 - p1))
+            margin = jnp.full_like(data.y, init)
+        elif data.distribution == "multinomial":
+            init = np.zeros(K, dtype=np.float32)
+            for k in range(K):
+                pk = float(jnp.sum((data.y == k) * data.w)) / w_sum
+                init[k] = np.log(max(pk, 1e-8))
+            margin = jnp.broadcast_to(jnp.asarray(init)[None, :],
+                                      (data.y.shape[0], K))
+        elif data.distribution == "poisson":
+            mu = float(jnp.sum(data.y * data.w)) / w_sum
+            init = np.log(max(mu, 1e-8))
+            margin = jnp.full_like(data.y, init)
+        else:
+            init = float(jnp.sum(data.y * data.w)) / w_sum
+            margin = jnp.full_like(data.y, init)
+
+        trees: list[Tree] = []
+        history: list[dict] = []
+        varimp = np.zeros(F, dtype=np.float64)
+        for t in range(p.ntrees):
+            key, kt = jax.random.split(key)
+            w_t = data.w
+            if p.sample_rate < 1.0:
+                kt, ks = jax.random.split(kt)
+                keep = jax.random.uniform(ks, data.w.shape) < p.sample_rate
+                w_t = data.w * keep
+            col_mask = None
+            if p.col_sample_rate_per_tree < 1.0:
+                kt, kc = jax.random.split(kt)
+                col_mask = jax.random.uniform(kc, (F,)) < \
+                    p.col_sample_rate_per_tree
+            lr = 1.0 if p._drf_mode else p.learn_rate
+            if K == 1:
+                if p._drf_mode:   # leaf value -G/H = in-leaf mean of y
+                    g, h = -data.y, jnp.ones_like(data.y)
+                else:
+                    g, h = _grad_hess(data.distribution, margin, data.y)
+                tree = grow_tree(binned, g, h, w_t, tp, col_mask, kt)
+                # bake shrinkage into stored leaf values so training
+                # margins and inference sum the SAME quantities
+                tree = tree._replace(value=lr * tree.value)
+                if not p._drf_mode:
+                    leaf = _predict_jit(tree, binned, tp.max_depth,
+                                        tp.n_bins)
+                    margin = margin + leaf
+                trees.append(tree)
+                varimp += _gain_by_feat(tree, F)
+            else:
+                # multinomial: K trees per iteration on softmax gradients
+                probs = None if p._drf_mode else jax.nn.softmax(margin, 1)
+                for k in range(K):
+                    yk = (data.y == k).astype(jnp.float32)
+                    if p._drf_mode:
+                        g, h = -yk, jnp.ones_like(yk)
+                    else:
+                        pk = probs[:, k]
+                        g = pk - yk
+                        h = pk * (1.0 - pk)
+                    tree = grow_tree(binned, g, h, w_t, tp, col_mask,
+                                     jax.random.fold_in(kt, k))
+                    tree = tree._replace(value=lr * tree.value)
+                    if not p._drf_mode:
+                        leaf = _predict_jit(tree, binned, tp.max_depth,
+                                            tp.n_bins)
+                        margin = margin.at[:, k].add(leaf)
+                    trees.append(tree)
+                    varimp += _gain_by_feat(tree, F)
+            if p.score_every and (t + 1) % p.score_every == 0 \
+                    and not p._drf_mode:
+                history.append({"ntrees": t + 1, **_margin_metrics(
+                    data.distribution, margin, data.y, data.w)})
+
+        model = self.model_cls(data, p, bin_spec, trees,
+                               init_score=init,
+                               varimp=dict(zip(data.feature_names, varimp)))
+        if p._drf_mode:
+            perf = model.model_performance(training_frame, y)
+            history.append({"ntrees": p.ntrees,
+                            **{f"train_{k}": v for k, v in perf.items()}})
+        else:
+            history.append({"ntrees": p.ntrees, **_margin_metrics(
+                data.distribution, margin, data.y, data.w)})
+        model.scoring_history = history
+        return model
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _predict_jit(tree: Tree, binned, max_depth: int, n_bins: int):
+    return predict_tree(tree, binned, max_depth, n_bins)
+
+
+def _gain_by_feat(tree: Tree, F: int) -> np.ndarray:
+    feat = np.asarray(tree.split_feat)
+    gain = np.asarray(tree.gain)
+    out = np.zeros(F, dtype=np.float64)
+    sel = feat >= 0
+    np.add.at(out, feat[sel], gain[sel])
+    return out

@@ -1,0 +1,5 @@
+from .binning import BinSpec, apply_bins, fit_bins
+from .core import Tree, TreeParams, grow_tree, predict_tree
+
+__all__ = ["BinSpec", "apply_bins", "fit_bins", "Tree", "TreeParams",
+           "grow_tree", "predict_tree"]

@@ -1,0 +1,104 @@
+"""Feature binning for histogram tree learners.
+
+The reference bins feature values per split via DHistogram min/max +
+equal-width bins recomputed every level (hex/tree/DHistogram.java,
+SURVEY.md §2b C10); the bundled XGBoost path uses global quantile
+sketches (tree_method=hist). On TPU, global quantile binning wins: it is
+done ONCE per frame, turns every feature into a uint8 code, and makes
+the per-level hot loop a pure integer scatter-add — static shapes, no
+data-dependent rebinning. This follows the GBDT-on-accelerator
+literature (PAPERS.md: XGBoost GPU, Booster) rather than the Java design.
+
+Layout: B total bins per feature. Bin B-1 is reserved for NA. Numeric
+features use quantile edges (≤ B-2 finite bins); categorical features
+use their codes directly (cardinality must be ≤ B-1, else the column is
+target-encoding territory — round 1 raises).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NA_BIN_OFFSET = 1  # last bin is NA
+
+
+@dataclass
+class BinSpec:
+    """Host-side binning model: per-feature quantile edges."""
+
+    names: list[str]
+    edges: list[np.ndarray]          # per feature, ascending, len <= B-2
+    is_enum: list[bool]
+    n_bins: int = 256                # total incl. NA bin
+
+    @property
+    def na_bin(self) -> int:
+        return self.n_bins - 1
+
+    def edges_matrix(self) -> np.ndarray:
+        """[F, B-2] edge matrix padded with +inf (for device binning)."""
+        F = len(self.edges)
+        width = self.n_bins - 2
+        m = np.full((F, width), np.inf, dtype=np.float32)
+        for i, e in enumerate(self.edges):
+            m[i, : len(e)] = e
+        return m
+
+
+def fit_bins(frame, feature_names: list[str], n_bins: int = 256,
+             sample: int = 200_000, seed: int = 0) -> BinSpec:
+    """Compute quantile edges per numeric feature (host-side, sampled)."""
+    if not 4 <= n_bins <= 256:
+        raise ValueError(f"n_bins must be in [4, 256] (uint8 bin codes), "
+                         f"got {n_bins}")
+    rng = np.random.default_rng(seed)
+    edges: list[np.ndarray] = []
+    is_enum: list[bool] = []
+    for name in feature_names:
+        v = frame.vec(name)
+        if v.is_enum():
+            card = v.cardinality()
+            if card > n_bins - 1:
+                raise ValueError(
+                    f"categorical '{name}' has {card} levels > {n_bins - 1}; "
+                    "reduce cardinality or raise n_bins")
+            edges.append(np.arange(1, card, dtype=np.float32) - 0.5)
+            is_enum.append(True)
+            continue
+        x = v.to_numpy()
+        x = x[~np.isnan(x)]
+        if len(x) > sample:
+            x = rng.choice(x, size=sample, replace=False)
+        if len(x) == 0:
+            edges.append(np.empty(0, dtype=np.float32))
+            is_enum.append(False)
+            continue
+        qs = np.quantile(x, np.linspace(0, 1, n_bins - 1)[1:-1])
+        e = np.unique(qs.astype(np.float32))
+        edges.append(e)
+        is_enum.append(False)
+    return BinSpec(names=list(feature_names), edges=edges, is_enum=is_enum,
+                   n_bins=n_bins)
+
+
+def apply_bins(X: jax.Array, edges_matrix: jax.Array, enum_mask: jax.Array,
+               na_bin: int) -> jax.Array:
+    """Bin a [rows, F] float matrix → [rows, F] uint8 codes (jittable).
+
+    Numeric: searchsorted into that feature's quantile edges.
+    Enum: the code IS the bin. NaN (or negative enum code) → NA bin.
+    """
+
+    def bin_feature(col, e, is_enum):
+        num = jnp.searchsorted(e, col, side="right").astype(jnp.int32)
+        cat = jnp.clip(col, 0, na_bin - 1).astype(jnp.int32)
+        b = jnp.where(is_enum, cat, num)
+        return jnp.where(jnp.isnan(col) | (col < 0) & is_enum, na_bin, b)
+
+    binned = jax.vmap(bin_feature, in_axes=(1, 0, 0), out_axes=1)(
+        X, edges_matrix, enum_mask)
+    return binned.astype(jnp.uint8)

@@ -1,0 +1,236 @@
+"""Shared histogram tree-growing core for GBM / DRF / XGBoost-hist.
+
+This is the TPU redesign of the reference's SharedTree driver +
+ScoreBuildHistogram2 MRTask + DTree split finding (hex/tree/SharedTree,
+DHistogram, ScoreBuildHistogram2 — SURVEY.md §3.4): per level, every
+row's (grad, hess, count) is accumulated into a per-node per-feature
+per-bin histogram, histograms are all-reduced across row shards, and the
+best split per node is an argmax over (feature, bin).
+
+TPU-first choices (SURVEY.md §7 "hard parts"):
+- dense per-row relative node ids instead of dynamic row partitions;
+  dead rows carry id -1 and are masked out of histograms;
+- the whole tree builds inside ONE jitted shard_map: local segment-sum
+  histograms + `lax.psum` over the ROWS axis per level (the MRTask
+  reduce), split finding replicated on every shard;
+- trees are dense heaps padded to max_depth — no recompilation as the
+  tree grows.
+
+Split semantics: `bin <= split_bin` goes left. The NA bin is the last
+bin; `na_left` per node records the learned NA direction (both
+directions are scored, XGBoost-style).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...runtime.mesh import ROWS, global_mesh
+
+
+class TreeParams(NamedTuple):
+    max_depth: int = 5
+    n_bins: int = 256
+    min_rows: float = 10.0          # min rows per leaf (on weighted counts)
+    reg_lambda: float = 0.0         # H2O GBM has no L2 penalty; XGB uses 1.0
+    reg_alpha: float = 0.0
+    gamma: float = 0.0              # min split gain improvement
+    mtries: int = -1                # per-node feature subsampling (DRF); -1=all
+
+
+class Tree(NamedTuple):
+    """Dense heap tree: node i has children 2i+1, 2i+2. [N]=2^(d+1)-1."""
+
+    split_feat: jax.Array   # int32 [N], -1 for leaves
+    split_bin: jax.Array    # int32 [N]
+    na_left: jax.Array      # bool  [N] NA direction
+    is_split: jax.Array     # bool  [N]
+    value: jax.Array        # f32   [N] leaf value (valid where not split)
+    gain: jax.Array         # f32   [N] split gain (varimp attribution)
+
+
+def _soft_thresh(g, alpha):
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - alpha, 0.0)
+
+
+def _leaf_value(G, H, p: TreeParams):
+    return -_soft_thresh(G, p.reg_alpha) / (H + p.reg_lambda + 1e-10)
+
+
+def _gain_term(G, H, p: TreeParams):
+    return _soft_thresh(G, p.reg_alpha) ** 2 / (H + p.reg_lambda + 1e-10)
+
+
+def _build_histogram(binned, rel, g, h, w, n_nodes, n_bins):
+    """Masked per-shard histogram: [n_nodes, F, B, 3] of (G, H, count).
+
+    binned: [r, F] uint8; rel: [r] int32 relative node id (-1 = dead);
+    w: [r] f32 row weight (0 for padding / unsampled rows).
+    """
+    live = (rel >= 0) & (w > 0)
+    seg_node = jnp.where(live, rel, n_nodes)  # overflow row dropped below
+    # where() (not just *w) so NaN g/h in dead/padded rows can't poison sums
+    vals = jnp.where(live[:, None],
+                     jnp.stack([g * w, h * w, w], axis=1), 0.0)  # [r, 3]
+
+    def per_feature(bins_f):
+        seg = seg_node * n_bins + bins_f.astype(jnp.int32)
+        out = jax.ops.segment_sum(vals, seg,
+                                  num_segments=(n_nodes + 1) * n_bins)
+        return out[: n_nodes * n_bins].reshape(n_nodes, n_bins, 3)
+
+    hist = jax.vmap(per_feature, in_axes=1, out_axes=1)(binned)
+    return hist  # [n_nodes, F, B, 3]
+
+
+def _find_splits(hist, p: TreeParams, feat_ok=None):
+    """Best split per node from a [n_nodes, F, B, 3] histogram.
+
+    Scores every (feature, threshold-bin) cut with the NA bin (last)
+    assigned to each side in turn, XGBoost-style learned NA direction.
+    `feat_ok`: optional [n_nodes, F] bool mask of allowed features
+    (per-tree column sampling and DRF per-node mtries).
+    Returns (feat, bin, na_left, can_split, node_value, G, H) per node.
+    """
+    nb = hist.shape[2]
+    na = hist[:, :, nb - 1, :]                 # [n, F, 3]
+    body = hist[:, :, : nb - 1, :]
+    cum = jnp.cumsum(body, axis=2)             # left stats, NA excluded
+    tot = cum[:, :, -1, :] + na                # [n, F, 3] node totals
+    totn = tot[:, 0:1, :]                      # same for every feature
+
+    tot4 = totn[:, :, None, :]                 # [n, 1, 1, 3]
+
+    def gains(left):                           # left: [n, F, B-1, 3]
+        right = tot4 - left
+        Gl, Hl, Cl = left[..., 0], left[..., 1], left[..., 2]
+        Gr, Hr, Cr = right[..., 0], right[..., 1], right[..., 2]
+        parent = _gain_term(tot4[..., 0], tot4[..., 1], p)
+        raw = _gain_term(Gl, Hl, p) + _gain_term(Gr, Hr, p) - parent
+        ok = (Cl >= p.min_rows) & (Cr >= p.min_rows)
+        return jnp.where(ok, raw, -jnp.inf)
+
+    gain_na_r = gains(cum)                              # NA goes right
+    gain_na_l = gains(cum + na[:, :, None, :])          # NA goes left
+    na_left_better = gain_na_l > gain_na_r
+    gain = jnp.maximum(gain_na_l, gain_na_r)            # [n, F, B-1]
+    if feat_ok is not None:
+        gain = jnp.where(feat_ok[:, :, None], gain, -jnp.inf)
+
+    n_nodes, F = gain.shape[0], gain.shape[1]
+    flat = gain.reshape(n_nodes, F * (nb - 1))
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], 1)[:, 0]
+    feat = (best // (nb - 1)).astype(jnp.int32)
+    bin_ = (best % (nb - 1)).astype(jnp.int32)
+    na_l = jnp.take_along_axis(
+        na_left_better.reshape(n_nodes, -1), best[:, None], 1)[:, 0]
+
+    G, H, C = totn[:, 0, 0], totn[:, 0, 1], totn[:, 0, 2]
+    can_split = (best_gain > p.gamma) & (C >= 2 * p.min_rows) & \
+        jnp.isfinite(best_gain)
+    value = _leaf_value(G, H, p)
+    return feat, bin_, na_l, can_split, value, best_gain
+
+
+def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
+    """Per-shard tree build (runs under shard_map; histograms psum'd)."""
+    F = binned.shape[1]
+    N = 2 ** (p.max_depth + 1) - 1
+    split_feat = jnp.full(N, -1, dtype=jnp.int32)
+    split_bin = jnp.zeros(N, dtype=jnp.int32)
+    na_left = jnp.zeros(N, dtype=bool)
+    is_split = jnp.zeros(N, dtype=bool)
+    value = jnp.zeros(N, dtype=jnp.float32)
+    gain = jnp.zeros(N, dtype=jnp.float32)
+
+    rel = jnp.zeros(binned.shape[0], dtype=jnp.int32)   # relative node @ lvl
+
+    for d in range(p.max_depth + 1):
+        n_nodes = 2 ** d
+        off = n_nodes - 1
+        hist = _build_histogram(binned, rel, g, h, w, n_nodes, p.n_bins)
+        hist = lax.psum(hist, ROWS)                     # MRTask reduce
+        feat_ok = jnp.broadcast_to(col_mask[None, :], (n_nodes, F))
+        if p.mtries > 0 and p.mtries < F:
+            # DRF: exactly mtries features per node (reference: DTree
+            # per-split feature sampling with mtries, SURVEY.md §2b C10)
+            r = jax.random.uniform(jax.random.fold_in(key, d), (n_nodes, F))
+            r = jnp.where(feat_ok, r, jnp.inf)
+            kth = jnp.sort(r, axis=1)[:, p.mtries - 1: p.mtries]
+            feat_ok = feat_ok & (r <= kth)
+        feat, bin_, na_l, can, val, g_best = _find_splits(hist, p, feat_ok)
+        if d == p.max_depth:                            # final level: leaves
+            can = jnp.zeros_like(can)
+        idx = off + jnp.arange(n_nodes)
+        split_feat = split_feat.at[idx].set(jnp.where(can, feat, -1))
+        split_bin = split_bin.at[idx].set(bin_)
+        na_left = na_left.at[idx].set(na_l)
+        is_split = is_split.at[idx].set(can)
+        value = value.at[idx].set(val)
+        gain = gain.at[idx].set(jnp.where(can, g_best, 0.0))
+        if d == p.max_depth:
+            break
+        # descend rows: dead rows stay dead; rows in non-split nodes die
+        live = rel >= 0
+        safe_rel = jnp.where(live, rel, 0)
+        f = feat[safe_rel]
+        b = bin_[safe_rel]
+        nl = na_l[safe_rel]
+        rowbin = jnp.take_along_axis(
+            binned, f[:, None].astype(jnp.int32), axis=1)[:, 0].astype(
+            jnp.int32)
+        is_na = rowbin == p.n_bins - 1
+        go_right = jnp.where(is_na, ~nl, rowbin > b)
+        child = 2 * rel + go_right.astype(jnp.int32)  # rel index at d+1
+        rel = jnp.where(live & can[safe_rel], child, -1)
+
+    return Tree(split_feat, split_bin, na_left, is_split, value, gain)
+
+
+def grow_tree(binned, g, h, w, p: TreeParams, col_mask=None, key=None,
+              mesh=None) -> Tree:
+    """Build one tree over row-sharded inputs. Tree is replicated."""
+    if col_mask is None:
+        col_mask = jnp.ones(binned.shape[1], dtype=bool)
+    if key is None:
+        key = jax.random.key(0)
+    return _grow_tree_jit(binned, g, h, w, col_mask, key, p,
+                          mesh or global_mesh())
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def _grow_tree_jit(binned, g, h, w, col_mask, key, p: TreeParams,
+                   mesh) -> Tree:
+    fn = jax.shard_map(
+        functools.partial(_grow_tree_shard, p=p),
+        mesh=mesh,
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
+        out_specs=P())
+    return fn(binned, g, h, w, col_mask, key)
+
+
+def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int):
+    """Per-row leaf value by iterative heap descent (jittable)."""
+    node = jnp.zeros(binned.shape[0], dtype=jnp.int32)
+    for _ in range(max_depth):
+        f = tree.split_feat[node]
+        b = tree.split_bin[node]
+        nl = tree.na_left[node]
+        sp = tree.is_split[node]
+        rowbin = jnp.take_along_axis(
+            binned, jnp.maximum(f, 0)[:, None], axis=1)[:, 0].astype(
+            jnp.int32)
+        is_na = rowbin == n_bins - 1
+        go_right = jnp.where(is_na, ~nl, rowbin > b)
+        child = 2 * node + 1 + go_right.astype(jnp.int32)
+        node = jnp.where(sp, child, node)
+    return tree.value[node]

@@ -1,0 +1,52 @@
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu import metrics as M
+from h2o_kubernetes_tpu.models import DRF
+
+
+def test_drf_binary(mesh8):
+    rng = np.random.default_rng(0)
+    n = 4000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = ((1.2 * x1 - 0.8 * x2 + rng.normal(scale=0.4, size=n)) > 0).astype(int)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["n", "p"])[y]})
+    m = DRF(ntrees=30, max_depth=8, seed=1).train(y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["auc"] > 0.95
+
+    from sklearn.ensemble import RandomForestClassifier
+    sk = RandomForestClassifier(n_estimators=30, max_depth=8,
+                                random_state=0).fit(
+        np.stack([x1, x2], 1), y)
+    sk_auc = M.roc_auc(y, sk.predict_proba(np.stack([x1, x2], 1))[:, 1])
+    assert perf["auc"] > sk_auc - 0.035  # parity band vs sklearn RF
+
+
+def test_drf_regression(mesh8):
+    rng = np.random.default_rng(2)
+    n = 3000
+    x1 = rng.normal(size=n)
+    x2 = rng.uniform(-2, 2, size=n)
+    y = 2.0 * x1 + x2 ** 2 + rng.normal(scale=0.2, size=n)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y})
+    m = DRF(ntrees=40, max_depth=10, seed=3).train(y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["r2"] > 0.85
+
+
+def test_drf_multiclass_probs_sum_to_one(mesh8):
+    rng = np.random.default_rng(4)
+    n = 2000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cls = np.where(x1 > 0.5, 2, np.where(x2 > 0, 1, 0))
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["a", "b", "c"])[cls]})
+    m = DRF(ntrees=20, max_depth=6, seed=5).train(y="y", training_frame=fr)
+    out = m.predict_raw(fr)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+    assert m.model_performance(fr, "y")["accuracy"] > 0.9

@@ -1,0 +1,172 @@
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu import metrics as M
+from h2o_kubernetes_tpu.models import GBM
+
+
+def _binary_data(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    x3 = rng.integers(0, 4, size=n)
+    logit = 1.5 * x1 - 2.0 * (x2 ** 2) + 1.2 * (x3 == 2) + \
+        rng.normal(scale=0.3, size=n)
+    y = (logit > 0).astype(int)
+    fr = Frame.from_arrays({
+        "x1": x1, "x2": x2,
+        "x3": np.array(["a", "b", "c", "d"])[x3],
+        "y": np.array(["no", "yes"])[y],
+    })
+    X = np.stack([x1, x2, x3.astype(float)], axis=1)
+    return fr, X, y
+
+
+def test_gbm_binary_auc_beats_sklearn_parity(mesh8):
+    fr, X, y = _binary_data()
+    m = GBM(ntrees=40, max_depth=4, learn_rate=0.2, seed=1).train(
+        y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["auc"] > 0.97
+    assert perf["logloss"] < 0.25
+
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    sk = HistGradientBoostingClassifier(
+        max_iter=40, max_depth=4, learning_rate=0.2,
+        categorical_features=[2]).fit(X, y)
+    sk_auc = M.roc_auc(y, sk.predict_proba(X)[:, 1])
+    assert perf["auc"] > sk_auc - 0.01  # parity with sklearn hist-GBM
+
+
+def test_gbm_regression(mesh8):
+    rng = np.random.default_rng(3)
+    n = 3000
+    x1 = rng.normal(size=n)
+    x2 = rng.uniform(-2, 2, size=n)
+    y = 3.0 * x1 + np.sin(2 * x2) * 2 + rng.normal(scale=0.1, size=n)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y})
+    m = GBM(ntrees=60, max_depth=4, learn_rate=0.2, seed=2).train(
+        y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["rmse"] < 0.4
+    assert perf["r2"] > 0.97
+
+
+def test_gbm_multinomial(mesh8):
+    rng = np.random.default_rng(4)
+    n = 3000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cls = np.where(x1 + x2 > 0.7, 2, np.where(x1 - x2 > 0.3, 1, 0))
+    fr = Frame.from_arrays({
+        "x1": x1, "x2": x2,
+        "y": np.array(["lo", "mid", "hi"])[cls]})
+    m = GBM(ntrees=20, max_depth=4, learn_rate=0.3, seed=5).train(
+        y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["accuracy"] > 0.93
+    pred = m.predict(fr)
+    assert set(pred.names) == {"predict", "plo", "pmid", "phi"}
+    probs = np.stack([pred[c].to_numpy() for c in ("plo", "pmid", "phi")], 1)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_gbm_na_handling(mesh8):
+    rng = np.random.default_rng(6)
+    n = 3000
+    x1 = rng.normal(size=n)
+    # y depends on whether x1 is missing — the learned NA direction must
+    # pick this up
+    miss = rng.uniform(size=n) < 0.3
+    y = np.where(miss, 1, (x1 > 0).astype(int))
+    x1 = np.where(miss, np.nan, x1)
+    fr = Frame.from_arrays({"x1": x1, "noise": rng.normal(size=n),
+                            "y": np.array(["n", "p"])[y]})
+    m = GBM(ntrees=20, max_depth=3, learn_rate=0.3, seed=7).train(
+        y="y", training_frame=fr)
+    assert m.model_performance(fr, "y")["auc"] > 0.98
+
+
+def test_gbm_sampling_reproducible(mesh8):
+    fr, X, y = _binary_data(n=2000, seed=8)
+    kw = dict(ntrees=15, max_depth=3, sample_rate=0.7,
+              col_sample_rate_per_tree=0.8, seed=42)
+    a = GBM(**kw).train(y="y", training_frame=fr)
+    b = GBM(**kw).train(y="y", training_frame=fr)
+    np.testing.assert_array_equal(a.predict_raw(fr), b.predict_raw(fr))
+
+
+def test_gbm_weights_column(mesh8):
+    rng = np.random.default_rng(9)
+    n = 2000
+    x = rng.normal(size=n)
+    y = (x > 0).astype(int)
+    w = np.where(np.arange(n) < 1000, 1.0, 0.0)  # second half ignored
+    y2 = y.copy()
+    y2[1000:] = 1 - y2[1000:]  # corrupt ignored rows
+    fr = Frame.from_arrays({"x": x, "w": w,
+                            "y": np.array(["a", "b"])[y2]})
+    m = GBM(ntrees=10, max_depth=2, seed=1).train(
+        y="y", training_frame=fr, weights_column="w")
+    sub = Frame.from_arrays({"x": x[:1000],
+                             "y": np.array(["a", "b"])[y[:1000]]})
+    assert m.model_performance(sub, "y")["auc"] > 0.99
+
+
+def test_varimp_ranks_signal_over_noise(mesh8):
+    rng = np.random.default_rng(10)
+    n = 3000
+    sig = rng.normal(size=n)
+    noise = rng.normal(size=n)
+    y = (sig > 0).astype(int)
+    fr = Frame.from_arrays({"sig": sig, "noise": noise,
+                            "y": np.array(["n", "p"])[y]})
+    m = GBM(ntrees=10, max_depth=3, seed=2).train(y="y", training_frame=fr)
+    vi = m.varimp()
+    assert vi["sig"] == 1.0
+    assert vi["noise"] < 0.05
+
+
+def test_predict_remaps_enum_domains(mesh8):
+    rng = np.random.default_rng(11)
+    n = 3000
+    c = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, size=n)]
+    y = np.where(np.isin(c, ["c", "d"]), "p", "n")  # y determined by c
+    fr = Frame.from_arrays({"c": c, "noise": rng.normal(size=n), "y": y})
+    m = GBM(ntrees=10, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    # scoring frame whose enum only contains b, d: local codes differ
+    c2 = np.array(["b", "d"])[rng.integers(0, 2, size=200)]
+    fr2 = Frame.from_arrays({"c": c2, "noise": rng.normal(size=200)})
+    out = m.predict_raw(fr2)
+    # all 'd' rows must score high, all 'b' rows low
+    assert out[c2 == "d", 1].min() > 0.8
+    assert out[c2 == "b", 1].max() < 0.2
+
+
+def test_nbins_validation(mesh8):
+    fr = Frame.from_arrays({"x": np.arange(100.0),
+                            "y": np.arange(100.0)})
+    with pytest.raises(ValueError, match="n_bins"):
+        GBM(ntrees=2, nbins=512).train(y="y", training_frame=fr)
+
+
+def test_scoring_history(mesh8):
+    fr, X, y = _binary_data(n=2000, seed=12)
+    m = GBM(ntrees=10, max_depth=3, score_every=5, seed=0).train(
+        y="y", training_frame=fr)
+    assert len(m.scoring_history) == 3  # @5, @10, final
+    assert m.scoring_history[0]["train_logloss"] > \
+        m.scoring_history[-1]["train_logloss"]
+
+
+def test_time_feature_binning_consistent(mesh8):
+    rng = np.random.default_rng(13)
+    n = 2000
+    base = np.datetime64("2026-01-01T00:00:00", "ms")
+    offs = rng.integers(0, 90 * 86400_000, size=n)
+    t = base + offs.astype("timedelta64[ms]")
+    y = np.where(offs > 45 * 86400_000, "late", "early")  # split on time
+    fr = Frame.from_arrays({"t": t, "y": y})
+    m = GBM(ntrees=5, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    assert m.model_performance(fr, "y")["auc"] > 0.99
