@@ -1,4 +1,9 @@
 from .drf import DRF, DRFModel
 from .gbm import GBM, GBMModel, GBMParams
+from .deeplearning import DeepLearning, DeepLearningModel
+from .glm import GLM, GLMModel, GLMParams
+from .word2vec import Word2Vec, Word2VecModel
 
-__all__ = ["DRF", "DRFModel", "GBM", "GBMModel", "GBMParams"]
+__all__ = ["DRF", "DRFModel", "DeepLearning", "DeepLearningModel",
+           "GBM", "GBMModel", "GBMParams", "GLM", "GLMModel", "GLMParams",
+           "Word2Vec", "Word2VecModel"]

@@ -1,0 +1,95 @@
+"""DataInfo — design-matrix expansion shared by GLM and DeepLearning.
+
+Analog of hex/DataInfo.java (SURVEY.md §2b C11): numeric features are
+mean-imputed and optionally standardized; categorical features expand to
+one-hot (optional NA level; drop-first for unpenalized identifiability);
+an intercept/bias column is appended last.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame import Frame
+from .base import TrainData
+
+
+# -- DataInfo: design-matrix expansion --------------------------------------
+
+@dataclass
+class DataInfo:
+    """Expanded design layout (analog of hex/DataInfo.java)."""
+
+    coef_names: list[str]
+    numeric_idx: list[int]            # columns of X that are numeric
+    # per enum: (X col, n_levels, has_na, mode_level)
+    enum_specs: list[tuple[int, int, bool, int]]
+    means: np.ndarray                 # per expanded col (standardization)
+    stds: np.ndarray
+    n_expanded: int
+    drop_first: bool
+
+    def expand(self, X: jax.Array) -> jax.Array:
+        """[R, F] raw matrix → [R, P] standardized expanded matrix."""
+        cols = []
+        for j, i in enumerate(self.numeric_idx):
+            c = X[:, i]
+            c = jnp.where(jnp.isnan(c), self.means[j], c)  # mean imputation
+            cols.append((c - self.means[j]) / self.stds[j])
+        out = [jnp.stack(cols, axis=1)] if cols else []
+        for (i, L, has_na, mode) in self.enum_specs:
+            c = X[:, i]
+            code = jnp.where(jnp.isnan(c), L, c).astype(jnp.int32)
+            if not has_na:
+                # no NA level was trained: impute NA/unseen to the modal
+                # level (the categorical analog of numeric mean-imputation)
+                # rather than silently encoding as the dropped base level
+                code = jnp.where(code >= L, mode, code)
+            lo = 1 if self.drop_first else 0
+            width = L - lo + (1 if has_na else 0)
+            levels = jnp.arange(lo, lo + width)
+            out.append((code[:, None] == levels[None, :]).astype(jnp.float32))
+        ones = jnp.ones((X.shape[0], 1), dtype=jnp.float32)
+        out.append(ones)                       # intercept last
+        return jnp.concatenate(out, axis=1)
+
+
+def build_datainfo(data: TrainData, frame: Frame, standardize: bool,
+                   drop_first: bool) -> DataInfo:
+    numeric_idx, enum_specs, coef_names = [], [], []
+    means, stds = [], []
+    for i, name in enumerate(data.feature_names):
+        dom = data.feature_domains.get(name)
+        if dom is None:
+            numeric_idx.append(i)
+            r = frame.vec(name).rollups()
+            mu = 0.0 if np.isnan(r["mean"]) else r["mean"]
+            sd = r["sigma"] if standardize and r["sigma"] > 0 else 1.0
+            means.append(mu)
+            stds.append(sd)
+            coef_names.append(name)
+    for i, name in enumerate(data.feature_names):
+        dom = data.feature_domains.get(name)
+        if dom is not None:
+            has_na = frame.vec(name).nacnt() > 0
+            L = len(dom)
+            codes = frame.vec(name).to_numpy()
+            mode = int(np.bincount(codes[codes >= 0],
+                                   minlength=L).argmax()) if L else 0
+            enum_specs.append((i, L, has_na, mode))
+            lo = 1 if drop_first else 0
+            coef_names += [f"{name}.{d}" for d in dom[lo:]]
+            if has_na:
+                coef_names.append(f"{name}.missing(NA)")
+    coef_names.append("Intercept")
+    n_expanded = len(coef_names)
+    return DataInfo(coef_names, numeric_idx, enum_specs,
+                    np.array(means, dtype=np.float32),
+                    np.array(stds, dtype=np.float32),
+                    n_expanded, drop_first)
+
+

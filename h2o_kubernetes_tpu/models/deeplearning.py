@@ -1,0 +1,277 @@
+"""DeepLearning — MLP / autoencoder with model-averaging allreduce.
+
+Reference: hex/deeplearning (SURVEY.md §2b C12): each node runs
+asynchronous ("Hogwild") SGD over its LOCAL rows, and every
+`train_samples_per_iteration` samples an MRTask reduce AVERAGES the
+per-node weights — parameter-averaging data parallelism, not gradient
+allreduce. The TPU translation keeps those semantics exactly: each
+shard runs `local_steps` minibatch SGD steps on its local rows inside
+`shard_map`, then `psum(params)/n_shards` — the model-averaging
+allreduce on ICI (BASELINE.json:5 names this move explicitly).
+
+Differences from the reference, by design: minibatches instead of
+per-row updates (MXU efficiency), and optax adam instead of ADADELTA
+as the default adaptive rate (both are per-weight adaptive schemes;
+`adaptive_rate=False` gives plain momentum SGD like the reference's
+manual-rate mode).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..frame import Frame
+from ..runtime.mesh import ROWS, global_mesh, n_row_shards
+from .base import Model, TrainData, resolve_xy
+from .datainfo import build_datainfo
+
+
+@dataclass
+class DeepLearningParams:
+    hidden: tuple = (200, 200)
+    activation: str = "rectifier"     # rectifier | tanh
+    epochs: float = 10.0
+    mini_batch_size: int = 32
+    train_samples_per_iteration: int = -2   # -2: auto (one avg per epoch)
+    adaptive_rate: bool = True        # adam; else momentum sgd
+    rate: float = 0.005
+    momentum_start: float = 0.9
+    l1: float = 0.0
+    l2: float = 0.0
+    input_dropout_ratio: float = 0.0
+    hidden_dropout_ratios: tuple | None = None
+    autoencoder: bool = False
+    standardize: bool = True
+    seed: int = 0
+    distribution: str = "auto"
+
+
+def _act(name):
+    return jnp.tanh if name == "tanh" else jax.nn.relu
+
+
+def _init_params(key, sizes):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, k = jax.random.split(key)
+        scale = np.sqrt(2.0 / sizes[i])
+        params.append({
+            "w": jax.random.normal(k, (sizes[i], sizes[i + 1])) * scale,
+            "b": jnp.zeros(sizes[i + 1]),
+        })
+    return params
+
+
+def _forward(params, x, act, dropout_keys=None, in_drop=0.0, hid_drop=None):
+    h = x
+    if dropout_keys is not None and in_drop > 0:
+        keep = jax.random.bernoulli(dropout_keys[0], 1 - in_drop, h.shape)
+        h = h * keep / (1 - in_drop)
+    for i, layer in enumerate(params[:-1]):
+        h = act(h @ layer["w"] + layer["b"])
+        if dropout_keys is not None and hid_drop and hid_drop[i] > 0:
+            keep = jax.random.bernoulli(dropout_keys[i + 1],
+                                        1 - hid_drop[i], h.shape)
+            h = h * keep / (1 - hid_drop[i])
+    out = h @ params[-1]["w"] + params[-1]["b"]
+    return out
+
+
+def _loss_fn(params, xb, yb, wb, act, loss_kind, l1, l2, key, in_drop,
+             hid_drop):
+    nkeys = len(params) + 1
+    dkeys = jax.random.split(key, nkeys) if (in_drop or hid_drop) else None
+    out = _forward(params, xb, act, dkeys, in_drop, hid_drop)
+    if loss_kind == "ce":
+        logp = jax.nn.log_softmax(out, axis=1)
+        nll = -jnp.take_along_axis(
+            logp, yb.astype(jnp.int32)[:, None], axis=1)[:, 0]
+        loss = jnp.sum(wb * nll) / (jnp.sum(wb) + 1e-10)
+    else:  # mse (regression & autoencoder)
+        err = out - (yb if yb.ndim == 2 else yb[:, None])
+        loss = jnp.sum(wb[:, None] * err * err) / (jnp.sum(wb) + 1e-10) \
+            / err.shape[1]
+    reg = sum(jnp.sum(jnp.abs(p["w"])) for p in params) * l1 + \
+        sum(jnp.sum(p["w"] ** 2) for p in params) * l2
+    return loss + reg
+
+
+class DeepLearningModel(Model):
+    algo = "deeplearning"
+
+    def __init__(self, data: TrainData, params: DeepLearningParams,
+                 dinfo, net_params, loss_kind: str):
+        super().__init__(data)
+        self.params = params
+        self.dinfo = dinfo
+        self.net = net_params
+        self.loss_kind = loss_kind
+
+    def _score_matrix(self, X: jax.Array) -> jax.Array:
+        Xe = self.dinfo.expand(X)[:, :-1]     # drop intercept col (bias
+        act = _act(self.params.activation)    # lives in the layers)
+        out = _forward(self.net, Xe, act)
+        if self.loss_kind == "ce":
+            return jax.nn.softmax(out, axis=1)
+        if self.params.autoencoder:
+            return out
+        return out[:, 0]
+
+    def predict(self, frame: Frame) -> Frame:
+        if self.params.autoencoder:
+            # reconstruction frame, one column per expanded input feature
+            # (reference: DeepLearningModel scoring returns reconstr_*)
+            rec = self.predict_raw(frame)
+            names = self.dinfo.coef_names[:-1]  # minus intercept
+            return Frame.from_arrays(
+                {f"reconstr_{n}": rec[:, i] for i, n in enumerate(names)})
+        return super().predict(frame)
+
+    def model_performance(self, frame: Frame, y: str | None = None) -> dict:
+        if self.params.autoencoder:
+            return {"mse": float(np.mean(self.anomaly(frame)))}
+        return super().model_performance(frame, y)
+
+    def anomaly(self, frame: Frame) -> np.ndarray:
+        """Autoencoder per-row reconstruction MSE (anomaly score)."""
+        if not self.params.autoencoder:
+            raise ValueError("anomaly() requires autoencoder=True")
+        X = self._design_matrix(frame)
+        Xe = self.dinfo.expand(X)[:, :-1]
+        act = _act(self.params.activation)
+        rec = _forward(self.net, Xe, act)
+        mse = jnp.mean((rec - Xe) ** 2, axis=1)
+        return np.asarray(mse)[: frame.nrows]
+
+    def deepfeatures(self, frame: Frame, layer: int) -> np.ndarray:
+        """Hidden-layer activations (reference: DeepFeatures scoring)."""
+        X = self._design_matrix(frame)
+        Xe = self.dinfo.expand(X)[:, :-1]
+        act = _act(self.params.activation)
+        h = Xe
+        for lyr in self.net[: layer + 1]:
+            h = act(h @ lyr["w"] + lyr["b"])
+        return np.asarray(h)[: frame.nrows]
+
+
+class DeepLearning:
+    """H2ODeepLearningEstimator analog."""
+
+    def __init__(self, **kw):
+        self.params = DeepLearningParams(**kw)
+
+    def train(self, y: str | None = None, training_frame: Frame = None,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              weights_column: str | None = None) -> DeepLearningModel:
+        p = self.params
+        mesh = global_mesh()
+        n_shards = n_row_shards(mesh)
+
+        if p.autoencoder:
+            if y is None:
+                # unsupervised: fabricate a constant response for resolve_xy
+                y = "__ae_const__"
+                training_frame = Frame(dict(training_frame._vecs))
+                from ..frame import Vec
+                training_frame[y] = Vec.from_numpy(
+                    np.zeros(training_frame.nrows, dtype=np.float32), y)
+            data = resolve_xy(training_frame, y, x, ignored_columns,
+                              weights_column, "gaussian")
+        else:
+            data = resolve_xy(training_frame, y, x, ignored_columns,
+                              weights_column, p.distribution)
+
+        dinfo = build_datainfo(data, training_frame, p.standardize,
+                               drop_first=False)
+        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]   # bias is in layers
+        Pn = Xe.shape[1]
+        K = data.nclasses
+        if p.autoencoder:
+            loss_kind, out_dim = "mse", Pn
+        elif K >= 2:
+            loss_kind, out_dim = "ce", K
+        else:
+            loss_kind, out_dim = "mse", 1
+
+        sizes = (Pn,) + tuple(p.hidden) + (out_dim,)
+        key = jax.random.key(p.seed)
+        key, kinit = jax.random.split(key)
+        net = _init_params(kinit, sizes)
+
+        rows_per_shard = Xe.shape[0] // n_shards
+        batch = min(p.mini_batch_size, rows_per_shard)
+        # non-positive (incl. the reference's -2 "auto") → one model
+        # average per epoch of samples
+        samples_per_iter = p.train_samples_per_iteration \
+            if p.train_samples_per_iteration > 0 else data.nrows
+        local_steps = max(1, samples_per_iter // (batch * n_shards))
+        total_samples = p.epochs * data.nrows
+        n_iters = max(1, int(round(total_samples /
+                                   (local_steps * batch * n_shards))))
+
+        if p.adaptive_rate:
+            opt = optax.adam(p.rate)
+        else:
+            opt = optax.sgd(p.rate, momentum=p.momentum_start)
+        opt_state = opt.init(net)
+
+        act = _act(p.activation)
+        hid_drop = p.hidden_dropout_ratios
+        y_dev = Xe if p.autoencoder else data.y     # AE reconstructs input
+
+        grad_fn = jax.grad(_loss_fn)
+
+        def local_round(net, opt_state, xs, ys, ws, key):
+            """`local_steps` minibatch SGD steps on this shard's rows."""
+            key = jax.random.fold_in(key, lax.axis_index(ROWS))
+
+            def step(carry, k):
+                net, opt_state = carry
+                kidx, kdrop = jax.random.split(k)
+                idx = jax.random.randint(kidx, (batch,), 0, xs.shape[0])
+                xb = xs[idx]
+                yb = ys[idx]
+                wb = ws[idx]
+                g = grad_fn(net, xb, yb, wb, act, loss_kind, p.l1, p.l2,
+                            kdrop, p.input_dropout_ratio, hid_drop)
+                updates, opt_state = opt.update(g, opt_state, net)
+                net = optax.apply_updates(net, updates)
+                return (net, opt_state), None
+
+            keys = jax.random.split(key, local_steps)
+            (net, opt_state), _ = lax.scan(step, (net, opt_state), keys)
+            # the model-averaging allreduce (ICI psum / n)
+            net = jax.tree.map(lambda a: lax.psum(a, ROWS) / n_shards, net)
+            opt_state = jax.tree.map(
+                lambda a: lax.psum(a, ROWS) / n_shards
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, opt_state)
+            return net, opt_state
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_iter(net, opt_state, key):
+            fn = jax.shard_map(
+                functools.partial(local_round),
+                mesh=mesh,
+                in_specs=(P(), P(), P(ROWS), P(ROWS), P(ROWS), P()),
+                out_specs=P(),
+            )
+            return fn(net, opt_state, Xe, y_dev, data.w, key)
+
+        for i in range(n_iters):
+            key, ki = jax.random.split(key)
+            net, opt_state = train_iter(net, opt_state, ki)
+
+        model = DeepLearningModel(data, p, dinfo, net, loss_kind)
+        if p.autoencoder:
+            model.nclasses = 1
+        return model

@@ -1,0 +1,367 @@
+"""GLM — generalized linear models with IRLSM and L-BFGS solvers.
+
+Reference: hex/glm/GLM.java + GLMTask.GLMIterationTask + gram/Gram +
+optimization/ADMM (SURVEY.md §2b C11, §3.5): each IRLS iteration is one
+MRTask over all chunks accumulating the weighted Gram XᵀWX and XᵀWz,
+reduced over the node ring, then a Cholesky solve on the driver (ADMM
+wrap for L1). Here the Gram accumulation is a per-shard fused matmul
+(MXU work) + `psum` over the ROWS axis, and the [P,P] solve runs
+replicated on device — the exact §3.5 correspondence.
+
+DataInfo analog: numeric features are mean-imputed + standardized;
+categorical features expand to one-hot (with optional NA level and
+drop-first when unpenalized), all device-side.
+
+Families: gaussian (identity), binomial (logit), poisson (log).
+Solvers: IRLSM (+ ADMM proximal loop for elastic-net L1), L_BFGS
+(optax.lbfgs on the penalized deviance). lambda_search fits a warm-
+started descending λ path.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..frame import Frame
+from ..runtime.mesh import ROWS, global_mesh
+from .base import Model, TrainData, resolve_xy
+from .datainfo import DataInfo, build_datainfo
+
+
+@dataclass
+class GLMParams:
+    family: str = "gaussian"          # gaussian | binomial | poisson
+    solver: str = "IRLSM"             # IRLSM | L_BFGS
+    alpha: float = 0.5                # elastic-net mixing (1 = lasso)
+    lambda_: float | None = None      # None → 0 unless lambda_search
+    lambda_search: bool = False
+    nlambdas: int = 30
+    lambda_min_ratio: float = 1e-4
+    standardize: bool = True
+    use_all_factor_levels: bool = False
+    max_iterations: int = 50
+    objective_epsilon: float = 1e-6
+    beta_epsilon: float = 1e-4
+    seed: int = 0
+
+
+# -- link/family math --------------------------------------------------------
+
+def _linkinv(family, eta):
+    if family == "binomial":
+        return jax.nn.sigmoid(eta)
+    if family == "poisson":
+        return jnp.exp(jnp.clip(eta, -30, 30))
+    return eta
+
+
+def _family_deviance(family, y, mu, w):
+    if family == "binomial":
+        mu = jnp.clip(mu, 1e-7, 1 - 1e-7)
+        ll = y * jnp.log(mu) + (1 - y) * jnp.log1p(-mu)
+        return -2.0 * jnp.sum(w * ll)
+    if family == "poisson":
+        mu = jnp.clip(mu, 1e-10, None)
+        t = jnp.where(y > 0, y * jnp.log(y / mu), 0.0)
+        return 2.0 * jnp.sum(w * (t - (y - mu)))
+    return jnp.sum(w * (y - mu) ** 2)
+
+
+def _irls_weights(family, eta, mu, y):
+    """(working weight, working response z) for one IRLS step."""
+    if family == "binomial":
+        wk = jnp.clip(mu * (1 - mu), 1e-10, None)
+        z = eta + (y - mu) / wk
+        return wk, z
+    if family == "poisson":
+        wk = jnp.clip(mu, 1e-10, None)
+        z = eta + (y - mu) / wk
+        return wk, z
+    return jnp.ones_like(eta), y
+
+
+# -- distributed accumulations (the GLMIterationTask analogs) ---------------
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _gram_task(Xe, wk, z, w, mesh):
+    """Per-shard Gram accumulate + psum: G=XᵀWX [P,P], b=XᵀWz [P]."""
+
+    def body(xs, wks, zs, ws):
+        ww = (wks * ws)[:, None]
+        G = xs.T @ (ww * xs)
+        b = xs.T @ (ww[:, 0] * zs)
+        return lax.psum(G, ROWS), lax.psum(b, ROWS)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P(ROWS),
+                         out_specs=P())(Xe, wk, z, w)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _eta_dev_task(Xe, beta, yw, family, mesh):
+    """Per-shard eta + deviance psum → (dev, eta). yw: [R,2] (y, w).
+
+    Returning eta (row-sharded) lets the IRLS loop reuse this matmul for
+    the next iteration's working weights instead of recomputing Xe@beta.
+    """
+
+    def body(xs, yws, b):
+        eta = xs @ b
+        mu = _linkinv(family, eta)
+        dev = _family_deviance(family, yws[:, 0], mu, yws[:, 1])
+        return lax.psum(dev, ROWS), eta
+
+    return jax.shard_map(body, mesh=mesh,
+                         in_specs=(P(ROWS), P(ROWS), P()),
+                         out_specs=(P(), P(ROWS)))(Xe, yw, beta)
+
+
+def _soft(x, k):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - k, 0.0)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _admm_solve(G, b, lam_l1, lam_l2, n_iter: int = 100):
+    """minimize ½βᵀGβ - bᵀβ + λ₁|β|₁ + ½λ₂|β|² (intercept unpenalized)."""
+    Pn = G.shape[0]
+    pen_mask = jnp.ones(Pn).at[Pn - 1].set(0.0)   # intercept last
+    rho = jnp.maximum(lam_l1, 1e-3)
+    A = G + (lam_l2 * pen_mask + rho * pen_mask)[:, None] * jnp.eye(Pn) \
+        + 1e-6 * jnp.eye(Pn)
+    L = jax.scipy.linalg.cho_factor(A)
+
+    def step(carry, _):
+        zb, u = carry
+        beta = jax.scipy.linalg.cho_solve(
+            L, b + rho * pen_mask * (zb - u))
+        zb_new = _soft(beta + u, lam_l1 / rho) * pen_mask + \
+            (beta + u) * (1 - pen_mask)
+        u_new = u + beta - zb_new
+        return (zb_new, u_new), None
+
+    (zb, _), _ = lax.scan(step, (jnp.zeros(Pn), jnp.zeros(Pn)), None,
+                          length=n_iter)
+    return zb
+
+
+@jax.jit
+def _chol_solve(G, b, lam_l2):
+    Pn = G.shape[0]
+    pen = jnp.ones(Pn).at[Pn - 1].set(0.0) * lam_l2
+    A = G + jnp.diag(pen) + 1e-6 * jnp.eye(Pn)
+    return jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(A), b)
+
+
+# -- model ------------------------------------------------------------------
+
+class GLMModel(Model):
+    algo = "glm"
+
+    def __init__(self, data: TrainData, params: GLMParams, dinfo: DataInfo,
+                 beta: jax.Array, lambda_used: float,
+                 null_deviance: float, residual_deviance: float,
+                 n_iterations: int):
+        super().__init__(data)
+        self.params = params
+        self.dinfo = dinfo
+        self.beta = beta
+        self.lambda_used = lambda_used
+        self.null_deviance = null_deviance
+        self.residual_deviance = residual_deviance
+        self.n_iterations = n_iterations
+
+    def coef(self) -> dict[str, float]:
+        """De-standardized coefficients in original units."""
+        b = np.asarray(self.beta, dtype=np.float64)
+        names = self.dinfo.coef_names
+        out = dict(zip(names, b))
+        icpt = out["Intercept"]
+        nnum = len(self.dinfo.numeric_idx)
+        for j in range(nnum):
+            name = names[j]
+            out[name] = b[j] / self.dinfo.stds[j]
+            icpt -= b[j] * self.dinfo.means[j] / self.dinfo.stds[j]
+        out["Intercept"] = icpt
+        return out
+
+    def coef_norm(self) -> dict[str, float]:
+        """Coefficients on the standardized scale (as solved)."""
+        return dict(zip(self.dinfo.coef_names,
+                        np.asarray(self.beta, dtype=np.float64)))
+
+    def _score_matrix(self, X: jax.Array) -> jax.Array:
+        Xe = self.dinfo.expand(X)
+        eta = Xe @ self.beta
+        mu = _linkinv(self.params.family, eta)
+        if self.params.family == "binomial":
+            return jnp.stack([1 - mu, mu], axis=1)
+        return mu
+
+
+class GLM:
+    """H2OGeneralizedLinearEstimator analog."""
+
+    def __init__(self, **kw):
+        self.params = GLMParams(**kw)
+
+    def _fit_beta(self, Xe, data, dinfo, lam, beta0, mesh):
+        p = self.params
+        Pn = dinfo.n_expanded
+        lam_l1 = lam * p.alpha
+        lam_l2 = lam * (1 - p.alpha)
+        n_obs = float(jnp.sum(data.w))
+        beta = beta0
+        yw = jnp.stack([data.y, data.w], axis=1)
+        dev0, eta = _eta_dev_task(Xe, beta, yw, p.family, mesh)
+        dev_prev = float(dev0)
+        it = 0
+        for it in range(1, p.max_iterations + 1):
+            mu = _linkinv(p.family, eta)       # eta reused from last solve
+            wk, z = _irls_weights(p.family, eta, mu, data.y)
+            G, b = _gram_task(Xe, wk, z, data.w, mesh)
+            G = G / n_obs
+            b = b / n_obs
+            if lam_l1 > 0:
+                beta_new = _admm_solve(G, b, lam_l1, lam_l2)
+            else:
+                beta_new = _chol_solve(G, b, lam_l2)
+            dev_new, eta = _eta_dev_task(Xe, beta_new, yw, p.family, mesh)
+            dev = float(dev_new)
+            db = float(jnp.max(jnp.abs(beta_new - beta)))
+            beta = beta_new
+            if p.family == "gaussian" and lam_l1 == 0:
+                break                      # exact one-shot solve
+            if abs(dev_prev - dev) < p.objective_epsilon * \
+                    (abs(dev_prev) + 1e-10) or db < p.beta_epsilon:
+                dev_prev = dev
+                break
+            dev_prev = dev
+        return beta, dev_prev, it
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              weights_column: str | None = None) -> GLMModel:
+        p = self.params
+        if p.family not in ("gaussian", "binomial", "poisson"):
+            raise ValueError(f"unknown family '{p.family}' (supported: "
+                             "gaussian, binomial, poisson)")
+        if p.solver not in ("IRLSM", "L_BFGS"):
+            raise ValueError(f"unknown solver '{p.solver}' (supported: "
+                             "IRLSM, L_BFGS)")
+        mesh = global_mesh()
+        fam_dist = {"binomial": "bernoulli"}.get(p.family, p.family)
+        data = resolve_xy(training_frame, y, x, ignored_columns,
+                          weights_column, fam_dist)
+        if p.family == "binomial" and data.nclasses != 2:
+            raise ValueError("binomial family needs a 2-class response")
+        if p.family != "binomial" and data.nclasses > 1:
+            raise ValueError(
+                f"family='{p.family}' needs a numeric response; "
+                f"'{y}' is categorical")
+        dinfo = build_datainfo(data, training_frame, p.standardize,
+                               drop_first=not p.use_all_factor_levels)
+        Xe = jax.jit(dinfo.expand)(data.X)
+        Pn = dinfo.n_expanded
+        n_obs = float(jnp.sum(data.w))
+        yw = jnp.stack([data.y, data.w], axis=1)
+
+        # null deviance (intercept-only model)
+        ybar = float(jnp.sum(data.y * data.w)) / n_obs
+        if p.family == "binomial":
+            ybar = min(max(ybar, 1e-7), 1 - 1e-7)
+            b0 = np.log(ybar / (1 - ybar))
+        elif p.family == "poisson":
+            b0 = np.log(max(ybar, 1e-10))
+        else:
+            b0 = ybar
+        beta_null = jnp.zeros(Pn).at[Pn - 1].set(b0)
+        null_dev = float(_eta_dev_task(Xe, beta_null, yw, p.family,
+                                         mesh)[0])
+
+        if p.lambda_search:
+            # λ_max: smallest λ zeroing all coefs (from null-model gradient)
+            eta0 = Xe @ beta_null
+            mu0 = _linkinv(p.family, eta0)
+            grad = np.asarray(jnp.abs(
+                Xe.T @ ((mu0 - data.y) * data.w))) / n_obs
+            lam_max = float(grad[:-1].max()) / max(p.alpha, 1e-3)
+            lams = np.logspace(np.log10(lam_max),
+                               np.log10(lam_max * p.lambda_min_ratio),
+                               p.nlambdas)
+        else:
+            lams = [p.lambda_ if p.lambda_ is not None else 0.0]
+
+        if p.solver == "L_BFGS":
+            beta, dev, iters = self._fit_lbfgs(Xe, data, dinfo,
+                                               float(lams[-1]), beta_null,
+                                               mesh)
+            lam_used = float(lams[-1])
+        else:
+            beta = beta_null
+            dev, iters = null_dev, 0
+            for lam in lams:               # warm-started λ path
+                beta, dev, its = self._fit_beta(Xe, data, dinfo,
+                                                float(lam), beta, mesh)
+                iters += its
+            lam_used = float(lams[-1])
+
+        return GLMModel(data, p, dinfo, beta, lam_used, null_dev, dev,
+                        iters)
+
+    def _fit_lbfgs(self, Xe, data, dinfo, lam, beta0, mesh):
+        import optax
+
+        p = self.params
+        n_obs = float(jnp.sum(data.w))
+        lam_l2 = lam * (1 - p.alpha)
+        lam_l1 = lam * p.alpha
+        Pn = dinfo.n_expanded
+        pen_mask = jnp.ones(Pn).at[Pn - 1].set(0.0)
+        yw = jnp.stack([data.y, data.w], axis=1)
+
+        def obj(beta):
+            def body(xs, yws, b):
+                eta = xs @ b
+                mu = _linkinv(p.family, eta)
+                return lax.psum(
+                    _family_deviance(p.family, yws[:, 0], mu, yws[:, 1]),
+                    ROWS)
+
+            dev = jax.shard_map(body, mesh=mesh,
+                                in_specs=(P(ROWS), P(ROWS), P()),
+                                out_specs=P())(Xe, yw, beta)
+            penal = 0.5 * lam_l2 * jnp.sum((pen_mask * beta) ** 2) + \
+                lam_l1 * jnp.sum(jnp.abs(pen_mask * beta))  # subgradient
+            return 0.5 * dev / n_obs + penal
+
+        opt = optax.lbfgs()
+        state = opt.init(beta0)
+        beta = beta0
+        value_and_grad = jax.jit(jax.value_and_grad(obj))
+
+        @jax.jit
+        def step(beta, state):
+            value, grad = value_and_grad(beta)
+            updates, state = opt.update(
+                grad, state, beta, value=value, grad=grad,
+                value_fn=obj)
+            return optax.apply_updates(beta, updates), state, value
+
+        prev = np.inf
+        it = 0
+        for it in range(1, p.max_iterations + 1):
+            beta, state, value = step(beta, state)
+            v = float(value)
+            if abs(prev - v) < p.objective_epsilon * (abs(prev) + 1e-10):
+                break
+            prev = v
+        dev = float(_eta_dev_task(Xe, beta, yw, p.family, mesh)[0])
+        return beta, dev, it

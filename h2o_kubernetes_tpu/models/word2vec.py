@@ -1,0 +1,208 @@
+"""Word2Vec — skip-gram embeddings with model-averaging allreduce.
+
+Reference: hex/word2vec (SURVEY.md §2b C13): skip-gram trained by
+per-node SGD over local text with periodic weight averaging across the
+cluster (the same parameter-averaging pattern as DeepLearning). The
+reference optimizes with hierarchical softmax; here we use negative
+sampling — the accelerator-standard equivalent objective (HS descends a
+per-word Huffman path, which is sequential and branchy; NS is two
+matmul-shaped gathers + a sigmoid, i.e. MXU work). Corpus positions
+shard over the ROWS axis; every iteration ends in `psum(params)/n`.
+
+Input convention (as the reference): a Frame with ONE string/enum
+column of words, sentences separated by NA rows.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..frame import Frame
+from ..runtime.mesh import ROWS, global_mesh, n_row_shards
+from ..runtime.mrtask import shard_rows
+
+
+@dataclass
+class Word2VecParams:
+    vec_size: int = 100
+    window_size: int = 5
+    min_word_freq: int = 5
+    negative_samples: int = 5
+    epochs: int = 5
+    init_learning_rate: float = 0.025
+    batch_per_shard: int = 512
+    seed: int = 0
+
+
+class Word2VecModel:
+    algo = "word2vec"
+
+    def __init__(self, params: Word2VecParams, vocab: list[str],
+                 counts: np.ndarray, W: np.ndarray):
+        self.params = params
+        self.vocab = vocab
+        self.word_index = {w: i for i, w in enumerate(vocab)}
+        self.counts = counts
+        self.W = W                    # [V, D] input embeddings
+
+    def find_synonyms(self, word: str, count: int = 10) -> dict[str, float]:
+        i = self.word_index.get(word)
+        if i is None:
+            return {}
+        Wn = self.W / (np.linalg.norm(self.W, axis=1, keepdims=True) + 1e-9)
+        sims = Wn @ Wn[i]
+        order = np.argsort(-sims)
+        out = {}
+        for j in order:
+            if j == i:
+                continue
+            out[self.vocab[j]] = float(sims[j])
+            if len(out) >= count:
+                break
+        return out
+
+    def to_frame(self) -> Frame:
+        cols = {"Word": np.array(self.vocab)}
+        for d in range(self.W.shape[1]):
+            cols[f"V{d + 1}"] = self.W[:, d]
+        return Frame.from_arrays(cols)
+
+    def transform(self, words_frame: Frame,
+                  aggregate_method: str = "NONE") -> np.ndarray:
+        """Map words to vectors; AVERAGE pools per NA-separated sentence."""
+        col = words_frame.vec(words_frame.names[0])
+        codes = col.to_numpy()
+        dom = col.domain or []
+        remap = np.array([self.word_index.get(w, -1) for w in dom] + [-1],
+                         dtype=np.int64)
+        idx = remap[np.where(codes < 0, len(dom), codes)]
+        vecs = np.where((idx >= 0)[:, None],
+                        self.W[np.maximum(idx, 0)], np.nan)
+        if aggregate_method.upper() == "NONE":
+            return vecs
+        # AVERAGE: sentences delimited by NA rows
+        sent_id = np.cumsum(codes < 0)
+        out = []
+        for s in np.unique(sent_id[codes >= 0]):
+            rows = vecs[(sent_id == s) & (codes >= 0) & (idx >= 0)]
+            out.append(rows.mean(axis=0) if len(rows) else
+                       np.full(self.W.shape[1], np.nan))
+        return np.stack(out) if out else np.empty((0, self.W.shape[1]))
+
+
+class Word2Vec:
+    """H2OWord2vecEstimator analog."""
+
+    def __init__(self, **kw):
+        self.params = Word2VecParams(**kw)
+
+    def train(self, training_frame: Frame) -> Word2VecModel:
+        p = self.params
+        mesh = global_mesh()
+        n_shards = n_row_shards(mesh)
+
+        col = training_frame.vec(training_frame.names[0])
+        if not col.is_enum():
+            raise ValueError("word2vec needs a single string/enum column")
+        codes = col.to_numpy()
+        dom = list(col.domain)
+
+        # vocab: words with freq >= min_word_freq, ordered by frequency
+        freq = np.bincount(codes[codes >= 0], minlength=len(dom))
+        keep = np.where(freq >= p.min_word_freq)[0]
+        keep = keep[np.argsort(-freq[keep])]
+        vocab = [dom[i] for i in keep]
+        V = len(vocab)
+        if V == 0:
+            raise ValueError("no words meet min_word_freq")
+        remap = np.full(len(dom) + 1, -1, dtype=np.int32)
+        remap[keep] = np.arange(V, dtype=np.int32)
+        corpus = remap[np.where(codes < 0, len(dom), codes)]
+        sent_id = np.cumsum(codes < 0).astype(np.int32)
+        counts = freq[keep].astype(np.float64)
+
+        # negative-sampling distribution: unigram^0.75
+        ns_logits = jnp.asarray(0.75 * np.log(counts), dtype=jnp.float32)
+
+        corpus_dev = shard_rows(corpus.astype(np.int32), pad_value=-1)
+        sent_dev = shard_rows(sent_id, pad_value=-2)
+        n_pos = len(corpus)
+        D, W_len = p.vec_size, p.window_size
+
+        key = jax.random.key(p.seed)
+        key, k1, k2 = jax.random.split(key, 3)
+        Win = jax.random.uniform(k1, (V, D), minval=-0.5 / D,
+                                 maxval=0.5 / D)
+        Wout = jnp.zeros((V, D))
+
+        def loss_fn(params, centers, contexts, negs, valid):
+            Win, Wout = params
+            v = Win[centers]                      # [B, D]
+            u = Wout[contexts]                    # [B, D]
+            un = Wout[negs]                       # [B, k, D]
+            pos = jax.nn.log_sigmoid(jnp.sum(v * u, axis=1))
+            neg = jnp.sum(jax.nn.log_sigmoid(
+                -jnp.einsum("bd,bkd->bk", v, un)), axis=1)
+            return -jnp.sum(valid * (pos + neg)) / (jnp.sum(valid) + 1e-9)
+
+        grad_fn = jax.grad(loss_fn)
+
+        def local_round(params, corp, sent, key, lr, steps):
+            key = jax.random.fold_in(key, lax.axis_index(ROWS))
+            L = corp.shape[0]
+
+            def step(params, k):
+                kc, ko, kn = jax.random.split(k, 3)
+                ci = jax.random.randint(kc, (p.batch_per_shard,), 0, L)
+                off = jax.random.randint(ko, (p.batch_per_shard,),
+                                         1, W_len + 1)
+                sign = jax.random.bernoulli(kn, 0.5,
+                                            (p.batch_per_shard,))
+                oi = jnp.clip(ci + jnp.where(sign, off, -off), 0, L - 1)
+                centers = corp[ci]
+                contexts = corp[oi]
+                valid = (centers >= 0) & (contexts >= 0) & \
+                    (sent[ci] == sent[oi]) & (ci != oi)
+                kneg = jax.random.fold_in(kn, 1)
+                negs = jax.random.categorical(
+                    kneg, ns_logits,
+                    shape=(p.batch_per_shard, p.negative_samples))
+                g = grad_fn(params, jnp.maximum(centers, 0),
+                            jnp.maximum(contexts, 0), negs,
+                            valid.astype(jnp.float32))
+                params = jax.tree.map(lambda a, b: a - lr * b, params, g)
+                return params, None
+
+            keys = jax.random.split(key, steps)
+            params, _ = lax.scan(step, params, keys)
+            return jax.tree.map(lambda a: lax.psum(a, ROWS) / n_shards,
+                                params)
+
+        # one epoch ≈ every (center, one-of-2W contexts) pair seen once
+        steps_per_iter = max(
+            1, n_pos * 2 * W_len // (p.batch_per_shard * n_shards))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def train_iter(params, key, lr):
+            fn = jax.shard_map(
+                functools.partial(local_round, steps=steps_per_iter),
+                mesh=mesh,
+                in_specs=(P(), P(ROWS), P(ROWS), P(), P()),
+                out_specs=P())
+            return fn(params, corpus_dev, sent_dev, key, lr)
+
+        params = (Win, Wout)
+        for e in range(p.epochs):
+            key, ke = jax.random.split(key)
+            lr_e = p.init_learning_rate * max(1.0 - e / p.epochs, 1e-3)
+            params = train_iter(params, ke, jnp.float32(lr_e))
+
+        return Word2VecModel(p, vocab, counts,
+                             np.asarray(params[0], dtype=np.float32))

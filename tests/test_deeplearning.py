@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu.models.deeplearning import DeepLearning
+
+
+def test_dl_binary_classification(mesh8):
+    rng = np.random.default_rng(0)
+    n = 4000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    y = ((x1 ** 2 + x2 ** 2) < 1.2).astype(int)   # nonlinear boundary
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["out", "in"])[y]})
+    m = DeepLearning(hidden=(32, 32), epochs=60, seed=1).train(
+        y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["auc"] > 0.97      # MLP must learn the circle
+
+
+def test_dl_regression(mesh8):
+    rng = np.random.default_rng(1)
+    n = 4000
+    x = rng.uniform(-2, 2, size=n)
+    y = np.sin(2 * x) + rng.normal(scale=0.05, size=n)
+    fr = Frame.from_arrays({"x": x, "y": y})
+    m = DeepLearning(hidden=(64, 64), epochs=80, seed=2).train(
+        y="y", training_frame=fr)
+    assert m.model_performance(fr, "y")["rmse"] < 0.15
+
+
+def test_dl_multiclass(mesh8):
+    rng = np.random.default_rng(2)
+    n = 3000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    cls = (x1 > 0).astype(int) + (x2 > 0).astype(int)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["a", "b", "c"])[cls]})
+    m = DeepLearning(hidden=(32,), epochs=40, seed=3).train(
+        y="y", training_frame=fr)
+    assert m.model_performance(fr, "y")["accuracy"] > 0.9
+
+
+def test_dl_autoencoder_anomaly(mesh8):
+    rng = np.random.default_rng(3)
+    n = 3000
+    # normal data on a line; anomalies off it
+    t = rng.normal(size=n)
+    X = np.stack([t, 2 * t, -t], axis=1) + rng.normal(scale=0.05,
+                                                      size=(n, 3))
+    fr = Frame.from_arrays({f"x{i}": X[:, i] for i in range(3)})
+    m = DeepLearning(hidden=(2,), epochs=60, autoencoder=True,
+                     seed=4).train(training_frame=fr)
+    scores_normal = m.anomaly(fr)
+    anomalies = Frame.from_arrays(
+        {"x0": np.array([3.0, -2.0]), "x1": np.array([-4.0, 5.0]),
+         "x2": np.array([3.0, 2.0])})
+    scores_anom = m.anomaly(anomalies)
+    assert scores_anom.min() > np.quantile(scores_normal, 0.99)
+
+
+def test_dl_deepfeatures_shape(mesh8):
+    rng = np.random.default_rng(4)
+    fr = Frame.from_arrays({"x1": rng.normal(size=500),
+                            "x2": rng.normal(size=500),
+                            "y": rng.normal(size=500)})
+    m = DeepLearning(hidden=(16, 8), epochs=2, seed=5).train(
+        y="y", training_frame=fr)
+    feats = m.deepfeatures(fr, layer=1)
+    assert feats.shape == (500, 8)
+
+
+def test_dl_autoencoder_predict_reconstruction_frame(mesh8):
+    rng = np.random.default_rng(5)
+    fr = Frame.from_arrays({f"x{i}": rng.normal(size=300) for i in range(3)})
+    m = DeepLearning(hidden=(2,), epochs=3, autoencoder=True, seed=0).train(
+        training_frame=fr)
+    rec = m.predict(fr)
+    assert rec.names == ["reconstr_x0", "reconstr_x1", "reconstr_x2"]
+    assert rec.nrows == 300
+    perf = m.model_performance(fr)
+    assert "mse" in perf

@@ -1,0 +1,152 @@
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu.models import GLM
+
+
+def _gaussian_data(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    g = np.array(["a", "b", "c"])[rng.integers(0, 3, size=n)]
+    y = 2.0 * x1 - 1.0 * x2 + 0.5 * (g == "b") + 1.5 * (g == "c") + 3.0 \
+        + rng.normal(scale=0.5, size=n)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "g": g, "y": y})
+    return fr, x1, x2, g, y
+
+
+def test_glm_gaussian_matches_ols(mesh8):
+    fr, x1, x2, g, y = _gaussian_data()
+    m = GLM(family="gaussian", lambda_=0.0).train(y="y", training_frame=fr)
+    coef = m.coef()
+    # closed-form check vs sklearn OLS on the same design
+    from sklearn.linear_model import LinearRegression
+
+    X = np.stack([x1, x2, (g == "b"), (g == "c")], axis=1).astype(float)
+    sk = LinearRegression().fit(X, y)
+    np.testing.assert_allclose(coef["x1"], sk.coef_[0], rtol=1e-3)
+    np.testing.assert_allclose(coef["x2"], sk.coef_[1], rtol=1e-3)
+    np.testing.assert_allclose(coef["g.b"], sk.coef_[2], rtol=2e-2)
+    np.testing.assert_allclose(coef["g.c"], sk.coef_[3], rtol=2e-2)
+    np.testing.assert_allclose(coef["Intercept"], sk.intercept_, rtol=1e-2)
+    assert m.model_performance(fr, "y")["r2"] > 0.9
+
+
+def test_glm_binomial_matches_sklearn(mesh8):
+    rng = np.random.default_rng(1)
+    n = 6000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    pr = 1 / (1 + np.exp(-(0.8 * x1 - 1.5 * x2 + 0.3)))
+    y = (rng.uniform(size=n) < pr).astype(int)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["n", "p"])[y]})
+    m = GLM(family="binomial", lambda_=0.0).train(y="y", training_frame=fr)
+    coef = m.coef()
+    from sklearn.linear_model import LogisticRegression
+
+    sk = LogisticRegression(C=np.inf, tol=1e-8).fit(
+        np.stack([x1, x2], 1), y)
+    np.testing.assert_allclose(coef["x1"], sk.coef_[0][0], rtol=2e-2)
+    np.testing.assert_allclose(coef["x2"], sk.coef_[0][1], rtol=2e-2)
+    perf = m.model_performance(fr, "y")
+    assert perf["auc"] > 0.8
+    assert m.null_deviance > m.residual_deviance
+
+
+def test_glm_poisson(mesh8):
+    rng = np.random.default_rng(2)
+    n = 5000
+    x = rng.normal(size=n)
+    lam = np.exp(0.5 * x + 1.0)
+    y = rng.poisson(lam).astype(float)
+    fr = Frame.from_arrays({"x": x, "y": y})
+    m = GLM(family="poisson", lambda_=0.0).train(y="y", training_frame=fr)
+    coef = m.coef()
+    np.testing.assert_allclose(coef["x"], 0.5, atol=0.05)
+    np.testing.assert_allclose(coef["Intercept"], 1.0, atol=0.05)
+
+
+def test_glm_lasso_sparsifies(mesh8):
+    rng = np.random.default_rng(3)
+    n = 3000
+    X = rng.normal(size=(n, 10))
+    y = 2.0 * X[:, 0] - 1.0 * X[:, 1] + rng.normal(scale=0.3, size=n)
+    fr = Frame.from_arrays({f"x{i}": X[:, i] for i in range(10)} | {"y": y})
+    m = GLM(family="gaussian", alpha=1.0, lambda_=0.1).train(
+        y="y", training_frame=fr)
+    coef = m.coef()
+    noise_coefs = [abs(coef[f"x{i}"]) for i in range(2, 10)]
+    assert max(noise_coefs) < 0.02          # noise zeroed by L1
+    assert abs(coef["x0"]) > 1.5            # signal survives
+
+
+def test_glm_lambda_search(mesh8):
+    fr, *_ = _gaussian_data(n=2000, seed=4)
+    m = GLM(family="gaussian", lambda_search=True, nlambdas=10,
+            alpha=0.5).train(y="y", training_frame=fr)
+    assert m.lambda_used < 0.01  # path descended far below lambda_max
+    assert m.model_performance(fr, "y")["r2"] > 0.85
+
+
+def test_glm_lbfgs_close_to_irlsm(mesh8):
+    rng = np.random.default_rng(5)
+    n = 4000
+    x1 = rng.normal(size=n)
+    x2 = rng.normal(size=n)
+    pr = 1 / (1 + np.exp(-(1.0 * x1 - 0.5 * x2)))
+    y = (rng.uniform(size=n) < pr).astype(int)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["n", "p"])[y]})
+    a = GLM(family="binomial", solver="IRLSM", lambda_=0.0,
+            max_iterations=50).train(y="y", training_frame=fr)
+    b = GLM(family="binomial", solver="L_BFGS", lambda_=0.0,
+            max_iterations=200).train(y="y", training_frame=fr)
+    ca, cb = a.coef(), b.coef()
+    np.testing.assert_allclose(ca["x1"], cb["x1"], atol=0.03)
+    np.testing.assert_allclose(ca["x2"], cb["x2"], atol=0.03)
+
+
+def test_glm_na_imputation(mesh8):
+    rng = np.random.default_rng(6)
+    n = 2000
+    x = rng.normal(size=n)
+    y = 2 * x + rng.normal(scale=0.1, size=n)
+    x_na = x.copy()
+    x_na[::7] = np.nan
+    fr = Frame.from_arrays({"x": x_na, "y": y})
+    m = GLM(family="gaussian", lambda_=0.0).train(y="y", training_frame=fr)
+    assert abs(m.coef()["x"] - 2.0) < 0.2
+
+
+def test_glm_family_response_validation(mesh8):
+    fr = Frame.from_arrays({"x": np.arange(10.0),
+                            "y": np.array(["a", "b"] * 5)})
+    with pytest.raises(ValueError, match="categorical"):
+        GLM(family="gaussian").train(y="y", training_frame=fr)
+    fr2 = Frame.from_arrays({"x": np.arange(10.0), "y": np.arange(10.0)})
+    with pytest.raises(ValueError, match="categorical|2-class"):
+        GLM(family="binomial").train(y="y", training_frame=fr2)
+
+
+def test_glm_param_validation(mesh8):
+    fr = Frame.from_arrays({"x": np.arange(10.0), "y": np.arange(10.0)})
+    with pytest.raises(ValueError, match="family"):
+        GLM(family="martian").train(y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="solver"):
+        GLM(solver="NEWTON").train(y="y", training_frame=fr)
+
+
+def test_glm_enum_na_scoring_mode_imputed(mesh8):
+    rng = np.random.default_rng(7)
+    n = 2000
+    g = np.array(["a", "b", "b", "b"])[rng.integers(0, 4, size=n)]  # b modal
+    y = 1.0 * (g == "b") + rng.normal(scale=0.1, size=n)
+    fr = Frame.from_arrays({"g": g, "y": y})
+    m = GLM(family="gaussian", lambda_=0.0).train(y="y", training_frame=fr)
+    # scoring frame with an unseen level: must impute to mode 'b', not 'a'
+    sf = Frame.from_arrays({"g": np.array(["zz", "a", "b"])})
+    pred = m.predict_raw(sf)
+    np.testing.assert_allclose(pred[0], pred[2], atol=0.05)  # zz ≈ b
+    assert abs(pred[0] - pred[1]) > 0.5                      # zz != a

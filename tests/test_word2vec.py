@@ -1,0 +1,49 @@
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu.models.word2vec import Word2Vec
+
+
+def _synthetic_corpus(n_sent=800, seed=0):
+    """Two topic clusters: {cat,dog,pet} and {car,road,drive} words
+    co-occur within topics, so embeddings must cluster by topic."""
+    rng = np.random.default_rng(seed)
+    topics = [["cat", "dog", "pet", "fur", "paw"],
+              ["car", "road", "drive", "wheel", "fuel"]]
+    words = []
+    for _ in range(n_sent):
+        topic = topics[rng.integers(0, 2)]
+        length = rng.integers(4, 9)
+        words += list(rng.choice(topic, size=length)) + [None]
+    return Frame.from_arrays({"words": np.array(words, dtype=object)})
+
+
+def test_word2vec_topic_clustering(mesh8):
+    fr = _synthetic_corpus()
+    m = Word2Vec(vec_size=16, epochs=30, min_word_freq=5, seed=1).train(fr)
+    assert set(m.vocab) == {"cat", "dog", "pet", "fur", "paw",
+                            "car", "road", "drive", "wheel", "fuel"}
+    syn = m.find_synonyms("cat", count=4)
+    assert set(syn) <= {"dog", "pet", "fur", "paw"}, syn
+
+
+def test_word2vec_transform(mesh8):
+    fr = _synthetic_corpus(n_sent=200, seed=2)
+    m = Word2Vec(vec_size=8, epochs=5, min_word_freq=2, seed=3).train(fr)
+    doc = Frame.from_arrays({"words": np.array(
+        ["cat", "dog", None, "car", "road"], dtype=object)})
+    none_vecs = m.transform(doc, aggregate_method="NONE")
+    assert none_vecs.shape == (5, 8)
+    assert np.isnan(none_vecs[2]).all()       # NA row has no vector
+    avg = m.transform(doc, aggregate_method="AVERAGE")
+    assert avg.shape == (2, 8)                # two sentences
+    assert not np.isnan(avg).any()
+
+
+def test_word2vec_to_frame(mesh8):
+    fr = _synthetic_corpus(n_sent=150, seed=4)
+    m = Word2Vec(vec_size=4, epochs=2, min_word_freq=2, seed=5).train(fr)
+    wf = m.to_frame()
+    assert wf.names[0] == "Word"
+    assert wf.ncols == 5
