@@ -72,6 +72,36 @@ def accuracy(y_true, label) -> float:
     return float((y == l).mean())
 
 
+def ndcg(y_true, score, group, k: int = 10) -> float:
+    """Mean NDCG@k over query groups (learning-to-rank metric).
+
+    Analog of the reference XGBoost extension's ranking eval
+    (h2o-extensions/xgboost eval_metric=ndcg, SURVEY.md §2b C14).
+    y_true: graded relevance per row; group: query id per row.
+    """
+    y = np.asarray(y_true).ravel().astype(np.float64)
+    s = np.asarray(score).ravel().astype(np.float64)
+    g = np.asarray(group).ravel()
+    # one argsort by group, then contiguous slices — O(n log n), not O(n·G)
+    order = np.argsort(g, kind="stable")
+    y, s, g = y[order], s[order], g[order]
+    _, starts = np.unique(g, return_index=True)
+    bounds = np.append(starts, len(g))
+    total, n = 0.0, 0
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        yy, ss = y[a:b], s[a:b]
+        kk = min(k, b - a)
+        disc = 1.0 / np.log2(np.arange(2, kk + 2))
+        top = np.argsort(-ss, kind="stable")[:kk]
+        dcg = ((2.0 ** yy[top] - 1.0) * disc).sum()
+        ideal = np.sort(2.0 ** yy - 1.0)[::-1]
+        idcg = (ideal[:kk] * disc).sum()
+        if idcg > 0:
+            total += dcg / idcg
+            n += 1
+    return total / max(n, 1)
+
+
 def r2(y_true, pred) -> float:
     y = jnp.asarray(y_true).astype(jnp.float32).ravel()
     p = jnp.asarray(pred).astype(jnp.float32).ravel()

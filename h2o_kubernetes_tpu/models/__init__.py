@@ -3,7 +3,8 @@ from .gbm import GBM, GBMModel, GBMParams
 from .deeplearning import DeepLearning, DeepLearningModel
 from .glm import GLM, GLMModel, GLMParams
 from .word2vec import Word2Vec, Word2VecModel
+from .xgboost import XGBoost, XGBoostModel
 
 __all__ = ["DRF", "DRFModel", "DeepLearning", "DeepLearningModel",
            "GBM", "GBMModel", "GBMParams", "GLM", "GLMModel", "GLMParams",
-           "Word2Vec", "Word2VecModel"]
+           "Word2Vec", "Word2VecModel", "XGBoost", "XGBoostModel"]

@@ -44,6 +44,7 @@ class GBMParams:
     distribution: str = "auto"
     reg_lambda: float = 0.0
     reg_alpha: float = 0.0
+    min_child_weight: float = 0.0        # XGBoost-style hessian-mass floor
     min_split_improvement: float = 1e-5  # H2O default
     seed: int = 0
     score_every: int = 0                 # 0 = score only at end
@@ -79,6 +80,23 @@ def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
     if dist == "poisson":
         return {"train_rmse": M.rmse(yv, np.exp(np.asarray(margin))[ok])}
     return {"train_rmse": M.rmse(yv, np.asarray(margin)[ok])}
+
+
+def _tree_sampling(p: "GBMParams", key_t, w, F: int):
+    """Row/column sampling for one boosting round → (key, w_t, col_mask).
+
+    Shared by GBM/DRF and the XGBoost rank loop so the sampling + key
+    derivation stays identical across estimators.
+    """
+    kt, w_t, col_mask = key_t, w, None
+    if p.sample_rate < 1.0:
+        kt, ks = jax.random.split(kt)
+        keep = jax.random.uniform(ks, w.shape) < p.sample_rate
+        w_t = w * keep
+    if p.col_sample_rate_per_tree < 1.0:
+        kt, kc = jax.random.split(kt)
+        col_mask = jax.random.uniform(kc, (F,)) < p.col_sample_rate_per_tree
+    return kt, w_t, col_mask
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
@@ -181,7 +199,8 @@ class GBM:
         tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,
                         min_rows=p.min_rows, reg_lambda=p.reg_lambda,
                         reg_alpha=p.reg_alpha,
-                        gamma=p.min_split_improvement, mtries=p.mtries)
+                        gamma=p.min_split_improvement, mtries=p.mtries,
+                        min_child_weight=p.min_child_weight)
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 
@@ -216,16 +235,7 @@ class GBM:
         varimp = np.zeros(F, dtype=np.float64)
         for t in range(p.ntrees):
             key, kt = jax.random.split(key)
-            w_t = data.w
-            if p.sample_rate < 1.0:
-                kt, ks = jax.random.split(kt)
-                keep = jax.random.uniform(ks, data.w.shape) < p.sample_rate
-                w_t = data.w * keep
-            col_mask = None
-            if p.col_sample_rate_per_tree < 1.0:
-                kt, kc = jax.random.split(kt)
-                col_mask = jax.random.uniform(kc, (F,)) < \
-                    p.col_sample_rate_per_tree
+            kt, w_t, col_mask = _tree_sampling(p, kt, data.w, F)
             lr = 1.0 if p._drf_mode else p.learn_rate
             if K == 1:
                 if p._drf_mode:   # leaf value -G/H = in-leaf mean of y

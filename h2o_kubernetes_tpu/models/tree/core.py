@@ -44,6 +44,7 @@ class TreeParams(NamedTuple):
     reg_alpha: float = 0.0
     gamma: float = 0.0              # min split gain improvement
     mtries: int = -1                # per-node feature subsampling (DRF); -1=all
+    min_child_weight: float = 0.0   # min hessian mass per child (XGBoost)
 
 
 class Tree(NamedTuple):
@@ -116,6 +117,8 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
         parent = _gain_term(tot4[..., 0], tot4[..., 1], p)
         raw = _gain_term(Gl, Hl, p) + _gain_term(Gr, Hr, p) - parent
         ok = (Cl >= p.min_rows) & (Cr >= p.min_rows)
+        if p.min_child_weight > 0:
+            ok &= (Hl >= p.min_child_weight) & (Hr >= p.min_child_weight)
         return jnp.where(ok, raw, -jnp.inf)
 
     gain_na_r = gains(cum)                              # NA goes right

@@ -1,0 +1,305 @@
+"""XGBoost-hist semantics on the shared TPU histogram tree core.
+
+The reference bundles native XGBoost behind a JNI extension
+(h2o-extensions/xgboost: XGBoost.java converts Frame→DMatrix and drives
+xgboost4j with tree_method=hist/gpu_hist; Rabit allreduces histograms —
+SURVEY.md §2b C14). The TPU rebuild needs no foreign library: the same
+regularized-gain hist algorithm runs on the shared tree core
+(models/tree/core.py), whose per-level psum over the ROWS mesh axis IS
+the Rabit allreduce, now on ICI.
+
+XGBoost-specific semantics implemented here, distinct from H2O GBM:
+- split gain regularized by `reg_lambda` (default 1.0), `reg_alpha`,
+  `gamma` (min loss reduction), `min_child_weight` on hessian mass;
+- objective aliases (reg:squarederror, binary:logistic, multi:softprob,
+  count:poisson) and base_score-style flat init;
+- learning-to-rank: rank:pairwise and rank:ndcg (LambdaMART) over a
+  query `group_column`, the reference's MSLR-WEB30K lambdarank config
+  (BASELINE.json:9). Pairwise lambda gradients are computed in a dense
+  [groups, max_docs] layout in fixed-size group batches (lax.map), so
+  the whole objective stays jittable with static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import metrics as M
+from ..frame import Frame
+from .base import resolve_xy
+from .gbm import GBM, GBMModel, _gain_by_feat, _predict_jit, _tree_sampling
+from .tree.binning import apply_bins, fit_bins
+from .tree.core import TreeParams, grow_tree
+
+_OBJECTIVE_ALIASES = {
+    "reg:squarederror": "gaussian",
+    "reg:linear": "gaussian",
+    "binary:logistic": "bernoulli",
+    "multi:softprob": "multinomial",
+    "multi:softmax": "multinomial",
+    "count:poisson": "poisson",
+    "rank:pairwise": "rank:pairwise",
+    "rank:ndcg": "rank:ndcg",
+}
+
+
+class XGBoostModel(GBMModel):
+    algo = "xgboost"
+    _group_column: str | None = None
+
+    def _score_matrix(self, X: jax.Array) -> jax.Array:
+        if self.distribution.startswith("rank:"):
+            return self._margins(X)          # raw ranking scores
+        return super()._score_matrix(X)
+
+    def model_performance(self, frame: Frame, y: str,
+                          group_column: str | None = None,
+                          k: int = 10) -> dict[str, float]:
+        if self.distribution.startswith("rank:"):
+            gcol = group_column or self._group_column
+            score = self.predict_raw(frame)
+            yv = frame.vec(y).to_numpy()
+            g = frame.vec(gcol).to_numpy()
+            return {f"ndcg@{k}": M.ndcg(yv, score, g, k=k)}
+        return super().model_performance(frame, y)
+
+
+# ---------------------------------------------------------------------------
+# LambdaMART gradients
+# ---------------------------------------------------------------------------
+
+class _GroupLayout:
+    """Host-side query-group layout: row-order ↔ dense [G, M] mapping."""
+
+    def __init__(self, group_ids: np.ndarray, padded_len: int):
+        uniq, inv = np.unique(group_ids, return_inverse=True)
+        self.n_groups = len(uniq)
+        sizes = np.bincount(inv, minlength=self.n_groups)
+        self.max_docs = int(sizes.max()) if len(sizes) else 1
+        G, Mx = self.n_groups, self.max_docs
+        order = np.argsort(inv, kind="stable")       # rows grouped together
+        starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+        slot = np.arange(len(inv)) - starts[inv[order]]  # within-group slot
+        idx = np.full(G * Mx, -1, dtype=np.int32)
+        pos = np.full(padded_len, -1, dtype=np.int32)
+        flat = inv[order] * Mx + slot
+        idx[flat] = order.astype(np.int32)
+        pos[order] = flat.astype(np.int32)
+        idx = idx.reshape(G, Mx)
+        self.idx = jnp.asarray(idx)          # [G, M] row index or -1
+        self.pos = jnp.asarray(pos)          # [padded] flat dense pos or -1
+        self.mask = jnp.asarray(idx >= 0)    # [G, M]
+
+
+def _ideal_dcg(y_dense: jax.Array, mask: jax.Array) -> jax.Array:
+    """Max DCG per group over the full list (LambdaMART normalizer)."""
+    gains = jnp.where(mask, 2.0 ** y_dense - 1.0, 0.0)
+    srt = jnp.sort(gains, axis=1)[:, ::-1]
+    disc = 1.0 / jnp.log2(jnp.arange(2, gains.shape[1] + 2))
+    return jnp.sum(srt * disc[None, :], axis=1)
+
+
+def _lambda_grads_batch(f, y, mask, maxdcg, use_ndcg: bool):
+    """Pairwise lambda gradients for one batch of groups.
+
+    f, y, mask: [B, M]; maxdcg: [B]. Returns (g, h): [B, M] each.
+    For each in-group pair with y_i > y_j: cross-entropy on the score
+    difference, weighted by |ΔNDCG| when use_ndcg (Burges LambdaRank).
+    """
+    fm = jnp.where(mask, f, -jnp.inf)
+    # current 1-based rank of each doc within its group (desc by score)
+    order = jnp.argsort(-fm, axis=1, stable=True)
+    rank = jnp.argsort(order, axis=1) + 1
+    diff = f[:, :, None] - f[:, None, :]               # [B, M, M]
+    rho = jax.nn.sigmoid(-diff)
+    pair = ((y[:, :, None] - y[:, None, :]) > 0) \
+        & mask[:, :, None] & mask[:, None, :]
+    if use_ndcg:
+        gain = 2.0 ** y - 1.0
+        disc = 1.0 / jnp.log2(1.0 + rank.astype(jnp.float32))
+        dgain = jnp.abs(gain[:, :, None] - gain[:, None, :])
+        ddisc = jnp.abs(disc[:, :, None] - disc[:, None, :])
+        w = dgain * ddisc / jnp.maximum(maxdcg, 1e-10)[:, None, None]
+    else:
+        w = 1.0
+    A = jnp.where(pair, w * rho, 0.0)
+    Hh = jnp.where(pair, w * rho * (1.0 - rho), 0.0)
+    g = -jnp.sum(A, axis=2) + jnp.sum(A, axis=1)
+    h = jnp.sum(Hh, axis=2) + jnp.sum(Hh, axis=1)
+    return g, h
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _lambda_grads(margin, layout_idx, layout_pos, layout_mask,
+                  use_ndcg: bool, batch: int, y_dense=None, maxdcg=None):
+    """Row-layout margins → row-layout (g, h) via the dense group layout."""
+    G, Mx = layout_idx.shape
+    f_dense = jnp.where(layout_mask, margin[jnp.maximum(layout_idx, 0)], 0.0)
+    nb = -(-G // batch)
+    pad = nb * batch - G
+
+    def pad_g(a, fill=0.0):
+        return jnp.concatenate(
+            [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)]) \
+            if pad else a
+
+    fb = pad_g(f_dense).reshape(nb, batch, Mx)
+    yb = pad_g(y_dense).reshape(nb, batch, Mx)
+    mb = pad_g(layout_mask, False).reshape(nb, batch, Mx)
+    db = pad_g(maxdcg).reshape(nb, batch)
+    g, h = lax.map(lambda t: _lambda_grads_batch(*t, use_ndcg), (fb, yb, mb, db))
+    g = g.reshape(-1, Mx).reshape(-1)[: G * Mx]
+    h = h.reshape(-1, Mx).reshape(-1)[: G * Mx]
+    ok = layout_pos >= 0
+    safe = jnp.maximum(layout_pos, 0)
+    return jnp.where(ok, g[safe], 0.0), jnp.where(ok, h[safe], 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Estimator
+# ---------------------------------------------------------------------------
+
+class XGBoost(GBM):
+    """H2OXGBoostEstimator analog (tree_method=hist on TPU).
+
+    XGBoost defaults differ from H2O GBM: eta .3, depth 6, lambda 1,
+    min_child_weight 1 (hessian mass, not row count).
+    """
+
+    model_cls = XGBoostModel
+
+    def __init__(self, ntrees: int = 50, max_depth: int = 6,
+                 learn_rate: float = 0.3, eta: float | None = None,
+                 reg_lambda: float = 1.0, reg_alpha: float = 0.0,
+                 gamma: float = 0.0, min_child_weight: float = 1.0,
+                 subsample: float = 1.0,
+                 colsample_bytree: float = 1.0,
+                 nbins: int = 256, objective: str | None = None,
+                 booster: str = "gbtree", tree_method: str = "hist",
+                 ndcg_group_batch: int = 16, **kw):
+        if booster != "gbtree":
+            raise ValueError(f"only booster=gbtree is supported: {booster}")
+        if tree_method not in ("hist", "gpu_hist", "approx", "auto"):
+            raise ValueError(f"unknown tree_method {tree_method}")
+        # H2O-side spellings map onto the XGBoost-native ones (the
+        # reference's XGBoostV3 schema does the same aliasing)
+        if "min_rows" in kw:
+            min_child_weight = kw.pop("min_rows")
+        if "sample_rate" in kw:
+            subsample = kw.pop("sample_rate")
+        if "col_sample_rate_per_tree" in kw:
+            colsample_bytree = kw.pop("col_sample_rate_per_tree")
+        dist = kw.pop("distribution", "auto")
+        if objective is not None:
+            if objective not in _OBJECTIVE_ALIASES:
+                raise ValueError(f"unknown objective {objective}")
+            dist = _OBJECTIVE_ALIASES[objective]
+        super().__init__(
+            ntrees=ntrees, max_depth=max_depth,
+            learn_rate=eta if eta is not None else learn_rate,
+            reg_lambda=reg_lambda, reg_alpha=reg_alpha,
+            min_split_improvement=gamma,
+            min_child_weight=min_child_weight,
+            sample_rate=subsample,
+            col_sample_rate_per_tree=colsample_bytree,
+            nbins=nbins, min_rows=1.0,
+            distribution=dist, **kw)
+        self._ndcg_group_batch = ndcg_group_batch
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              group_column: str | None = None, **kw) -> XGBoostModel:
+        if self.params.distribution.startswith("rank:"):
+            if group_column is None:
+                raise ValueError("ranking objectives need group_column")
+            return self._train_rank(y, training_frame, x, group_column, **kw)
+        ignored = list(kw.pop("ignored_columns", None) or [])
+        if group_column:
+            ignored.append(group_column)
+        model = super().train(y=y, training_frame=training_frame, x=x,
+                              ignored_columns=ignored, **kw)
+        model._group_column = group_column
+        return model
+
+    def _train_rank(self, y: str, frame: Frame, x, group_column: str,
+                    ignored_columns: Sequence[str] | None = None,
+                    weights_column: str | None = None) -> XGBoostModel:
+        p = self.params
+        ignored = list(ignored_columns or []) + [group_column]
+        data = resolve_xy(frame, y, x, ignored, weights_column,
+                          distribution="gaussian")
+        data.distribution = p.distribution   # rank:* carried through
+        # graded relevance stored as an enum: codes ARE the grades —
+        # score as a single-output ranker, never the multinomial path
+        data.nclasses = 1
+        data.response_domain = None
+        use_ndcg = p.distribution == "rank:ndcg"
+
+        gv = frame.vec(group_column)
+        gids = gv.to_numpy()
+        # padded rows get fresh singleton group ids → they pair with
+        # nothing and receive zero gradients
+        padded = data.y.shape[0]
+        real = np.asarray(gids).astype(np.int64)
+        gfull = np.empty(padded, dtype=np.int64)
+        gfull[: frame.nrows] = real
+        top = int(real.max()) + 1 if len(real) else 0
+        gfull[frame.nrows:] = top + np.arange(padded - frame.nrows)
+        layout = _GroupLayout(gfull, padded)
+
+        bin_spec = fit_bins(frame, data.feature_names, n_bins=p.nbins,
+                            seed=p.seed)
+        edges = jnp.asarray(bin_spec.edges_matrix())
+        enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
+        binned = jax.jit(apply_bins, static_argnums=3)(
+            data.X, edges, enum_mask, bin_spec.na_bin)
+
+        y_dense = jnp.where(layout.mask,
+                            data.y[jnp.maximum(layout.idx, 0)], 0.0)
+        maxdcg = _ideal_dcg(y_dense, layout.mask)
+
+        tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,
+                        min_rows=p.min_rows, reg_lambda=p.reg_lambda,
+                        reg_alpha=p.reg_alpha,
+                        gamma=p.min_split_improvement, mtries=p.mtries,
+                        min_child_weight=p.min_child_weight)
+        key = jax.random.key(p.seed)
+        F = len(data.feature_names)
+        margin = jnp.zeros_like(data.y)
+        trees, history = [], []
+        varimp = np.zeros(F, dtype=np.float64)
+        batch = min(self._ndcg_group_batch, layout.n_groups)
+        for t in range(p.ntrees):
+            key, kt = jax.random.split(key)
+            g, h = _lambda_grads(margin, layout.idx, layout.pos,
+                                 layout.mask, use_ndcg, batch,
+                                 y_dense=y_dense, maxdcg=maxdcg)
+            kt, w_t, col_mask = _tree_sampling(p, kt, data.w, F)
+            tree = grow_tree(binned, g, h, w_t, tp, col_mask, kt)
+            tree = tree._replace(value=p.learn_rate * tree.value)
+            margin = margin + _predict_jit(tree, binned, tp.max_depth,
+                                           tp.n_bins)
+            trees.append(tree)
+            varimp += _gain_by_feat(tree, F)
+            if p.score_every and (t + 1) % p.score_every == 0:
+                sc = np.asarray(margin)[: frame.nrows]
+                yt = np.asarray(data.y)[: frame.nrows]
+                history.append({"ntrees": t + 1,
+                                "train_ndcg@10": M.ndcg(yt, sc, gids, k=10)})
+
+        model = self.model_cls(data, p, bin_spec, trees, init_score=0.0,
+                               varimp=dict(zip(data.feature_names, varimp)))
+        model._group_column = group_column
+        sc = np.asarray(margin)[: frame.nrows]
+        yt = np.asarray(data.y)[: frame.nrows]
+        history.append({"ntrees": p.ntrees,
+                        "train_ndcg@10": M.ndcg(yt, sc, gids, k=10)})
+        model.scoring_history = history
+        return model

@@ -1,0 +1,145 @@
+"""XGBoost-hist estimator tests (config #3: hist + lambdarank)."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import metrics as M
+from h2o_kubernetes_tpu.models import XGBoost
+
+
+def _binary_frame(n=4000, seed=0):
+    rng = np.random.default_rng(seed)
+    x = {f"x{i}": rng.normal(size=n).astype(np.float32) for i in range(5)}
+    logit = 1.5 * x["x0"] - 1.0 * x["x1"] + 0.5 * x["x2"] * x["x3"]
+    y = (logit + rng.normal(scale=0.7, size=n)) > 0
+    x["y"] = np.where(y, "yes", "no")
+    return h2o.Frame.from_arrays(x)
+
+
+def _rank_frame(n_groups=60, docs=25, seed=0):
+    """Synthetic LTR data: relevance 0-4 driven by two features."""
+    rng = np.random.default_rng(seed)
+    n = n_groups * docs
+    f1 = rng.normal(size=n).astype(np.float32)
+    f2 = rng.normal(size=n).astype(np.float32)
+    f3 = rng.normal(size=n).astype(np.float32)  # noise
+    raw = 1.2 * f1 - 0.8 * f2 + rng.normal(scale=0.4, size=n)
+    rel = np.clip(np.digitize(raw, [-1.5, -0.5, 0.5, 1.5]), 0, 4)
+    group = np.repeat(np.arange(n_groups), docs)
+    fr = h2o.Frame.from_arrays({
+        "f1": f1, "f2": f2, "f3": f3,
+        "rel": rel.astype(np.float32), "qid": group.astype(np.float32)})
+    return fr, rel, group
+
+
+def test_binary_classification(mesh8):
+    fr = _binary_frame()
+    m = XGBoost(ntrees=20, max_depth=4, learn_rate=0.3, seed=1).train(
+        y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["auc"] > 0.9
+    assert m.algo == "xgboost"
+
+
+def test_objective_aliases(mesh8):
+    fr = _binary_frame(n=1000)
+    m = XGBoost(ntrees=5, objective="binary:logistic").train(
+        y="y", training_frame=fr)
+    assert m.distribution == "bernoulli"
+    with pytest.raises(ValueError):
+        XGBoost(objective="nope:nope")
+    with pytest.raises(ValueError):
+        XGBoost(booster="dart")
+
+
+def test_regression_squarederror(mesh8):
+    rng = np.random.default_rng(2)
+    n = 3000
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.uniform(-2, 2, size=n).astype(np.float32)
+    y = 3.0 * x0 + np.sin(2 * x1) + rng.normal(scale=0.1, size=n)
+    fr = h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
+    m = XGBoost(ntrees=40, max_depth=5, learn_rate=0.3,
+                objective="reg:squarederror").train(y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert perf["r2"] > 0.95
+
+
+def test_min_child_weight_regularizes(mesh8):
+    """High hessian floor must forbid tiny leaves (fewer splits)."""
+    fr = _binary_frame(n=600, seed=3)
+    loose = XGBoost(ntrees=5, max_depth=6, min_child_weight=0.0,
+                    seed=1).train(y="y", training_frame=fr)
+    tight = XGBoost(ntrees=5, max_depth=6, min_child_weight=30.0,
+                    seed=1).train(y="y", training_frame=fr)
+    n_loose = int(np.asarray(loose.trees.is_split).sum())
+    n_tight = int(np.asarray(tight.trees.is_split).sum())
+    assert n_tight < n_loose
+
+
+def test_lambdarank_ndcg_improves(mesh8):
+    fr, rel, group = _rank_frame()
+    m = XGBoost(ntrees=30, max_depth=4, learn_rate=0.3,
+                objective="rank:ndcg", seed=0).train(
+        y="rel", training_frame=fr, group_column="qid")
+    score = m.predict_raw(fr)
+    got = M.ndcg(rel, score, group, k=10)
+    random_ndcg = M.ndcg(rel, np.random.default_rng(0).normal(size=len(rel)),
+                         group, k=10)
+    ideal_on_f1 = M.ndcg(rel, fr.vec("f1").to_numpy(), group, k=10)
+    assert got > random_ndcg + 0.1
+    assert got > ideal_on_f1           # beats the single best raw feature
+    perf = m.model_performance(fr, "rel")
+    assert perf["ndcg@10"] == pytest.approx(got, abs=1e-6)
+
+
+def test_rank_pairwise_runs(mesh8):
+    fr, rel, group = _rank_frame(n_groups=20, docs=10, seed=5)
+    m = XGBoost(ntrees=10, objective="rank:pairwise", seed=0).train(
+        y="rel", training_frame=fr, group_column="qid")
+    score = m.predict_raw(fr)
+    assert M.ndcg(rel, score, group) > M.ndcg(
+        rel, np.zeros_like(rel), group) - 1e-9
+    # group column must not leak into features
+    assert "qid" not in m.feature_names
+
+
+def test_rank_with_enum_relevance(mesh8):
+    """Graded relevance stored as a categorical must still rank (and
+    score) as a single-output model, not take the multinomial path."""
+    fr, rel, group = _rank_frame(n_groups=15, docs=8, seed=7)
+    fr["rel_cat"] = h2o.Vec.from_numpy(
+        rel.astype(np.int32), domain=[str(i) for i in range(5)])
+    m = XGBoost(ntrees=3, objective="rank:ndcg", seed=0).train(
+        y="rel_cat", training_frame=fr, x=["f1", "f2", "f3"],
+        group_column="qid")
+    score = m.predict_raw(fr)          # crashed before nclasses fix
+    assert score.shape == (fr.nrows,)
+
+
+def test_h2o_param_aliases(mesh8):
+    """H2O spellings (min_rows, sample_rate, …) map to XGBoost params."""
+    m = XGBoost(ntrees=2, min_rows=5.0, sample_rate=0.8,
+                col_sample_rate_per_tree=0.9)
+    assert m.params.min_child_weight == 5.0
+    assert m.params.sample_rate == 0.8
+    assert m.params.col_sample_rate_per_tree == 0.9
+
+
+def test_rank_requires_group(mesh8):
+    fr, _, _ = _rank_frame(n_groups=5, docs=5)
+    with pytest.raises(ValueError, match="group_column"):
+        XGBoost(ntrees=2, objective="rank:ndcg").train(
+            y="rel", training_frame=fr)
+
+
+def test_ndcg_metric_known_answer():
+    # two groups; perfect ordering in g0, inverted in g1
+    y = np.array([2, 1, 0, 0, 1, 2])
+    s = np.array([3.0, 2.0, 1.0, 3.0, 2.0, 1.0])
+    g = np.array([0, 0, 0, 1, 1, 1])
+    perfect = M.ndcg(y[:3], s[:3], g[:3])
+    assert perfect == pytest.approx(1.0)
+    mixed = M.ndcg(y, s, g)
+    assert 0.5 < mixed < 1.0
