@@ -7,7 +7,7 @@ with stacked ensembles, and a C++ Kubernetes operator/CLI (native/).
 See SURVEY.md for the reference blueprint this is built against.
 """
 
-from .frame import Frame, Vec
+from .frame import Frame, Vec, import_file, parse_setup
 from .runtime import (global_mesh, initialize_distributed, make_mesh,
                       set_global_mesh, use_mesh)
 

@@ -1,3 +1,4 @@
 from .frame import Frame, Vec, NA_ENUM
+from .parse import import_file, parse_setup
 
-__all__ = ["Frame", "Vec", "NA_ENUM"]
+__all__ = ["Frame", "Vec", "NA_ENUM", "import_file", "parse_setup"]

@@ -126,6 +126,10 @@ class Vec:
     # -- rollups ------------------------------------------------------------
 
     def _compute_rollups(self) -> dict[str, float]:
+        if self.nrows == 0:
+            return dict(min=float("nan"), max=float("nan"),
+                        mean=float("nan"), sigma=0.0, nacnt=0, zeros=0,
+                        rows=0)
         if self.kind == "time":
             col = self.data  # origin-relative: full precision; shift below
         elif self.kind == "enum":
@@ -173,6 +177,54 @@ class Vec:
     def mean(self): return self.rollups()["mean"]
     def sigma(self): return self.rollups()["sigma"]
     def nacnt(self): return self.rollups()["nacnt"]
+
+    # -- row/type ops --------------------------------------------------------
+
+    def select_rows(self, idx: np.ndarray) -> "Vec":
+        """New Vec of rows at `idx` (host gather → fresh sharded column).
+
+        Row selection is a reshard, so it goes through the host; CV and
+        similar row-masked training paths should prefer weight masks,
+        which stay on device (see models/cv.py).
+        """
+        a = np.asarray(self.data)[: self.nrows][idx]
+        if self.kind == "time":
+            return Vec.from_numpy(a.astype(np.float64) + self.origin,
+                                  self.name, kind="time")
+        return Vec.from_numpy(a, self.name, domain=self.domain,
+                              kind=self.kind)
+
+    def asfactor(self) -> "Vec":
+        """Numeric → enum, domain = sorted distinct values (h2o asfactor)."""
+        if self.is_enum():
+            return self
+        a = self.to_numpy()
+        ok = ~np.isnan(a)
+        vals = np.unique(a[ok])
+        domain = [_num_str(v) for v in vals]
+        codes = np.full(len(a), NA_ENUM, dtype=np.int32)
+        codes[ok] = np.searchsorted(vals, a[ok]).astype(np.int32)
+        return Vec.from_numpy(codes, self.name, domain=domain)
+
+    def asnumeric(self) -> "Vec":
+        """Enum → numeric: parse domain labels as numbers where possible,
+        else fall back to the codes (h2o asnumeric semantics)."""
+        if not self.is_enum():
+            return self
+        a = self.to_numpy()
+        if not self.domain:  # all-NA enum column
+            return Vec.from_numpy(np.full(len(a), np.nan, np.float32),
+                                  self.name)
+        try:
+            lut = np.array([float(d) for d in self.domain], dtype=np.float32)
+        except ValueError:
+            lut = np.arange(len(self.domain), dtype=np.float32)
+        out = np.where(a >= 0, lut[np.maximum(a, 0)], np.nan)
+        return Vec.from_numpy(out.astype(np.float32), self.name)
+
+
+def _num_str(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else str(v)
 
 
 class Frame:
@@ -289,6 +341,73 @@ class Frame:
 
     def summary(self) -> dict[str, dict[str, float]]:
         return {n: v.rollups() for n, v in self._vecs.items()}
+
+    # -- row ops -------------------------------------------------------------
+
+    def select_rows(self, idx) -> "Frame":
+        """New Frame of rows at `idx` (int index array or bool mask)."""
+        idx = np.asarray(idx)
+        if idx.dtype == bool:
+            if len(idx) != self.nrows:
+                raise ValueError("mask length != nrows")
+            idx = np.flatnonzero(idx)
+        return Frame({n: v.select_rows(idx) for n, v in self._vecs.items()})
+
+    def head(self, n: int = 10) -> "Frame":
+        return self.select_rows(np.arange(min(n, self.nrows)))
+
+    def split_frame(self, ratios: Sequence[float] = (0.75,),
+                    seed: int = -1) -> list["Frame"]:
+        """Random row split into len(ratios)+1 frames (h2o split_frame).
+
+        Same sampling scheme as the reference's FrameSplitter: one uniform
+        draw per row against the cumulative ratio boundaries.
+        """
+        if sum(ratios) >= 1.0:
+            raise ValueError("ratios must sum to < 1")
+        rng = np.random.default_rng(None if seed < 0 else seed)
+        u = rng.random(self.nrows)
+        bounds = np.cumsum(list(ratios) + [1.0])
+        part = np.searchsorted(bounds, u, side="right")
+        return [self.select_rows(part == k) for k in range(len(bounds))]
+
+    def rbind(self, other: "Frame") -> "Frame":
+        """Stack rows of two column-compatible frames."""
+        if self.names != other.names:
+            raise ValueError("rbind: column names differ")
+        out: dict[str, Vec] = {}
+        for n in self.names:
+            a, b = self._vecs[n], other._vecs[n]
+            if a.kind != b.kind:
+                raise ValueError(f"rbind: column '{n}' kinds differ "
+                                 f"({a.kind} vs {b.kind})")
+            if a.is_enum() and list(a.domain) != list(b.domain):
+                dom = sorted(set(a.domain) | set(b.domain))
+                pos = {d: i for i, d in enumerate(dom)}
+                lut_a = np.array([pos[d] for d in a.domain] + [NA_ENUM],
+                                 dtype=np.int32)
+                lut_b = np.array([pos[d] for d in b.domain] + [NA_ENUM],
+                                 dtype=np.int32)
+                ca, cb = a.to_numpy(), b.to_numpy()
+                cat = np.concatenate([lut_a[np.where(ca < 0, len(lut_a) - 1, ca)],
+                                      lut_b[np.where(cb < 0, len(lut_b) - 1, cb)]])
+                out[n] = Vec.from_numpy(cat, n, domain=dom)
+            else:
+                cat = np.concatenate([a.to_numpy(), b.to_numpy()])
+                out[n] = Vec.from_numpy(cat, n, domain=a.domain, kind=a.kind)
+        return Frame(out)
+
+    def cbind(self, other: "Frame") -> "Frame":
+        """Adjoin columns of an equal-length frame (suffix dups like h2o)."""
+        if other.nrows != self.nrows:
+            raise ValueError("cbind: nrows differ")
+        out = dict(self._vecs)
+        for n, v in other._vecs.items():
+            name = n
+            while name in out:
+                name += "0"   # h2o suffixes duplicate names
+            out[name] = v
+        return Frame(out)
 
 
 def _factorize(arr: np.ndarray,

@@ -1,0 +1,327 @@
+"""Ingest: CSV (and friends) → Frame.
+
+The reference's distributed parse (water/parser/ParseDataset — preview →
+type inference → chunk-parallel parse into NewChunks → categorical
+interning across nodes; SURVEY.md §2b C8) becomes a host-side two-pass
+parse here: a preview pass infers per-column types exactly like
+ParseSetup does, then a typed bulk read materialises columns that are
+`device_put`-sharded over the mesh rows axis (Frame construction does the
+sharding). There is no cross-node string interning to do — the vocab is
+built once on the host and only int32 codes reach the device.
+
+Supported: separator sniffing, header detection, NA-token handling,
+gz/bz2/xz transparently, globs and directories (multi-file import is
+concatenated in name order, like ParseDataset over several keys), and
+explicit per-column type overrides (col_types) mirroring h2o.import_file.
+"""
+
+from __future__ import annotations
+
+import bz2
+import glob as globlib
+import gzip
+import io
+import lzma
+import os
+from typing import Mapping, Sequence
+
+import numpy as np
+
+from .frame import Frame, Vec, NA_ENUM
+
+# the reference's default NA tokens (water/parser/ParseSetup) plus pandas'
+_NA_TOKENS = {"", "na", "n/a", "nan", "null", "none", "-", "?",
+              "#n/a", "#na", "1.#qnan", "-nan", "-1.#qnan"}
+
+_SEPS = [",", "\t", ";", "|", " "]
+
+_PREVIEW_ROWS = 1000
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return io.TextIOWrapper(gzip.open(path, "rb"), encoding="utf-8",
+                                errors="replace")
+    if path.endswith(".bz2"):
+        return io.TextIOWrapper(bz2.open(path, "rb"), encoding="utf-8",
+                                errors="replace")
+    if path.endswith((".xz", ".lzma")):
+        return io.TextIOWrapper(lzma.open(path, "rb"), encoding="utf-8",
+                                errors="replace")
+    return open(path, "r", encoding="utf-8", errors="replace", newline="")
+
+
+def _expand_paths(path: str | Sequence[str]) -> list[str]:
+    if isinstance(path, (list, tuple)):
+        out: list[str] = []
+        for p in path:
+            out.extend(_expand_paths(p))
+        return out
+    if os.path.isdir(path):
+        return sorted(
+            os.path.join(path, f) for f in os.listdir(path)
+            if not f.startswith("."))
+    if any(c in path for c in "*?["):
+        hits = sorted(globlib.glob(path))
+        if not hits:
+            raise FileNotFoundError(path)
+        return hits
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    return [path]
+
+
+def _sniff_sep(lines: list[str]) -> str:
+    """Pick the separator that yields the most consistent column count > 1
+    (ParseSetup's separator guess)."""
+    best, best_score = ",", -1
+    for sep in _SEPS:
+        counts = [len(_split_line(ln, sep)) for ln in lines if ln.strip()]
+        if not counts:
+            continue
+        mode = max(set(counts), key=counts.count)
+        if mode < 2:
+            continue
+        score = counts.count(mode) * mode
+        if score > best_score:
+            best, best_score = sep, score
+    return best
+
+
+def _read_records(f, limit: int | None = None):
+    """Yield logical CSV records, joining physical lines while inside an
+    unterminated double-quoted field (multi-line cells)."""
+    count = 0
+    buf: list[str] = []
+    for ln in f:
+        buf.append(ln)
+        joined = "".join(buf)
+        if joined.count('"') % 2 == 1:
+            continue  # quote still open → record spans to next line
+        buf = []
+        if not joined.strip():
+            continue
+        yield joined
+        count += 1
+        if limit is not None and count >= limit:
+            return
+    if buf and "".join(buf).strip():
+        yield "".join(buf)
+
+
+def _split_line(line: str, sep: str) -> list[str]:
+    """Split one CSV record honoring double-quote quoting."""
+    if '"' not in line:
+        return line.rstrip("\r\n").split(sep)
+    out, cur, inq = [], [], False
+    i, n = 0, len(line)
+    while i < n:
+        c = line[i]
+        if inq:
+            if c == '"':
+                if i + 1 < n and line[i + 1] == '"':
+                    cur.append('"'); i += 1
+                else:
+                    inq = False
+            else:
+                cur.append(c)
+        elif c == '"':
+            inq = True
+        elif c == sep:
+            out.append("".join(cur)); cur = []
+        elif c not in "\r\n":
+            cur.append(c)
+        i += 1
+    out.append("".join(cur))
+    return out
+
+
+def _is_na(tok: str, na_strings: set[str]) -> bool:
+    return tok.strip().lower() in na_strings
+
+
+def _try_float(tok: str) -> float | None:
+    try:
+        return float(tok)
+    except ValueError:
+        return None
+
+
+def _infer_col_type(vals: list[str], na_strings: set[str]) -> str:
+    """ParseSetup-style vote over preview values: numeric if every non-NA
+    token parses as a number; time if they parse as dates; else enum."""
+    nnum = ntime = nother = 0
+    for tok in vals:
+        if _is_na(tok, na_strings):
+            continue
+        if _try_float(tok) is not None:
+            nnum += 1
+        elif _parse_time_ms(tok) is not None:
+            ntime += 1
+        else:
+            nother += 1
+    if nother == 0 and ntime > 0 and nnum == 0:
+        return "time"
+    if nother == 0 and ntime == 0 and nnum > 0:
+        return "numeric"
+    if nnum + ntime + nother == 0:
+        return "numeric"  # all-NA column
+    return "enum"
+
+
+_TIME_FORMATS = ["%Y-%m-%d %H:%M:%S", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d",
+                 "%m/%d/%Y", "%d-%b-%y", "%Y%m%d"]
+
+
+def _parse_time_ms(tok: str) -> float | None:
+    tok = tok.strip()
+    if not tok or tok[0] not in "0123456789":
+        return None
+    import datetime as dt
+    for fmt in _TIME_FORMATS:
+        try:
+            d = dt.datetime.strptime(tok, fmt)
+            return d.replace(tzinfo=dt.timezone.utc).timestamp() * 1000.0
+        except ValueError:
+            continue
+    return None
+
+
+def _header_vote(rows: list[list[str]], na_strings: set[str]) -> bool:
+    """ParseSetup-style header heuristic: row 1 must be all non-numeric;
+    then either the body has numbers (type break) or, for all-string data,
+    row-1 labels are unique and never recur in their own columns."""
+    first = rows[0]
+    if any(_try_float(t) is not None for t in first):
+        return False
+    body = rows[1:]
+    if not body:
+        return True
+    if any(_try_float(t) is not None for r in body for t in r
+           if not _is_na(t, na_strings)):
+        return True
+    # all-string dataset: column labels are unique and don't repeat below
+    if len(set(first)) != len(first):
+        return False
+    for c, label in enumerate(first):
+        if any(c < len(r) and r[c] == label for r in body):
+            return False
+    return True
+
+
+def parse_setup(path: str | Sequence[str], sep: str | None = None,
+                header: int = -1,
+                na_strings: Sequence[str] | None = None) -> dict:
+    """Preview pass → {files, sep, header, names, types} (the /3/ParseSetup
+    analog). `header`: -1 auto, 0 none, 1 forced."""
+    files = _expand_paths(path)
+    nas = set(_NA_TOKENS if na_strings is None
+              else [s.lower() for s in na_strings])
+    with _open_text(files[0]) as f:
+        lines = list(_read_records(f, limit=_PREVIEW_ROWS))
+    if not lines:
+        raise ValueError(f"{files[0]}: empty file")
+    if sep is None:
+        sep = _sniff_sep(lines[:50])
+    rows = [_split_line(ln, sep) for ln in lines]
+    has_header = bool(header) if header >= 0 else _header_vote(rows, nas)
+    if has_header:
+        ncol = len(rows[0])
+    else:  # modal column count over the preview (ParseSetup vote)
+        counts = [len(r) for r in rows]
+        ncol = max(set(counts), key=counts.count)
+    names = (rows[0] if has_header else [f"C{i+1}" for i in range(ncol)])
+    body = rows[1:] if has_header else rows
+    types = []
+    for c in range(ncol):
+        vals = [r[c] for r in body if c < len(r)]
+        types.append(_infer_col_type(vals, nas))
+    return {"files": files, "sep": sep, "header": has_header,
+            "names": names, "types": types, "na_strings": nas}
+
+
+def import_file(path: str | Sequence[str], sep: str | None = None,
+                header: int = -1, col_names: Sequence[str] | None = None,
+                col_types: Mapping[str, str] | Sequence[str] | None = None,
+                na_strings: Sequence[str] | None = None,
+                skipped_columns: Sequence[str] | None = None) -> Frame:
+    """h2o.import_file analog: parse CSV file(s) into a sharded Frame."""
+    setup = parse_setup(path, sep=sep, header=header, na_strings=na_strings)
+    names = list(col_names) if col_names else setup["names"]
+    types = list(setup["types"])
+    if col_types:
+        if isinstance(col_types, Mapping):
+            for n, t in col_types.items():
+                types[names.index(n)] = _norm_type(t)
+        else:
+            types = [_norm_type(t) for t in col_types]
+    skipped = set(skipped_columns or [])
+    nas = setup["na_strings"]
+    ncol = len(names)
+
+    raw: list[list[str]] = [[] for _ in range(ncol)]
+    for fp in setup["files"]:
+        with _open_text(fp) as f:
+            it = _read_records(f)
+            if setup["header"]:
+                next(it, None)
+            for lineno, ln in enumerate(it, start=1):
+                toks = _split_line(ln, setup["sep"])
+                if len(toks) > ncol:
+                    # fail loudly like ParseDataset on column-count breaks
+                    raise ValueError(
+                        f"{fp}:{lineno}: {len(toks)} columns, expected "
+                        f"{ncol}")
+                for c in range(ncol):
+                    raw[c].append(toks[c] if c < len(toks) else "")
+
+    vecs: dict[str, Vec] = {}
+    for c, (name, typ) in enumerate(zip(names, types)):
+        if name in skipped:
+            continue
+        vecs[name] = _materialize(raw[c], typ, name, nas)
+    return Frame(vecs)
+
+
+def _norm_type(t: str) -> str:
+    t = t.lower()
+    return {"real": "numeric", "int": "numeric", "float": "numeric",
+            "factor": "enum", "categorical": "enum", "string": "enum",
+            }.get(t, t)
+
+
+def _materialize(vals: list[str], typ: str, name: str,
+                 nas: set[str]) -> Vec:
+    n = len(vals)
+    if typ == "numeric":
+        out = np.empty(n, dtype=np.float32)
+        for i, tok in enumerate(vals):
+            if _is_na(tok, nas):
+                out[i] = np.nan
+            else:
+                f = _try_float(tok)
+                out[i] = np.nan if f is None else f
+        return Vec.from_numpy(out, name)
+    if typ == "time":
+        out = np.empty(n, dtype=np.float64)
+        for i, tok in enumerate(vals):
+            ms = None if _is_na(tok, nas) else _parse_time_ms(tok)
+            out[i] = np.nan if ms is None else ms
+        return Vec.from_numpy(out, name, kind="time")
+    # enum: intern strings host-side, codes to device; domain sorted
+    # alphabetically like the reference's categorical domains
+    lut: dict[str, int] = {}
+    codes = np.empty(n, dtype=np.int32)
+    for i, tok in enumerate(vals):
+        tok = tok.strip()
+        if _is_na(tok, nas):
+            codes[i] = NA_ENUM
+        else:
+            codes[i] = lut.setdefault(tok, len(lut))
+    domain = sorted(lut)
+    order = {tok: i for i, tok in enumerate(domain)}
+    remap = np.empty(len(lut) + 1, dtype=np.int32)
+    remap[-1] = NA_ENUM
+    for tok, old in lut.items():
+        remap[old] = order[tok]
+    return Vec.from_numpy(remap[codes], name, domain=domain)

@@ -1,0 +1,158 @@
+"""CSV ingest + Frame row-op tests (reference: water/parser ParseDataset
+type inference + FrameSplitter; SURVEY.md §2b C8)."""
+
+import gzip
+
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame, import_file, parse_setup
+from h2o_kubernetes_tpu.frame import NA_ENUM
+
+CSV = """id,age,income,city,signup
+1,34,55000.5,austin,2021-03-04
+2,41,NA,boston,2021-05-12
+3,,72100,austin,2022-01-30
+4,29,48000,chicago,2021-11-02
+5,50,91000,?,2020-07-19
+"""
+
+
+@pytest.fixture
+def csvfile(tmp_path, mesh8):
+    p = tmp_path / "data.csv"
+    p.write_text(CSV)
+    return str(p)
+
+
+def test_parse_setup_inference(csvfile):
+    s = parse_setup(csvfile)
+    assert s["sep"] == ","
+    assert s["header"] is True
+    assert s["names"] == ["id", "age", "income", "city", "signup"]
+    assert s["types"] == ["numeric", "numeric", "numeric", "enum", "time"]
+
+
+def test_import_file_values(csvfile):
+    fr = import_file(csvfile)
+    assert fr.shape == (5, 5)
+    np.testing.assert_allclose(fr["id"].to_numpy(), [1, 2, 3, 4, 5])
+    age = fr["age"].to_numpy()
+    assert np.isnan(age[2]) and age[0] == 34
+    inc = fr["income"].to_numpy()
+    assert np.isnan(inc[1]) and inc[0] == 55000.5
+    city = fr["city"]
+    assert city.domain == ["austin", "boston", "chicago"]
+    assert city.to_numpy()[4] == NA_ENUM  # "?" is an NA token
+    assert fr["signup"].kind == "time"
+    ms = fr["signup"].to_numpy()
+    assert ms[0] < ms[1] < ms[3]  # chronological ordering preserved
+
+
+def test_import_gz_and_glob(tmp_path, mesh8):
+    (tmp_path / "part1.csv").write_text("a,b\n1,x\n2,y\n")
+    with gzip.open(tmp_path / "part2.csv.gz", "wt") as f:
+        f.write("a,b\n3,z\n")
+    fr = import_file(str(tmp_path / "part*"))
+    assert fr.nrows == 3
+    np.testing.assert_allclose(sorted(fr["a"].to_numpy()), [1, 2, 3])
+
+
+def test_headerless_and_tab(tmp_path, mesh8):
+    p = tmp_path / "t.tsv"
+    p.write_text("1\t2.5\tq\n3\t4.5\tr\n")
+    fr = import_file(str(p))
+    assert fr.names == ["C1", "C2", "C3"]
+    np.testing.assert_allclose(fr["C2"].to_numpy(), [2.5, 4.5])
+    assert fr["C3"].is_enum()
+
+
+def test_quoted_fields(tmp_path, mesh8):
+    p = tmp_path / "q.csv"
+    p.write_text('name,v\n"a,b",1\n"say ""hi""",2\n')
+    fr = import_file(str(p))
+    assert fr["name"].domain == ["a,b", 'say "hi"']
+
+
+def test_multiline_quoted_cell(tmp_path, mesh8):
+    p = tmp_path / "m.csv"
+    p.write_text('name,v\n"a\nb",1\n"c",2\n')
+    fr = import_file(str(p))
+    assert fr.nrows == 2
+    assert fr["name"].domain == ["a\nb", "c"]
+    np.testing.assert_allclose(fr["v"].to_numpy(), [1, 2])
+
+
+def test_all_string_header_detected(tmp_path, mesh8):
+    p = tmp_path / "s.csv"
+    p.write_text("name,city\nalice,austin\nbob,boston\n")
+    fr = import_file(str(p))
+    assert fr.names == ["name", "city"]
+    assert fr["city"].domain == ["austin", "boston"]
+
+
+def test_ragged_row_fails_loudly(tmp_path, mesh8):
+    p = tmp_path / "r.csv"
+    p.write_text("a,b\n1,2\n3,4,5\n")
+    with pytest.raises(ValueError, match="columns"):
+        import_file(str(p))
+
+
+def test_empty_split_part_rollups(mesh8):
+    fr = Frame.from_arrays({"x": np.array([1.0, 2.0, 3.0], np.float32)})
+    empty = fr.select_rows(np.zeros(3, dtype=bool))
+    assert empty.nrows == 0
+    r = empty["x"].rollups()
+    assert np.isnan(r["mean"]) and r["nacnt"] == 0
+
+
+def test_asnumeric_empty_domain(mesh8):
+    v = Frame.from_arrays({"c": np.array([NA_ENUM, NA_ENUM], np.int32)},
+                          domains={"c": []})["c"]
+    out = v.asnumeric().to_numpy()
+    assert np.isnan(out).all()
+
+
+def test_col_types_override(csvfile):
+    fr = import_file(csvfile, col_types={"id": "enum"})
+    assert fr["id"].is_enum()
+
+
+def test_select_rows_and_split(mesh8):
+    rng = np.random.default_rng(3)
+    fr = Frame.from_arrays({
+        "x": rng.normal(size=500).astype(np.float32),
+        "c": np.array(["u", "v"])[rng.integers(0, 2, size=500)],
+    })
+    sub = fr.select_rows(np.arange(0, 500, 5))
+    assert sub.nrows == 100
+    np.testing.assert_allclose(sub["x"].to_numpy(),
+                               fr["x"].to_numpy()[::5])
+    assert sub["c"].domain == fr["c"].domain
+
+    parts = fr.split_frame([0.6, 0.2], seed=42)
+    assert len(parts) == 3
+    assert sum(p.nrows for p in parts) == 500
+    assert abs(parts[0].nrows - 300) < 60
+
+
+def test_rbind_cbind_asfactor(mesh8):
+    a = Frame.from_arrays({"x": np.array([1.0, 2.0], np.float32),
+                           "c": np.array(["p", "q"])})
+    b = Frame.from_arrays({"x": np.array([3.0], np.float32),
+                           "c": np.array(["r"])})
+    r = a.rbind(b)
+    assert r.nrows == 3
+    assert r["c"].domain == ["p", "q", "r"]
+    assert [r["c"].domain[i] for i in r["c"].to_numpy()] == ["p", "q", "r"]
+
+    c = a.cbind(Frame.from_arrays({"x": np.array([9.0, 8.0], np.float32)}))
+    assert c.names == ["x", "c", "x0"]
+
+    v = Frame.from_arrays({"k": np.array([2.0, 1.0, 2.0, np.nan],
+                                         np.float32)})["k"].asfactor()
+    assert v.domain == ["1", "2"]
+    assert v.to_numpy().tolist() == [1, 0, 1, NA_ENUM]
+    back = v.asnumeric()
+    out = back.to_numpy()
+    assert out[0] == 2.0 and np.isnan(out[3])
