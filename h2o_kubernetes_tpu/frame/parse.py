@@ -21,6 +21,7 @@ import bz2
 import glob as globlib
 import gzip
 import io
+import itertools
 import lzma
 import os
 from typing import Mapping, Sequence
@@ -248,6 +249,14 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
     """h2o.import_file analog: parse CSV file(s) into a sharded Frame."""
     setup = parse_setup(path, sep=sep, header=header, na_strings=na_strings)
     names = list(col_names) if col_names else setup["names"]
+    # uniquify duplicate headers like the reference parser (a, a -> a, a2)
+    # instead of silently collapsing same-named columns into one dict key
+    seen: dict[str, int] = {}
+    for i, n in enumerate(names):
+        if n in seen:
+            seen[n] += 1
+            names[i] = f"{n}{seen[n]}"
+        seen.setdefault(names[i], 1)
     types = list(setup["types"])
     if col_types:
         if isinstance(col_types, Mapping):
@@ -260,11 +269,22 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
     ncol = len(names)
 
     raw: list[list[str]] = [[] for _ in range(ncol)]
-    for fp in setup["files"]:
+    for fi, fp in enumerate(setup["files"]):
         with _open_text(fp) as f:
             it = _read_records(f)
             if setup["header"]:
-                next(it, None)
+                if fi == 0:
+                    next(it, None)
+                else:
+                    # later files in a multi-file parse may be headerless
+                    # continuations: only drop the first record when it
+                    # repeats the header (the reference checks each file's
+                    # first line against the ParseSetup columns)
+                    first = next(it, None)
+                    if first is not None:
+                        toks = _split_line(first, setup["sep"])
+                        if [t.strip() for t in toks] != setup["names"]:
+                            it = itertools.chain([first], it)
             for lineno, ln in enumerate(it, start=1):
                 toks = _split_line(ln, setup["sep"])
                 if len(toks) > ncol:

@@ -98,6 +98,22 @@ class Model:
         self.response_domain = data.response_domain
         self.distribution = data.distribution
         self.scoring_history: list[dict[str, Any]] = []
+        self.cv = None                    # CVResult when trained with nfolds
+        self.validation_metrics: dict[str, float] | None = None
+
+    # -- h2o-py-style CV accessors (H2OEstimator.cross_validation_*) -------
+
+    def cross_validation_models(self):
+        return self.cv.models if self.cv else None
+
+    def cross_validation_holdout_predictions(self):
+        return self.cv.holdout_predictions if self.cv else None
+
+    def cross_validation_metrics(self) -> dict[str, float] | None:
+        return self.cv.metrics if self.cv else None
+
+    def cross_validation_metrics_summary(self):
+        return self.cv.metrics_summary if self.cv else None
 
     # subclasses implement: _score(X) -> margin/probs array
     def _score_matrix(self, X: jax.Array) -> jax.Array:
@@ -163,25 +179,39 @@ class Model:
         out = self.predict_raw(frame)
         ok = ~np.isnan(yv.as_float().__array__()[: frame.nrows]) \
             if not yv.is_enum() else yv.to_numpy() >= 0
-        y_true = yv.to_numpy()[ok]
-        if self.nclasses == 2:
-            p1 = out[ok, 1]
-            return {
-                "auc": M.roc_auc(y_true, p1),
-                "logloss": M.logloss(y_true, p1),
-                "rmse": M.rmse(y_true, p1),
-            }
-        if self.nclasses > 2:
-            return {
-                "logloss": M.multinomial_logloss(y_true, out[ok]),
-                "accuracy": M.accuracy(y_true, out[ok].argmax(axis=1)),
-            }
-        pred = out[ok]
-        dist = "poisson" if self.distribution == "poisson" else "gaussian"
+        return score_predictions(self.nclasses, self.distribution,
+                                 yv.to_numpy()[ok], out[ok])
+
+
+def score_predictions(nclasses: int, distribution: str,
+                      y_true: np.ndarray, preds: np.ndarray
+                      ) -> dict[str, float]:
+    """Metric dispatch shared by model_performance and CV scoring.
+
+    y_true: class codes (classification) or numeric response; preds:
+    [n, K] probabilities or [n] regression predictions — NA rows
+    already filtered by the caller.
+    """
+    if len(y_true) == 0:
+        raise ValueError("cannot score an empty holdout "
+                         "(no rows with a valid response)")
+    if nclasses == 2:
+        p1 = preds[:, 1]
         return {
-            "rmse": M.rmse(y_true, pred),
-            "mae": M.mae(y_true, pred),
-            "r2": M.r2(y_true, pred),
-            "mean_residual_deviance": M.mean_residual_deviance(
-                y_true, pred, dist),
+            "auc": M.roc_auc(y_true, p1),
+            "logloss": M.logloss(y_true, p1),
+            "rmse": M.rmse(y_true, p1),
         }
+    if nclasses > 2:
+        return {
+            "logloss": M.multinomial_logloss(y_true, preds),
+            "accuracy": M.accuracy(y_true, preds.argmax(axis=1)),
+        }
+    dist = "poisson" if distribution == "poisson" else "gaussian"
+    return {
+        "rmse": M.rmse(y_true, preds),
+        "mae": M.mae(y_true, preds),
+        "r2": M.r2(y_true, preds),
+        "mean_residual_deviance": M.mean_residual_deviance(
+            y_true, preds, dist),
+    }

@@ -1,0 +1,207 @@
+"""N-fold cross-validation shared by every supervised estimator.
+
+The analog of the reference's ModelBuilder CV plumbing
+(hex/ModelBuilder.java computeCrossValidation — fold assignment, one
+cv-model per fold trained on the complement, holdout predictions kept
+for metrics and for Stacked Ensembles; SURVEY.md §2b C15/C16):
+
+- fold assignment schemes mirror H2O's ``fold_assignment`` enum:
+  AUTO(→Random), Random, Modulo, Stratified, plus an explicit
+  ``fold_column``;
+- each fold model trains on the out-of-fold rows and predicts the
+  in-fold rows; the concatenated holdout predictions are scored once
+  ("combined holdout metrics", H2O's main CV metric surface) and are
+  exactly what StackedEnsemble consumes as level-one data;
+- per-fold metrics are summarised mean ± std (H2O's
+  cross_validation_metrics_summary).
+
+Estimators opt in by constructing with ``nfolds=...`` (and optionally
+``fold_assignment=`` / ``fold_column=``), exactly like h2o-py.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..frame import Frame
+
+_CV_KEYS = ("nfolds", "fold_assignment", "fold_column",
+            "keep_cross_validation_predictions",
+            "keep_cross_validation_models")
+
+
+@dataclass
+class CVArgs:
+    """CV knobs popped off an estimator's **kwargs (h2o-py surface)."""
+
+    nfolds: int = 0
+    fold_assignment: str = "auto"     # auto | random | modulo | stratified
+    fold_column: str | None = None
+    keep_cross_validation_predictions: bool = True
+    keep_cross_validation_models: bool = True
+
+    @classmethod
+    def pop(cls, kw: dict) -> "CVArgs":
+        args = {k: kw.pop(k) for k in _CV_KEYS if k in kw}
+        out = cls(**args)
+        if out.fold_assignment.lower() not in (
+                "auto", "random", "modulo", "stratified"):
+            raise ValueError(
+                f"unknown fold_assignment '{out.fold_assignment}'")
+        return out
+
+    @property
+    def enabled(self) -> bool:
+        return self.nfolds >= 2 or self.fold_column is not None
+
+
+@dataclass
+class CVResult:
+    """Attached to a model as .cross_validation_* (h2o-py accessors)."""
+
+    fold_ids: np.ndarray
+    models: list | None
+    holdout_predictions: np.ndarray | None   # [n, K] probs or [n] preds
+    metrics: dict[str, float]                # combined-holdout metrics
+    metrics_summary: dict[str, dict[str, float]]  # per-metric mean/std
+    fold_metrics: list[dict[str, float]] = field(default_factory=list)
+
+
+def fold_ids(n: int, nfolds: int, scheme: str = "auto",
+             y: np.ndarray | None = None, seed: int = 0) -> np.ndarray:
+    """Per-row fold index in [0, nfolds) under an H2O assignment scheme."""
+    scheme = scheme.lower()
+    if scheme == "modulo":
+        return (np.arange(n) % nfolds).astype(np.int32)
+    rng = np.random.default_rng(seed if seed >= 0 else None)
+    if scheme in ("auto", "random"):
+        return rng.integers(0, nfolds, size=n).astype(np.int32)
+    if scheme == "stratified":
+        if y is None:
+            raise ValueError("stratified fold assignment needs a "
+                             "categorical response")
+        out = np.empty(n, dtype=np.int32)
+        start = 0
+        for cls_val in np.unique(y):
+            idx = np.flatnonzero(y == cls_val)
+            rng.shuffle(idx)
+            # round-robin within the class, rotating the starting fold
+            # across classes so small classes don't all land in fold 0
+            out[idx] = (np.arange(len(idx)) + start) % nfolds
+            start += len(idx)
+        return out
+    raise ValueError(f"unknown fold_assignment '{scheme}'")
+
+
+def _combined_metrics(model, y_true_codes, is_enum, preds,
+                      dist: str) -> dict[str, float]:
+    """Score concatenated holdout predictions (H2O's headline CV metric)."""
+    from .base import score_predictions
+
+    ok = (y_true_codes >= 0) if is_enum else ~np.isnan(y_true_codes)
+    return score_predictions(model.nclasses, dist, y_true_codes[ok],
+                             preds[ok])
+
+
+def cross_validate(est, y: str, frame: Frame, cv: CVArgs,
+                   train_kw: dict[str, Any], seed: int = 0) -> CVResult:
+    """Train one model per fold; returns holdout preds + metric summary.
+
+    ``est`` is the configured estimator; each fold trains a deep copy
+    with CV disabled (the reference likewise clones the builder per
+    fold, ModelBuilder.cv_makeFramesAndBuilders).
+    """
+    n = frame.nrows
+    yv = frame.vec(y)
+    if cv.fold_column is not None:
+        fv = frame.vec(cv.fold_column)
+        fc = fv.to_numpy()
+        has_na = (fc < 0).any() if fv.is_enum() else \
+            np.isnan(np.asarray(fc, dtype=np.float64)).any()
+        if has_na:
+            raise ValueError(f"fold_column '{cv.fold_column}' has NAs")
+        codes = np.unique(fc)
+        folds = np.searchsorted(codes, fc).astype(np.int32)
+        nfolds = len(codes)
+        if nfolds < 2:
+            raise ValueError("fold_column must define >= 2 folds")
+    else:
+        nfolds = cv.nfolds
+        if nfolds > n:
+            raise ValueError(f"nfolds={nfolds} > {n} rows")
+        scheme = cv.fold_assignment.lower()
+        if scheme == "auto":
+            scheme = "random"
+        if scheme == "stratified" and not yv.is_enum():
+            raise ValueError("stratified folds need a categorical response")
+        folds = fold_ids(n, nfolds, scheme,
+                         yv.to_numpy() if yv.is_enum() else None, seed)
+    counts = np.bincount(folds, minlength=nfolds)
+    if (counts == 0).any():
+        # the reference rejects degenerate fold maps up front
+        # (ModelBuilder.cv_init) rather than training on a full frame
+        raise ValueError(
+            f"fold assignment left fold(s) "
+            f"{np.flatnonzero(counts == 0).tolist()} empty "
+            f"(nfolds={nfolds}, nrows={n})")
+
+    tkw = dict(train_kw)
+    tkw.pop("validation_frame", None)
+    fold_col_ignore = [cv.fold_column] if cv.fold_column else []
+    if fold_col_ignore:
+        ignored = list(tkw.get("ignored_columns") or []) + fold_col_ignore
+        tkw["ignored_columns"] = ignored
+
+    models, fold_metrics = [], []
+    preds: np.ndarray | None = None
+    for k in range(nfolds):
+        hold = folds == k
+        clone = copy.deepcopy(est)
+        clone.cv_args = CVArgs()            # fold models never recurse
+        m = clone.train(y=y, training_frame=frame.select_rows(~hold),
+                        **tkw)
+        hold_fr = frame.select_rows(hold)
+        pk = m.predict_raw(hold_fr)
+        if preds is None:
+            preds = np.zeros((n,) + pk.shape[1:], dtype=pk.dtype)
+        preds[hold] = pk
+        fold_metrics.append(m.model_performance(hold_fr, y))
+        models.append(m)
+
+    keys = fold_metrics[0].keys()
+    summary = {key: {"mean": float(np.mean([fm[key] for fm in fold_metrics])),
+                     "std": float(np.std([fm[key] for fm in fold_metrics]))}
+               for key in keys}
+    y_codes = yv.to_numpy() if yv.is_enum() else \
+        np.asarray(yv.as_float())[:n]
+    combined = _combined_metrics(models[0], y_codes, yv.is_enum(), preds,
+                                 models[0].distribution)
+    return CVResult(
+        fold_ids=folds,
+        models=models if cv.keep_cross_validation_models else None,
+        holdout_predictions=(preds if
+                             cv.keep_cross_validation_predictions else None),
+        metrics=combined, metrics_summary=summary,
+        fold_metrics=fold_metrics)
+
+
+def finalize_train(est, model, y: str, training_frame: Frame,
+                   train_kw: dict[str, Any],
+                   validation_frame: Frame | None = None):
+    """Post-train hook every supervised estimator calls: validation
+    metrics + optional CV. Returns the (annotated) model."""
+    if validation_frame is not None:
+        model.validation_metrics = model.model_performance(
+            validation_frame, y)
+    cv = getattr(est, "cv_args", None)
+    if cv is not None and cv.enabled:
+        seed = int(getattr(est.params, "seed", 0) or 0)
+        model.cv = cross_validate(est, y, training_frame, cv, train_kw,
+                                  seed=seed)
+    else:
+        model.cv = None
+    return model

@@ -167,13 +167,23 @@ class DeepLearning:
     """H2ODeepLearningEstimator analog."""
 
     def __init__(self, **kw):
+        from .cv import CVArgs
+
+        self.cv_args = CVArgs.pop(kw)
         self.params = DeepLearningParams(**kw)
 
     def train(self, y: str | None = None, training_frame: Frame = None,
               x: Sequence[str] | None = None,
               ignored_columns: Sequence[str] | None = None,
-              weights_column: str | None = None) -> DeepLearningModel:
+              weights_column: str | None = None,
+              validation_frame: Frame | None = None) -> DeepLearningModel:
         p = self.params
+        if p.autoencoder and self.cv_args.enabled:
+            raise ValueError(
+                "cross-validation is not supported for autoencoders")
+        if self.cv_args.fold_column:
+            ignored_columns = list(ignored_columns or []) + \
+                [self.cv_args.fold_column]
         mesh = global_mesh()
         n_shards = n_row_shards(mesh)
 
@@ -274,4 +284,17 @@ class DeepLearning:
         model = DeepLearningModel(data, p, dinfo, net, loss_kind)
         if p.autoencoder:
             model.nclasses = 1
-        return model
+            model.cv = None
+            if validation_frame is not None:
+                # validation reconstruction error (H2O scores AEs the
+                # same way: MSE of reconstruction on the valid frame)
+                model.validation_metrics = model.model_performance(
+                    validation_frame)
+            return model
+        from .cv import finalize_train
+
+        return finalize_train(
+            self, model, y, training_frame,
+            {"x": x, "ignored_columns": ignored_columns,
+             "weights_column": weights_column},
+            validation_frame)

@@ -50,6 +50,8 @@ class DRF(GBM):
         # without materializing the design matrix twice
         ignored = set(kw.get("ignored_columns") or [])
         ignored.add(y)
+        if self.cv_args.fold_column:
+            ignored.add(self.cv_args.fold_column)
         if kw.get("weights_column"):
             ignored.add(kw["weights_column"])
         names = list(x) if x else [

@@ -177,15 +177,22 @@ class GBM:
     model_cls = GBMModel
 
     def __init__(self, **kw):
+        from .cv import CVArgs
+
+        self.cv_args = CVArgs.pop(kw)
         self.params = GBMParams(**kw)
 
     def train(self, y: str, training_frame: Frame,
               x: Sequence[str] | None = None,
               ignored_columns: Sequence[str] | None = None,
-              weights_column: str | None = None) -> GBMModel:
+              weights_column: str | None = None,
+              validation_frame: Frame | None = None) -> GBMModel:
         p = self.params
         if p.ntrees < 1:
             raise ValueError(f"ntrees must be >= 1, got {p.ntrees}")
+        if self.cv_args.fold_column:
+            ignored_columns = list(ignored_columns or []) + \
+                [self.cv_args.fold_column]
         data = resolve_xy(training_frame, y, x, ignored_columns,
                           weights_column, p.distribution)
         bin_spec = fit_bins(training_frame, data.feature_names,
@@ -288,7 +295,13 @@ class GBM:
             history.append({"ntrees": p.ntrees, **_margin_metrics(
                 data.distribution, margin, data.y, data.w)})
         model.scoring_history = history
-        return model
+        from .cv import finalize_train
+
+        return finalize_train(
+            self, model, y, training_frame,
+            {"x": x, "ignored_columns": ignored_columns,
+             "weights_column": weights_column},
+            validation_frame)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))

@@ -209,6 +209,9 @@ class GLM:
     """H2OGeneralizedLinearEstimator analog."""
 
     def __init__(self, **kw):
+        from .cv import CVArgs
+
+        self.cv_args = CVArgs.pop(kw)
         self.params = GLMParams(**kw)
 
     def _fit_beta(self, Xe, data, dinfo, lam, beta0, mesh):
@@ -248,8 +251,12 @@ class GLM:
     def train(self, y: str, training_frame: Frame,
               x: Sequence[str] | None = None,
               ignored_columns: Sequence[str] | None = None,
-              weights_column: str | None = None) -> GLMModel:
+              weights_column: str | None = None,
+              validation_frame: Frame | None = None) -> GLMModel:
         p = self.params
+        if self.cv_args.fold_column:
+            ignored_columns = list(ignored_columns or []) + \
+                [self.cv_args.fold_column]
         if p.family not in ("gaussian", "binomial", "poisson"):
             raise ValueError(f"unknown family '{p.family}' (supported: "
                              "gaussian, binomial, poisson)")
@@ -313,8 +320,15 @@ class GLM:
                 iters += its
             lam_used = float(lams[-1])
 
-        return GLMModel(data, p, dinfo, beta, lam_used, null_dev, dev,
-                        iters)
+        model = GLMModel(data, p, dinfo, beta, lam_used, null_dev, dev,
+                         iters)
+        from .cv import finalize_train
+
+        return finalize_train(
+            self, model, y, training_frame,
+            {"x": x, "ignored_columns": ignored_columns,
+             "weights_column": weights_column},
+            validation_frame)
 
     def _fit_lbfgs(self, Xe, data, dinfo, lam, beta0, mesh):
         import optax

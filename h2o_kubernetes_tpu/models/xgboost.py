@@ -219,6 +219,10 @@ class XGBoost(GBM):
         if self.params.distribution.startswith("rank:"):
             if group_column is None:
                 raise ValueError("ranking objectives need group_column")
+            if self.cv_args.enabled:
+                raise ValueError(
+                    "cross-validation with rank:* objectives needs "
+                    "group-aware folds; not supported yet")
             return self._train_rank(y, training_frame, x, group_column, **kw)
         ignored = list(kw.pop("ignored_columns", None) or [])
         if group_column:
@@ -230,7 +234,8 @@ class XGBoost(GBM):
 
     def _train_rank(self, y: str, frame: Frame, x, group_column: str,
                     ignored_columns: Sequence[str] | None = None,
-                    weights_column: str | None = None) -> XGBoostModel:
+                    weights_column: str | None = None,
+                    validation_frame: Frame | None = None) -> XGBoostModel:
         p = self.params
         ignored = list(ignored_columns or []) + [group_column]
         data = resolve_xy(frame, y, x, ignored, weights_column,
@@ -302,4 +307,10 @@ class XGBoost(GBM):
         history.append({"ntrees": p.ntrees,
                         "train_ndcg@10": M.ndcg(yt, sc, gids, k=10)})
         model.scoring_history = history
+        if validation_frame is not None:
+            vy = validation_frame.vec(y)
+            vscore = model.predict_raw(validation_frame)
+            vg = validation_frame.vec(group_column).to_numpy()
+            model.validation_metrics = {
+                "ndcg@10": M.ndcg(vy.to_numpy(), vscore, vg, k=10)}
         return model

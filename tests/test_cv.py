@@ -1,0 +1,91 @@
+"""Cross-validation infrastructure tests (reference: ModelBuilder CV,
+SURVEY.md §2b C16 — fold assignment, holdout predictions, CV metrics)."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import GBM, GLM
+from h2o_kubernetes_tpu.models.cv import fold_ids
+
+
+def _binary_frame(n=600, seed=3):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = np.where(x0 + 0.5 * x1 + rng.normal(scale=0.3, size=n) > 0,
+                 "yes", "no")
+    return h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
+
+
+class TestFoldIds:
+    def test_modulo(self):
+        f = fold_ids(10, 3, "modulo")
+        assert list(f[:6]) == [0, 1, 2, 0, 1, 2]
+
+    def test_random_covers_all_folds(self):
+        f = fold_ids(1000, 5, "random", seed=1)
+        assert set(f) == {0, 1, 2, 3, 4}
+
+    def test_stratified_balances_classes(self):
+        y = np.array([0] * 90 + [1] * 10)
+        f = fold_ids(100, 5, "stratified", y=y, seed=1)
+        # every fold gets exactly 2 of the rare class and 18 of the common
+        for k in range(5):
+            assert (y[f == k] == 1).sum() == 2
+            assert (y[f == k] == 0).sum() == 18
+
+
+class TestGBMCV:
+    def test_nfolds_attaches_cv(self, mesh8):
+        fr = _binary_frame()
+        m = GBM(ntrees=5, max_depth=3, nfolds=3, seed=7,
+                fold_assignment="modulo").train(y="y", training_frame=fr)
+        assert m.cv is not None
+        assert len(m.cross_validation_models()) == 3
+        preds = m.cross_validation_holdout_predictions()
+        assert preds.shape == (fr.nrows, 2)
+        # every row was predicted by exactly one holdout model
+        assert (preds.sum(axis=1) > 0.99).all()
+        cvm = m.cross_validation_metrics()
+        assert cvm["auc"] > 0.8
+        summ = m.cross_validation_metrics_summary()
+        assert set(summ) >= {"auc", "logloss"}
+        assert summ["auc"]["std"] >= 0.0
+
+    def test_fold_column(self, mesh8):
+        fr = _binary_frame()
+        folds = (np.arange(fr.nrows) % 4).astype(np.float32)
+        fr["fold"] = h2o.Vec.from_numpy(folds)
+        m = GBM(ntrees=4, max_depth=3, fold_column="fold", seed=1).train(
+            y="y", training_frame=fr)
+        assert len(m.cross_validation_models()) == 4
+        # fold column must not be used as a feature
+        assert "fold" not in m.feature_names
+
+    def test_validation_frame(self, mesh8):
+        fr = _binary_frame()
+        tr, va = fr.split_frame(ratios=[0.8], seed=5)
+        m = GBM(ntrees=5, max_depth=3, seed=1).train(
+            y="y", training_frame=tr, validation_frame=va)
+        assert m.validation_metrics is not None
+        assert m.validation_metrics["auc"] > 0.7
+
+
+class TestGLMCV:
+    def test_glm_cv_binomial(self, mesh8):
+        fr = _binary_frame()
+        m = GLM(family="binomial", nfolds=3, seed=2).train(
+            y="y", training_frame=fr)
+        assert len(m.cross_validation_models()) == 3
+        assert m.cross_validation_metrics()["auc"] > 0.8
+
+    def test_stratified_needs_enum(self, mesh8):
+        rng = np.random.default_rng(0)
+        fr = h2o.Frame.from_arrays({
+            "x0": rng.normal(size=100).astype(np.float32),
+            "y": rng.normal(size=100).astype(np.float32)})
+        with pytest.raises(ValueError, match="stratified"):
+            GLM(family="gaussian", nfolds=3,
+                fold_assignment="stratified").train(
+                y="y", training_frame=fr)

@@ -156,3 +156,28 @@ def test_rbind_cbind_asfactor(mesh8):
     back = v.asnumeric()
     out = back.to_numpy()
     assert out[0] == 2.0 and np.isnan(out[3])
+
+
+def test_duplicate_headers_uniquified(tmp_path, mesh8):
+    p = tmp_path / "dup.csv"
+    p.write_text("a,a,b\n1,2,x\n3,4,y\n")
+    fr = import_file(str(p))
+    assert fr.names == ["a", "a2", "b"]
+    assert fr["a"].to_numpy().tolist() == [1.0, 3.0]
+    assert fr["a2"].to_numpy().tolist() == [2.0, 4.0]
+
+
+def test_multifile_headerless_continuation(tmp_path, mesh8):
+    (tmp_path / "p1.csv").write_text("a,b\n1,2\n")
+    (tmp_path / "p2.csv").write_text("3,4\n5,6\n")
+    fr = import_file(str(tmp_path))
+    assert fr.nrows == 3
+    assert sorted(fr["a"].to_numpy().tolist()) == [1.0, 3.0, 5.0]
+
+
+def test_multifile_repeated_headers_dropped(tmp_path, mesh8):
+    (tmp_path / "p1.csv").write_text("a,b\n1,2\n")
+    (tmp_path / "p2.csv").write_text("a,b\n3,4\n")
+    fr = import_file(str(tmp_path))
+    assert fr.nrows == 2
+    assert sorted(fr["a"].to_numpy().tolist()) == [1.0, 3.0]
