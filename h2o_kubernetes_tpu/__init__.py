@@ -7,7 +7,12 @@ with stacked ensembles, and a C++ Kubernetes operator/CLI (native/).
 See SURVEY.md for the reference blueprint this is built against.
 """
 
+from .automl import AutoML, Job, Leaderboard, jobs
+from .diagnostics import device_memory, log, profile, timeline
 from .frame import Frame, Vec, import_file, parse_setup
+from .mojo import MojoModel, export_mojo, import_mojo
+from .persist import (export_file, load_frame, load_model, save_frame,
+                      save_model)
 from .runtime import (global_mesh, initialize_distributed, make_mesh,
                       set_global_mesh, use_mesh)
 

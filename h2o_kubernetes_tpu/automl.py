@@ -1,0 +1,399 @@
+"""AutoML — orchestrated model search + stacked ensembles + leaderboard.
+
+Reference: h2o-automl/ai/h2o/automl/AutoML.java + Leaderboard.java +
+modeling/*Steps (SURVEY.md §2b C16). The reference runs a fixed plan of
+per-algorithm default models, then random-search grids, all under n-fold
+CV with a shared fold assignment, then builds two stacked ensembles
+(BestOfFamily and AllModels) and ranks everything on a leaderboard.
+
+This build mirrors that plan:
+- every base model trains with the same modulo fold assignment (the
+  reference forces a shared fold map when stacking is enabled) and keeps
+  CV holdout predictions — the level-one data for the ensembles;
+- the model plan is defaults-first (GLM, DRF, XRT, 5 GBMs, 3 XGBoosts,
+  1 DL) then a random GBM/XGBoost/DL grid until max_models or
+  max_runtime_secs runs out;
+- the leaderboard ranks by CV metrics (or on leaderboard_frame when
+  given): auc desc for binomial, logloss asc for multinomial, rmse asc
+  for regression — H2O's sort_metric defaults.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+
+from .frame import Frame
+from .models import DRF, GBM, GLM, DeepLearning, StackedEnsemble, XGBoost
+
+# metrics where larger is better (everything else ranks ascending)
+_DESC = {"auc", "accuracy", "r2", "pr_auc", "ndcg@10"}
+
+
+JOBS: dict[str, "Job"] = {}       # /3/Jobs analog: every Job registers
+
+
+def jobs() -> list[dict[str, Any]]:
+    """List all jobs with status/progress (GET /3/Jobs analog)."""
+    return [{"dest": j.dest, "description": j.description,
+             "status": j.status, "progress": j.progress, "msg": j.msg}
+            for j in JOBS.values()]
+
+
+@dataclass
+class Job:
+    """Minimal water.Job analog: async-style progress surface."""
+
+    dest: str
+    description: str
+    status: str = "CREATED"        # CREATED | RUNNING | DONE | FAILED
+    progress: float = 0.0
+    msg: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+
+    def start(self):
+        self.status = "RUNNING"
+        self.start_time = time.time()
+        JOBS[self.dest] = self
+        from .diagnostics import timeline
+
+        timeline.record("job_start", self.description, dest=self.dest)
+        return self
+
+    def update(self, progress: float, msg: str = ""):
+        self.progress = float(progress)
+        if msg:
+            self.msg = msg
+
+    def done(self):
+        self.status = "DONE"
+        self.progress = 1.0
+        self.end_time = time.time()
+        from .diagnostics import timeline
+
+        timeline.record("job_done", self.description, dest=self.dest,
+                        seconds=self.end_time - self.start_time)
+
+    def failed(self, msg: str):
+        self.status = "FAILED"
+        self.msg = msg
+        self.end_time = time.time()
+
+
+class Leaderboard:
+    """Ranked table of (model_id, metrics) — Leaderboard.java analog."""
+
+    def __init__(self, sort_metric: str, ascending: bool):
+        self.sort_metric = sort_metric
+        self.ascending = ascending
+        self.rows: list[dict[str, Any]] = []
+        self.models: dict[str, Any] = {}
+
+    def add(self, model_id: str, model, metrics: dict[str, float]):
+        self.models[model_id] = model
+        self.rows.append({"model_id": model_id, **metrics})
+        self.rows.sort(key=lambda r: r.get(self.sort_metric, np.inf)
+                       if self.ascending
+                       else -r.get(self.sort_metric, -np.inf))
+
+    @property
+    def leader(self):
+        return self.models[self.rows[0]["model_id"]] if self.rows else None
+
+    def as_list(self) -> list[dict[str, Any]]:
+        return [dict(r) for r in self.rows]
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.rows)
+
+    def __repr__(self):
+        if not self.rows:
+            return "Leaderboard(empty)"
+        cols: list[str] = []
+        for r in self.rows:           # union of metric keys, stable order
+            cols += [c for c in r if c != "model_id" and c not in cols]
+        w = max(len(r["model_id"]) for r in self.rows)
+        lines = ["  ".join([f"{'model_id':<{w}}"] +
+                           [f"{c:>12}" for c in cols])]
+        for r in self.rows:
+            lines.append("  ".join(
+                [f"{r['model_id']:<{w}}"] +
+                [f"{r[c]:>12.5f}" if c in r else " " * 12 for c in cols]))
+        return "\n".join(lines)
+
+
+def _default_plan(seed: int) -> list[tuple[str, str, dict]]:
+    """(family, name, params) — the defaults-first slice of the
+    reference's modeling steps (DefaultStepsProvider order)."""
+    return [
+        ("glm", "GLM_1", {}),
+        ("drf", "DRF_1", {"ntrees": 50}),
+        # XRT: extremely-randomized variant (reference drf/XRT step) —
+        # approximated by no-bootstrap full-data trees with default
+        # per-node feature sampling (random split thresholds aren't
+        # expressible in the histogram core)
+        ("drf", "XRT_1", {"ntrees": 50, "sample_rate": 1.0,
+                          "min_rows": 5}),
+        # depths are capped at 10 vs the reference's 15-20: the dense-heap
+        # tree layout (models/tree/core.py) grows histograms as 2^depth,
+        # so depth>10 trades HBM for nothing on typical data
+        ("gbm", "GBM_1", {"ntrees": 50, "max_depth": 6, "min_rows": 1}),
+        ("gbm", "GBM_2", {"ntrees": 50, "max_depth": 7, "min_rows": 10}),
+        ("gbm", "GBM_3", {"ntrees": 50, "max_depth": 8, "min_rows": 10}),
+        ("gbm", "GBM_4", {"ntrees": 50, "max_depth": 9, "min_rows": 10}),
+        ("gbm", "GBM_5", {"ntrees": 50, "max_depth": 10, "min_rows": 100,
+                          "nbins": 64}),
+        ("xgboost", "XGBoost_1", {"ntrees": 50, "max_depth": 8,
+                                  "min_child_weight": 5}),
+        ("xgboost", "XGBoost_2", {"ntrees": 50, "max_depth": 10,
+                                  "min_child_weight": 10, "nbins": 64}),
+        ("xgboost", "XGBoost_3", {"ntrees": 50, "max_depth": 5,
+                                  "min_child_weight": 3}),
+        ("deeplearning", "DeepLearning_1", {"hidden": (64, 64),
+                                            "epochs": 10}),
+    ]
+
+
+def _random_grid(rng: np.random.Generator) -> tuple[str, dict]:
+    """One random-search draw (reference grids: gbm/xgboost/dl spaces)."""
+    fam = rng.choice(["gbm", "xgboost", "deeplearning"],
+                     p=[0.4, 0.4, 0.2])
+    if fam == "gbm":
+        return fam, {
+            "ntrees": int(rng.choice([30, 50, 80])),
+            "max_depth": int(rng.integers(3, 11)),
+            "learn_rate": float(rng.choice([0.05, 0.1, 0.2])),
+            "sample_rate": float(rng.choice([0.6, 0.8, 1.0])),
+            "col_sample_rate_per_tree": float(rng.choice([0.5, 0.8, 1.0])),
+            "min_rows": float(rng.choice([1, 5, 10, 30])),
+        }
+    if fam == "xgboost":
+        return fam, {
+            "ntrees": int(rng.choice([30, 50, 80])),
+            "max_depth": int(rng.integers(3, 11)),
+            "learn_rate": float(rng.choice([0.05, 0.1, 0.3])),
+            "reg_lambda": float(rng.choice([0.1, 1.0, 10.0])),
+            "min_child_weight": float(rng.choice([1, 5, 15])),
+            "subsample": float(rng.choice([0.6, 0.8, 1.0])),
+        }
+    return fam, {
+        "hidden": tuple(rng.choice([32, 64, 128],
+                                   size=int(rng.integers(1, 4)))),
+        "epochs": int(rng.choice([5, 10, 20])),
+        "input_dropout_ratio": float(rng.choice([0.0, 0.1, 0.2])),
+    }
+
+
+_EST = {"glm": GLM, "drf": DRF, "gbm": GBM, "xgboost": XGBoost,
+        "deeplearning": DeepLearning}
+
+
+class AutoML:
+    """H2OAutoML analog."""
+
+    def __init__(self, max_models: int = 12,
+                 max_runtime_secs: float | None = None,
+                 nfolds: int = 5, seed: int = 0,
+                 include_algos: Sequence[str] | None = None,
+                 exclude_algos: Sequence[str] | None = None,
+                 sort_metric: str = "auto",
+                 project_name: str = "automl",
+                 verbosity: str | None = "info"):
+        if include_algos and exclude_algos:
+            raise ValueError("include_algos and exclude_algos are "
+                             "mutually exclusive")
+        self.max_models = max_models
+        self.max_runtime_secs = max_runtime_secs
+        self.nfolds = nfolds
+        self.seed = seed
+        algos = {"glm", "drf", "gbm", "xgboost", "deeplearning",
+                 "stackedensemble"}
+        if include_algos:
+            algos = {a.lower() for a in include_algos}
+        if exclude_algos:
+            algos -= {a.lower() for a in exclude_algos}
+        self.algos = algos
+        self.sort_metric = sort_metric
+        self.project_name = project_name
+        self.verbosity = verbosity
+        self.leaderboard: Leaderboard | None = None
+        self.job: Job | None = None
+        self._models_by_family: dict[str, list] = {}
+
+    # -- internals ----------------------------------------------------------
+
+    def _log(self, msg: str):
+        if self.verbosity:
+            print(f"[AutoML {self.project_name}] {msg}")
+
+    def _resolve_sort(self, nclasses: int) -> tuple[str, bool]:
+        if self.sort_metric != "auto":
+            m = self.sort_metric.lower()
+            return m, m not in _DESC
+        if nclasses == 2:
+            return "auc", False
+        if nclasses > 2:
+            return "logloss", True
+        return "rmse", True
+
+    # -- main entry ---------------------------------------------------------
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              leaderboard_frame: Frame | None = None) -> "AutoML":
+        t0 = time.monotonic()
+        deadline = t0 + self.max_runtime_secs if self.max_runtime_secs \
+            else None
+        rng = np.random.default_rng(self.seed)
+        yv = training_frame.vec(y)
+        nclasses = yv.cardinality() if yv.is_enum() else 1
+        metric, asc = self._resolve_sort(nclasses)
+        self.leaderboard = Leaderboard(metric, asc)
+        self.job = Job(dest=self.project_name, description="AutoML").start()
+
+        plan = [(fam, name, prm) for fam, name, prm in
+                _default_plan(self.seed) if fam in self.algos]
+        n_done = 0
+        # H2O: max_models 0/None means unlimited — bounded by the time
+        # budget; with neither limit, run the default plan only
+        budget = self.max_models if self.max_models else None
+
+        def out_of_budget():
+            if budget is not None and n_done >= budget:
+                return True
+            return deadline is not None and time.monotonic() > deadline
+
+        def run_one(fam: str, name: str, params: dict) -> bool:
+            """Train one model; returns False when the step is skipped."""
+            if fam == "glm":
+                if nclasses > 2:      # GLM has no multinomial family yet
+                    self._log(f"{name} skipped: GLM has no multinomial "
+                              "family")
+                    return False
+                params = {**params,
+                          "family": "binomial" if nclasses == 2
+                          else "gaussian"}
+            est = _EST[fam](
+                **params, seed=self.seed,
+                nfolds=self.nfolds, fold_assignment="modulo",
+                keep_cross_validation_predictions=True)
+            model_id = f"{name}_AutoML_{self.project_name}"
+            t = time.monotonic()
+            model = est.train(y=y, training_frame=training_frame, x=x)
+            if leaderboard_frame is not None:
+                metrics = model.model_performance(leaderboard_frame, y)
+            elif model.cv is not None:
+                metrics = model.cv.metrics
+            else:   # nfolds < 2: rank on training metrics (H2O fallback)
+                metrics = model.model_performance(training_frame, y)
+            metrics = {**metrics,
+                       "training_time_s": time.monotonic() - t}
+            self.leaderboard.add(model_id, model, metrics)
+            self._models_by_family.setdefault(fam, []).append(
+                (model_id, model))
+            self._log(f"{model_id}: {metric}="
+                      f"{metrics.get(metric, float('nan')):.5f}")
+            return True
+
+        for fam, name, params in plan:
+            if out_of_budget():
+                break
+            try:
+                # a skipped step doesn't consume budget; a failed attempt
+                # does (so persistent failures can't loop forever)
+                if not run_one(fam, name, params):
+                    continue
+            except Exception as e:       # a failed step never kills the run
+                self._log(f"{name} failed: {e}")
+            n_done += 1
+            self.job.update(min(0.8, n_done / max(budget or 20, 1)))
+
+        grid_families = [f for f in ("gbm", "xgboost", "deeplearning")
+                         if f in self.algos]
+        if budget is None and deadline is None:
+            grid_families = []          # nothing bounds the grid search
+        grid_idx = 0
+        while grid_families and not out_of_budget():
+            fam, params = _random_grid(rng)
+            if fam not in grid_families:
+                continue
+            grid_idx += 1
+            try:
+                run_one(fam, f"{fam.upper()}_grid_{grid_idx}", params)
+            except Exception as e:
+                self._log(f"grid {fam} failed: {e}")
+            n_done += 1
+            self.job.update(min(0.9, n_done / max(budget or 20, 1)))
+
+        if "stackedensemble" in self.algos and \
+                leaderboard_frame is None and \
+                len(self.leaderboard.models) >= 2 and self.nfolds >= 2:
+            self._build_ensembles(y, training_frame, metric, asc)
+
+        self.job.done()
+        self._log(f"done in {time.monotonic() - t0:.1f}s — leader: "
+                  f"{self.leaderboard.rows[0]['model_id']}"
+                  if self.leaderboard.rows else "done (no models)")
+        return self
+
+    def _build_ensembles(self, y, frame, metric, asc):
+        """BestOfFamily + AllModels ensembles (reference StackedEnsembleStep).
+
+        Only base models sharing the leader's fold assignment stack; CV
+        metrics for the SEs themselves are skipped (the reference scores
+        SEs on CV too, at 2x cost — the leaderboard uses training CV
+        holdout scoring instead, flagged in the model_id)."""
+        id2fam = {}
+        for fam, lst in self._models_by_family.items():
+            for mid, _ in lst:
+                id2fam[mid] = fam
+
+        ranked = [(r["model_id"], self.leaderboard.models[r["model_id"]])
+                  for r in self.leaderboard.rows
+                  if r["model_id"] in id2fam]
+        usable = [(mid, m) for mid, m in ranked
+                  if m.cv is not None and m.cv.holdout_predictions is not None]
+        if len(usable) < 2:
+            return
+        best_of_family = {}
+        for mid, m in usable:
+            best_of_family.setdefault(id2fam[mid], (mid, m))
+
+        for tag, pool in (
+                ("BestOfFamily", list(best_of_family.values())),
+                ("AllModels", usable)):
+            if len(pool) < 2:
+                continue
+            try:
+                se = StackedEnsemble(
+                    [m for _, m in pool],
+                    metalearner_nfolds=self.nfolds).train(
+                    y=y, training_frame=frame)
+                # the metalearner CVs over the level-one (holdout) frame
+                # — its CV metrics are the ensemble's honest rank
+                metrics = se.cv.metrics if se.cv else \
+                    se.model_performance(frame, y)
+                self.leaderboard.add(
+                    f"StackedEnsemble_{tag}_AutoML_{self.project_name}",
+                    se, metrics)
+                self._log(f"StackedEnsemble_{tag}: "
+                          f"{metric}={metrics.get(metric, float('nan')):.5f}")
+            except Exception as e:
+                self._log(f"StackedEnsemble_{tag} failed: {e}")
+
+    # -- results ------------------------------------------------------------
+
+    @property
+    def leader(self):
+        return self.leaderboard.leader if self.leaderboard else None
+
+    def predict(self, frame: Frame):
+        if self.leader is None:
+            raise ValueError("AutoML has no trained models")
+        return self.leader.predict(frame)

@@ -1,0 +1,99 @@
+"""Diagnostics — timeline ring, leveled logging, profiler hooks.
+
+Reference (SURVEY.md §5.1, §5.5):
+- water/TimeLine.java: per-node in-memory ring of runtime events,
+  exposed at /3/Timeline — here a host-side ring buffer that training
+  drivers and the runtime append to;
+- water/util/Log: leveled per-node log — here a thin stdlib-logging
+  wrapper with the same level names;
+- WaterMeter CPU ticks / jProfile: device-side profiling — here
+  `profile()` wraps jax.profiler.trace (xprof/perfetto traces viewable
+  in TensorBoard), and `device_memory()` surfaces live HBM usage.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+__all__ = ["TimeLine", "timeline", "log", "profile", "device_memory"]
+
+
+@dataclass
+class _Event:
+    ts: float
+    kind: str
+    msg: str
+    data: dict[str, Any] = field(default_factory=dict)
+
+
+class TimeLine:
+    """Fixed-size event ring (water/TimeLine analog; thread-safe)."""
+
+    def __init__(self, capacity: int = 4096):
+        self._ring: collections.deque[_Event] = collections.deque(
+            maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, msg: str = "", **data) -> None:
+        with self._lock:
+            self._ring.append(_Event(time.time(), kind, msg, data))
+
+    def events(self, kind: str | None = None) -> list[dict[str, Any]]:
+        """Snapshot, oldest first (the /3/Timeline payload)."""
+        with self._lock:
+            evs = list(self._ring)
+        return [{"ts": e.ts, "kind": e.kind, "msg": e.msg, **e.data}
+                for e in evs if kind is None or e.kind == kind]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+timeline = TimeLine()
+
+log = logging.getLogger("h2o_kubernetes_tpu")
+if not log.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname).4s %(name)s: %(message)s"))
+    log.addHandler(_h)
+    log.setLevel(logging.WARNING)
+
+
+@contextlib.contextmanager
+def profile(logdir: str) -> Iterator[None]:
+    """Device profiler trace around a block (xprof; open in TensorBoard).
+
+    The analog of the reference's WaterMeter/jProfile endpoints — but
+    captured by XLA itself, so it shows real MXU/HBM activity.
+    """
+    import jax
+
+    timeline.record("profile_start", logdir=logdir)
+    with jax.profiler.trace(logdir):
+        yield
+    timeline.record("profile_stop", logdir=logdir)
+
+
+def device_memory() -> list[dict[str, Any]]:
+    """Live per-device memory stats (HBM analog of /3/Cloud free_mem)."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        out.append({"device": str(d),
+                    "bytes_in_use": stats.get("bytes_in_use"),
+                    "bytes_limit": stats.get("bytes_limit")})
+    return out

@@ -1,0 +1,36 @@
+"""h2o-py estimator-name compatibility layer.
+
+The reference's Python client exposes estimators under
+h2o.estimators.* with H2O-prefixed names (h2o-py/h2o/estimators/*,
+SURVEY.md §2b C19). A user migrating from h2o-py can keep their class
+names:
+
+    from h2o_kubernetes_tpu.estimators import H2OGradientBoostingEstimator
+    H2OGradientBoostingEstimator(ntrees=50).train(y=..., training_frame=...)
+"""
+
+from .automl import AutoML as H2OAutoML
+from .models import (DRF, GBM, GLM, PCA, DeepLearning, IsolationForest,
+                     KMeans, NaiveBayes, StackedEnsemble, Word2Vec,
+                     XGBoost)
+
+H2OGradientBoostingEstimator = GBM
+H2ORandomForestEstimator = DRF
+H2OGeneralizedLinearEstimator = GLM
+H2ODeepLearningEstimator = DeepLearning
+H2OXGBoostEstimator = XGBoost
+H2OWord2vecEstimator = Word2Vec
+H2OStackedEnsembleEstimator = StackedEnsemble
+H2OKMeansEstimator = KMeans
+H2OPrincipalComponentAnalysisEstimator = PCA
+H2ONaiveBayesEstimator = NaiveBayes
+H2OIsolationForestEstimator = IsolationForest
+
+__all__ = [
+    "H2OAutoML", "H2OGradientBoostingEstimator",
+    "H2ORandomForestEstimator", "H2OGeneralizedLinearEstimator",
+    "H2ODeepLearningEstimator", "H2OXGBoostEstimator",
+    "H2OWord2vecEstimator", "H2OStackedEnsembleEstimator",
+    "H2OKMeansEstimator", "H2OPrincipalComponentAnalysisEstimator",
+    "H2ONaiveBayesEstimator", "H2OIsolationForestEstimator",
+]

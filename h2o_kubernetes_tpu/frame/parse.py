@@ -254,8 +254,12 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
     seen: dict[str, int] = {}
     for i, n in enumerate(names):
         if n in seen:
-            seen[n] += 1
-            names[i] = f"{n}{seen[n]}"
+            while True:          # walk past real headers like a2
+                seen[n] += 1
+                cand = f"{n}{seen[n]}"
+                if cand not in names and cand not in seen:
+                    break
+            names[i] = cand
         seen.setdefault(names[i], 1)
     types = list(setup["types"])
     if col_types:

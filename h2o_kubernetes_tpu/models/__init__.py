@@ -2,9 +2,18 @@ from .drf import DRF, DRFModel
 from .gbm import GBM, GBMModel, GBMParams
 from .deeplearning import DeepLearning, DeepLearningModel
 from .glm import GLM, GLMModel, GLMParams
+from .isolationforest import IsolationForest, IsolationForestModel
+from .kmeans import KMeans, KMeansModel
+from .naivebayes import NaiveBayes, NaiveBayesModel
+from .pca import PCA, PCAModel
+from .stackedensemble import StackedEnsemble, StackedEnsembleModel
 from .word2vec import Word2Vec, Word2VecModel
 from .xgboost import XGBoost, XGBoostModel
 
 __all__ = ["DRF", "DRFModel", "DeepLearning", "DeepLearningModel",
            "GBM", "GBMModel", "GBMParams", "GLM", "GLMModel", "GLMParams",
+           "IsolationForest", "IsolationForestModel",
+           "KMeans", "KMeansModel", "NaiveBayes", "NaiveBayesModel",
+           "PCA", "PCAModel",
+           "StackedEnsemble", "StackedEnsembleModel",
            "Word2Vec", "Word2VecModel", "XGBoost", "XGBoostModel"]

@@ -36,6 +36,19 @@ class TrainData:
     feature_domains: dict[str, list[str]] = field(default_factory=dict)
 
 
+def _feature_names(frame: Frame, x: Sequence[str] | None,
+                   ignored: set[str]) -> list[str]:
+    """Resolve + validate feature columns (shared by resolve_xy/resolve_x)."""
+    names = list(x) if x else [n for n in frame.names if n not in ignored]
+    for n in names:
+        if n not in frame:
+            raise ValueError(f"feature column '{n}' not in frame")
+        if frame.vec(n).kind not in ("numeric", "enum", "time"):
+            raise ValueError(f"column '{n}' of kind {frame.vec(n).kind} "
+                             "cannot be a feature")
+    return names
+
+
 def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
                ignored: Sequence[str] | None = None,
                weights_column: str | None = None,
@@ -46,13 +59,7 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
     ignored.add(y)
     if weights_column:
         ignored.add(weights_column)
-    names = list(x) if x else [n for n in frame.names if n not in ignored]
-    for n in names:
-        if n not in frame:
-            raise ValueError(f"feature column '{n}' not in frame")
-        if frame.vec(n).kind not in ("numeric", "enum", "time"):
-            raise ValueError(f"column '{n}' of kind {frame.vec(n).kind} "
-                             "cannot be a feature")
+    names = _feature_names(frame, x, ignored)
     yv = frame.vec(y)
     nclasses, domain = 1, None
     if yv.is_enum():
@@ -84,6 +91,24 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
              if frame.vec(n).is_enum()}
     return TrainData(names, X, y_arr, w, frame.nrows, nclasses, domain,
                      distribution, fdoms)
+
+
+def resolve_x(frame: Frame, x: Sequence[str] | None = None,
+              ignored: Sequence[str] | None = None) -> TrainData:
+    """Unsupervised variant of resolve_xy: features only, y is a dummy.
+
+    Returned TrainData has y=0, nclasses=1 — usable with build_datainfo
+    for one-hot expansion/standardization (KMeans/PCA do the same via
+    DataInfo in the reference, hex/kmeans & hex/pca)."""
+    ignored = set(ignored or [])
+    names = _feature_names(frame, x, ignored)
+    X = frame.to_matrix(names)
+    w = frame.valid_mask()
+    fdoms = {n: list(frame.vec(n).domain) for n in names
+             if frame.vec(n).is_enum()}
+    zeros = jnp.zeros(X.shape[0], dtype=jnp.float32)
+    return TrainData(names, X, zeros, w, frame.nrows, 1, None,
+                     "gaussian", fdoms)
 
 
 class Model:

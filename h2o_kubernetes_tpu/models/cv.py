@@ -169,7 +169,13 @@ def cross_validate(est, y: str, frame: Frame, cv: CVArgs,
         if preds is None:
             preds = np.zeros((n,) + pk.shape[1:], dtype=pk.dtype)
         preds[hold] = pk
-        fold_metrics.append(m.model_performance(hold_fr, y))
+        # fold metrics straight from pk — a model_performance() call
+        # would rebuild the design matrix and re-score the holdout
+        yh = hold_fr.vec(y)
+        fold_metrics.append(_combined_metrics(
+            m, yh.to_numpy() if yh.is_enum() else
+            np.asarray(yh.as_float())[: hold_fr.nrows],
+            yh.is_enum(), pk, m.distribution))
         models.append(m)
 
     keys = fold_metrics[0].keys()

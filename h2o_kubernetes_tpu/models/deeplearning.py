@@ -53,6 +53,9 @@ class DeepLearningParams:
     standardize: bool = True
     seed: int = 0
     distribution: str = "auto"
+    # continue training from a previous model (reference DeepLearning
+    # checkpoint semantics, SURVEY.md §5.4): runs `epochs` MORE epochs
+    checkpoint: object = None
 
 
 def _act(name):
@@ -201,8 +204,23 @@ class DeepLearning:
             data = resolve_xy(training_frame, y, x, ignored_columns,
                               weights_column, p.distribution)
 
-        dinfo = build_datainfo(data, training_frame, p.standardize,
-                               drop_first=False)
+        if p.checkpoint is not None:
+            ck = p.checkpoint
+            if self.cv_args.enabled:
+                raise ValueError(
+                    "checkpoint cannot be combined with cross-validation")
+            if ck.feature_names != data.feature_names or \
+                    ck.feature_domains != data.feature_domains:
+                raise ValueError(
+                    "checkpoint model was trained on different features/"
+                    "domains")
+            # reuse the checkpoint's standardization stats: recomputing
+            # them on the continuation frame would silently rescale every
+            # input the restored weights were fit to
+            dinfo = ck.dinfo
+        else:
+            dinfo = build_datainfo(data, training_frame, p.standardize,
+                                   drop_first=False)
         Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]   # bias is in layers
         Pn = Xe.shape[1]
         K = data.nclasses
@@ -216,7 +234,17 @@ class DeepLearning:
         sizes = (Pn,) + tuple(p.hidden) + (out_dim,)
         key = jax.random.key(p.seed)
         key, kinit = jax.random.split(key)
-        net = _init_params(kinit, sizes)
+        if p.checkpoint is not None:
+            ck = p.checkpoint
+            got = tuple(l["w"].shape[0] for l in ck.net) + \
+                (ck.net[-1]["w"].shape[1],)
+            if got != sizes:
+                raise ValueError(f"checkpoint layer sizes {got} != {sizes}")
+            # deep copy: train_iter donates its buffers, and an aliased
+            # checkpoint net would be deleted out from under ck
+            net = jax.tree.map(lambda a: jnp.array(a, copy=True), ck.net)
+        else:
+            net = _init_params(kinit, sizes)
 
         rows_per_shard = Xe.shape[0] // n_shards
         batch = min(p.mini_batch_size, rows_per_shard)

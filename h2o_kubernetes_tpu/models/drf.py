@@ -35,7 +35,7 @@ class DRF(GBM):
 
     def __init__(self, ntrees: int = 50, max_depth: int = 12,
                  nbins: int = 64, sample_rate: float = 0.632,
-                 mtries: int = -2, min_rows: float = 1.0, **kw):
+                 mtries: int = -1, min_rows: float = 1.0, **kw):
         kw.setdefault("min_split_improvement", 1e-5)
         super().__init__(ntrees=ntrees, max_depth=max_depth, nbins=nbins,
                          sample_rate=sample_rate, min_rows=min_rows, **kw)
@@ -60,9 +60,16 @@ class DRF(GBM):
             training_frame.vec(n).kind in ("numeric", "enum", "time")]
         F = len(names)
         classification = training_frame.vec(y).is_enum()
-        if self._mtries_arg == -2:
+        # H2O semantics: -1 → sqrt(F) classification / F/3 regression
+        # (the default), -2 → all features, >0 → that many
+        if self._mtries_arg == -1:
             m = int(np.sqrt(F)) if classification else max(F // 3, 1)
             self.params.mtries = max(m, 1)
+        elif self._mtries_arg == -2:
+            self.params.mtries = -1          # TreeParams: <=0 disables
         elif self._mtries_arg > 0:
             self.params.mtries = self._mtries_arg
+        else:
+            raise ValueError(f"mtries must be -1, -2 or > 0, "
+                             f"got {self._mtries_arg}")
         return super().train(y=y, training_frame=training_frame, x=x, **kw)

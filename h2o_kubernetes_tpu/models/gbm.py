@@ -48,6 +48,9 @@ class GBMParams:
     min_split_improvement: float = 1e-5  # H2O default
     seed: int = 0
     score_every: int = 0                 # 0 = score only at end
+    # continue training from a previous model (reference SharedTree
+    # checkpoint semantics, SURVEY.md §5.4): ntrees is the TOTAL count
+    checkpoint: object = None
     # DRF mode: no shrinkage on margins, trees vote/average
     _drf_mode: bool = False
 
@@ -195,8 +198,35 @@ class GBM:
                 [self.cv_args.fold_column]
         data = resolve_xy(training_frame, y, x, ignored_columns,
                           weights_column, p.distribution)
-        bin_spec = fit_bins(training_frame, data.feature_names,
-                            n_bins=p.nbins, seed=p.seed)
+        ckpt = p.checkpoint
+        if ckpt is not None:
+            if self.cv_args.enabled:
+                # H2O forbids checkpoint+CV: fold models would inherit
+                # trees that already saw their holdout rows
+                raise ValueError(
+                    "checkpoint cannot be combined with cross-validation")
+            if ckpt.feature_names != data.feature_names:
+                raise ValueError(
+                    "checkpoint model was trained on different features "
+                    f"({ckpt.feature_names} vs {data.feature_names})")
+            if ckpt.distribution != data.distribution:
+                raise ValueError("checkpoint distribution mismatch")
+            if ckpt.nclasses != data.nclasses or \
+                    (ckpt.response_domain or []) != \
+                    (data.response_domain or []):
+                raise ValueError(
+                    "checkpoint response mismatch: "
+                    f"{ckpt.nclasses} classes {ckpt.response_domain} vs "
+                    f"{data.nclasses} classes {data.response_domain}")
+            K0 = ckpt.nclasses if ckpt.nclasses > 2 else 1
+            if p.ntrees * K0 <= len(ckpt.trees.value):
+                raise ValueError(
+                    f"ntrees={p.ntrees} must exceed the checkpoint's "
+                    f"{len(ckpt.trees.value) // K0} trees")
+            bin_spec = ckpt.bin_spec     # same binning → trees compose
+        else:
+            bin_spec = fit_bins(training_frame, data.feature_names,
+                                n_bins=p.nbins, seed=p.seed)
         edges = jnp.asarray(bin_spec.edges_matrix())
         enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
         binned = jax.jit(apply_bins, static_argnums=3)(
@@ -212,7 +242,26 @@ class GBM:
         F = len(data.feature_names)
 
         w_sum = float(jnp.sum(data.w))
-        if p._drf_mode:
+        if ckpt is not None:
+            if ckpt.params.nbins != p.nbins or \
+                    ckpt.params.max_depth != p.max_depth:
+                raise ValueError(
+                    "checkpoint nbins/max_depth must match "
+                    f"({ckpt.params.nbins}/{ckpt.params.max_depth} vs "
+                    f"{p.nbins}/{p.max_depth})")
+            init = ckpt.init_score
+            if p._drf_mode:
+                margin = jnp.zeros((data.y.shape[0], K)) if K > 1 \
+                    else jnp.zeros_like(data.y)
+            elif K == 1:
+                margin = init + _stack_predict(ckpt.trees, binned,
+                                               p.max_depth, p.nbins)
+            else:
+                outs = [init[k] + _stack_predict(
+                    jax.tree.map(lambda a: a[k::K], ckpt.trees),
+                    binned, p.max_depth, p.nbins) for k in range(K)]
+                margin = jnp.stack(outs, axis=1)
+        elif p._drf_mode:
             # DRF: no boosting — leaves are in-leaf target means, init 0
             init = np.zeros(K, dtype=np.float32) if K > 1 else 0.0
             margin = jnp.zeros((data.y.shape[0], K)) if K > 1 \
@@ -238,9 +287,14 @@ class GBM:
             margin = jnp.full_like(data.y, init)
 
         trees: list[Tree] = []
+        start_t = 0
+        if ckpt is not None:
+            T0 = len(ckpt.trees.value)
+            trees = [jax.tree.map(lambda a: a[i], ckpt.trees)
+                     for i in range(T0)]
+            start_t = T0 // K
         history: list[dict] = []
-        varimp = np.zeros(F, dtype=np.float64)
-        for t in range(p.ntrees):
+        for t in range(start_t, p.ntrees):
             key, kt = jax.random.split(key)
             kt, w_t, col_mask = _tree_sampling(p, kt, data.w, F)
             lr = 1.0 if p._drf_mode else p.learn_rate
@@ -258,7 +312,6 @@ class GBM:
                                         tp.n_bins)
                     margin = margin + leaf
                 trees.append(tree)
-                varimp += _gain_by_feat(tree, F)
             else:
                 # multinomial: K trees per iteration on softmax gradients
                 probs = None if p._drf_mode else jax.nn.softmax(margin, 1)
@@ -278,15 +331,14 @@ class GBM:
                                             tp.n_bins)
                         margin = margin.at[:, k].add(leaf)
                     trees.append(tree)
-                    varimp += _gain_by_feat(tree, F)
             if p.score_every and (t + 1) % p.score_every == 0 \
                     and not p._drf_mode:
                 history.append({"ntrees": t + 1, **_margin_metrics(
                     data.distribution, margin, data.y, data.w)})
 
         model = self.model_cls(data, p, bin_spec, trees,
-                               init_score=init,
-                               varimp=dict(zip(data.feature_names, varimp)))
+                               init_score=init, varimp=None)
+        model._varimp = _stacked_varimp(model.trees, data.feature_names)
         if p._drf_mode:
             perf = model.model_performance(training_frame, y)
             history.append({"ntrees": p.ntrees,
@@ -316,3 +368,12 @@ def _gain_by_feat(tree: Tree, F: int) -> np.ndarray:
     sel = feat >= 0
     np.add.at(out, feat[sel], gain[sel])
     return out
+
+
+def _stacked_varimp(trees: Tree, names: list[str]) -> dict[str, float]:
+    """Varimp from a stacked [T, N] Tree pytree in ONE host transfer —
+    a per-tree np.asarray would force a device sync every boosting
+    iteration, which dominates wall-clock when the chip sits behind a
+    network tunnel. tree.map keeps field association by name."""
+    flat = jax.tree.map(jnp.ravel, trees)
+    return dict(zip(names, _gain_by_feat(flat, len(names))))

@@ -1,0 +1,224 @@
+"""IsolationForest — random isolation trees on the dense-heap layout.
+
+Reference: hex/tree/isofor/IsolationForest.java (SURVEY.md §2b C17):
+each tree trains on a row subsample (sample_size, default 256); at each
+node a RANDOM feature and a RANDOM split value within the node's
+[min, max] of that feature are chosen (no histograms, no gain); a row's
+anomaly score derives from its mean path length over the forest,
+normalized as 2^(-E[h]/c(n)) (Liu et al.'s standard isolation score).
+
+TPU design mirrors models/tree/core.py: dense per-row relative node
+ids, per-level `segment_min`/`segment_max` for node feature ranges
+(psum-free — `lax.pmin/pmax` across row shards), random choices drawn
+from a replicated key so every shard agrees, trees padded to max_depth
+so nothing recompiles as the forest grows.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..frame import Frame
+from ..runtime.mesh import ROWS, global_mesh
+from .base import Model, resolve_x
+
+
+@dataclass(frozen=True)      # hashable: passed as a static jit argument
+class IsolationForestParams:
+    ntrees: int = 50
+    sample_size: int = 256
+    max_depth: int = 8              # reference default: ceil(log2(256))
+    seed: int = 0
+
+
+class IsoTree(NamedTuple):
+    split_feat: jax.Array   # int32 [N]
+    split_val: jax.Array    # f32   [N] raw-value threshold (go left if <)
+    is_split: jax.Array     # bool  [N]
+    count: jax.Array        # f32   [N] training rows that reached the node
+
+
+def _avg_path(n):
+    """c(n): average BST unsuccessful-search path length (Liu et al.)."""
+    n = jnp.maximum(n, 2.0)
+    H = jnp.log(n - 1.0) + 0.5772156649
+    return 2.0 * H - 2.0 * (n - 1.0) / n
+
+
+def _seg_stat(vals, seg, n_seg, combine):
+    """Per-(node,feature) reduce of row values: [r,F] -> [n_seg,F]."""
+    fn = {"min": jax.ops.segment_min, "max": jax.ops.segment_max}[combine]
+    return jax.vmap(lambda col: fn(col, seg, num_segments=n_seg),
+                    in_axes=1, out_axes=1)(vals)
+
+
+def _grow_iso_shard(X, live0, key, p: IsolationForestParams):
+    F = X.shape[1]
+    N = 2 ** (p.max_depth + 1) - 1
+    split_feat = jnp.full(N, -1, dtype=jnp.int32)
+    split_val = jnp.zeros(N, dtype=jnp.float32)
+    is_split = jnp.zeros(N, dtype=bool)
+    count = jnp.zeros(N, dtype=jnp.float32)
+
+    Xf = jnp.nan_to_num(X)                    # NAs take value 0 (go left-ish)
+    rel = jnp.where(live0, 0, -1)
+
+    for d in range(p.max_depth + 1):
+        n_nodes = 2 ** d
+        off = n_nodes - 1
+        seg = jnp.where(rel >= 0, rel, n_nodes)
+        big = jnp.float32(3.4e38)
+        vmin = _seg_stat(jnp.where((rel >= 0)[:, None], Xf, big), seg,
+                         n_nodes + 1, "min")[:n_nodes]
+        vmax = _seg_stat(jnp.where((rel >= 0)[:, None], Xf, -big), seg,
+                         n_nodes + 1, "max")[:n_nodes]
+        vmin = lax.pmin(vmin, ROWS)
+        vmax = lax.pmax(vmax, ROWS)
+        cnt = lax.psum(jax.ops.segment_sum(
+            (rel >= 0).astype(jnp.float32), seg,
+            num_segments=n_nodes + 1)[:n_nodes], ROWS)
+
+        kf, kv = jax.random.split(jax.random.fold_in(key, d))
+        # random feature among those with spread; if none, node is a leaf
+        spread_ok = vmax > vmin                       # [n, F]
+        r = jax.random.uniform(kf, (n_nodes, F))
+        r = jnp.where(spread_ok, r, -1.0)
+        feat = jnp.argmax(r, axis=1).astype(jnp.int32)
+        any_ok = jnp.any(spread_ok, axis=1)
+        u = jax.random.uniform(kv, (n_nodes,))
+        fmin = jnp.take_along_axis(vmin, feat[:, None], 1)[:, 0]
+        fmax = jnp.take_along_axis(vmax, feat[:, None], 1)[:, 0]
+        val = fmin + u * (fmax - fmin)
+        can = any_ok & (cnt > 1.0)
+        if d == p.max_depth:
+            can = jnp.zeros_like(can)
+
+        idx = off + jnp.arange(n_nodes)
+        split_feat = split_feat.at[idx].set(jnp.where(can, feat, -1))
+        split_val = split_val.at[idx].set(val)
+        is_split = is_split.at[idx].set(can)
+        count = count.at[idx].set(cnt)
+        if d == p.max_depth:
+            break
+
+        live = rel >= 0
+        safe = jnp.where(live, rel, 0)
+        rowval = jnp.take_along_axis(
+            Xf, feat[safe][:, None], axis=1)[:, 0]
+        go_right = rowval >= val[safe]
+        child = 2 * rel + go_right.astype(jnp.int32)
+        rel = jnp.where(live & can[safe], child, -1)
+
+    return IsoTree(split_feat, split_val, is_split, count)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _grow_iso_jit(X, live, p: IsolationForestParams, mesh, key):
+    fn = jax.shard_map(
+        functools.partial(_grow_iso_shard, p=p),
+        mesh=mesh, in_specs=(P(ROWS), P(ROWS), P()), out_specs=P())
+    return fn(X, live, key)
+
+
+def _path_length(tree: IsoTree, X, max_depth: int):
+    """Per-row path length h(x) incl. c(leaf_count) adjustment."""
+    Xf = jnp.nan_to_num(X)
+    node = jnp.zeros(X.shape[0], dtype=jnp.int32)
+    depth = jnp.zeros(X.shape[0], dtype=jnp.float32)
+    for _ in range(max_depth):
+        f = tree.split_feat[node]
+        v = tree.split_val[node]
+        sp = tree.is_split[node]
+        rowval = jnp.take_along_axis(
+            Xf, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        child = 2 * node + 1 + (rowval >= v).astype(jnp.int32)
+        node = jnp.where(sp, child, node)
+        depth = depth + sp.astype(jnp.float32)
+    leaf_n = tree.count[node]
+    return depth + jnp.where(leaf_n > 1.0, _avg_path(leaf_n), 0.0)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _forest_path(trees: IsoTree, X, max_depth: int):
+    def body(acc, tree):
+        return acc + _path_length(tree, X, max_depth), None
+
+    init = jnp.zeros(X.shape[0], dtype=jnp.float32)
+    total, _ = lax.scan(body, init, trees)
+    return total
+
+
+class IsolationForestModel(Model):
+    algo = "isolationforest"
+
+    def __init__(self, data, params, trees: list[IsoTree],
+                 sample_size_effective: int):
+        super().__init__(data)
+        self.params = params
+        self.trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+        self.ntrees = len(trees)
+        self.nclasses = 1
+        # normalizer uses the ACTUAL per-tree sample (clamped to valid
+        # rows), not the requested one, or small frames inflate scores
+        self.sample_size_effective = sample_size_effective
+
+    def _score_matrix(self, X):
+        mean_len = _forest_path(self.trees, X,
+                                self.params.max_depth) / self.ntrees
+        c = _avg_path(jnp.float32(self.sample_size_effective))
+        score = jnp.exp2(-mean_len / c)
+        return jnp.stack([score, mean_len], axis=1)
+
+    def predict(self, frame: Frame) -> Frame:
+        out = self.predict_raw(frame)
+        return Frame.from_arrays({"predict": out[:, 0],
+                                  "mean_length": out[:, 1]})
+
+    def model_performance(self, frame=None, y=None) -> dict:
+        return {"ntrees": self.ntrees}
+
+
+class IsolationForest:
+    """H2OIsolationForestEstimator analog."""
+
+    def __init__(self, **kw):
+        from .cv import CVArgs
+
+        CVArgs.pop(kw)
+        self.params = IsolationForestParams(**kw)
+
+    def train(self, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              y: str | None = None) -> IsolationForestModel:
+        p = self.params
+        ignored = list(ignored_columns or [])
+        if y is not None:
+            ignored.append(y)
+        data = resolve_x(training_frame, x, ignored)
+        mesh = global_mesh()
+        key = jax.random.key(p.seed)
+        n = data.X.shape[0]
+        rows_valid = np.asarray(data.w) > 0
+        rng = np.random.default_rng(p.seed)
+        trees = []
+        sample = min(p.sample_size, int(rows_valid.sum()))
+        valid_idx = np.flatnonzero(rows_valid)
+        for t in range(p.ntrees):
+            key, kt = jax.random.split(key)
+            pick = rng.choice(valid_idx, size=sample, replace=False)
+            live = np.zeros(n, dtype=bool)
+            live[pick] = True
+            trees.append(_grow_iso_jit(data.X, jnp.asarray(live), p,
+                                       mesh, kt))
+        model = IsolationForestModel(data, p, trees, sample)
+        model.cv = None
+        return model

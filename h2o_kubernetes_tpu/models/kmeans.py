@@ -1,0 +1,204 @@
+"""KMeans — Lloyd's algorithm as sharded matmuls + ICI psum.
+
+Reference: hex/kmeans/KMeans.java (SURVEY.md §2b C17): k-means++
+("PlusPlus") init, then Lloyd iterations where one MRTask per iteration
+assigns every row to its closest center and accumulates per-cluster
+sums/counts, reduced across the node ring; the driver recomputes
+centers and checks movement.
+
+TPU design: the whole Lloyd loop runs in ONE jitted shard_map —
+distances via a single [r,F]x[F,k] matmul (MXU), per-cluster sums via a
+one-hot [k,r]x[r,F] matmul (MXU again, no scatter), `lax.psum` for the
+cross-shard reduce, `lax.while_loop` for convergence — no per-iteration
+host round trip (the reference pays one MRTask latency per iteration).
+Categoricals are one-hot expanded by DataInfo exactly as the reference
+expands them for KMeans.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..frame import Frame
+from ..runtime.mesh import ROWS, global_mesh
+from .base import Model, resolve_x
+from .datainfo import build_datainfo
+
+
+@dataclass
+class KMeansParams:
+    k: int = 8
+    max_iterations: int = 10
+    init: str = "PlusPlus"            # PlusPlus | Random | Furthest
+    standardize: bool = True
+    seed: int = 0
+    estimate_k: bool = False          # reserved (reference feature)
+
+
+def _pairwise_sqdist(X, C):
+    """[r,F],[k,F] -> [r,k] squared distances via matmul (MXU path)."""
+    x2 = jnp.sum(X * X, axis=1, keepdims=True)
+    c2 = jnp.sum(C * C, axis=1)[None, :]
+    return x2 - 2.0 * (X @ C.T) + c2
+
+
+def _lloyd_shard(Xe, w, C0, max_iter: int, tol: float):
+    """Runs under shard_map; returns (C, assignments, withinss)."""
+    k = C0.shape[0]
+
+    def assign_stats(C):
+        d = _pairwise_sqdist(Xe, C)                       # [r,k]
+        a = jnp.argmin(d, axis=1)
+        onehot = (a[:, None] == jnp.arange(k)[None, :])
+        onehot = onehot.astype(jnp.float32) * w[:, None]  # [r,k]
+        sums = lax.psum(onehot.T @ Xe, ROWS)              # [k,F] MXU
+        cnts = lax.psum(jnp.sum(onehot, axis=0), ROWS)    # [k]
+        wss = lax.psum(
+            jnp.sum(jnp.min(d, axis=1) * w), ROWS)
+        return a, sums, cnts, wss
+
+    def cond(carry):
+        it, C, move, _ = carry
+        return (it < max_iter) & (move > tol)
+
+    def body(carry):
+        it, C, _, _ = carry
+        _, sums, cnts, wss = assign_stats(C)
+        newC = jnp.where(cnts[:, None] > 0,
+                         sums / jnp.maximum(cnts[:, None], 1.0), C)
+        move = jnp.max(jnp.sum((newC - C) ** 2, axis=1))
+        return it + 1, newC, move, wss
+
+    it, C, _, _ = lax.while_loop(cond, body,
+                                 (0, C0, jnp.inf, jnp.float32(0)))
+    a, _, cnts, wss = assign_stats(C)
+    return C, a, cnts, wss, it
+
+
+@functools.partial(jax.jit, static_argnums=(3, 5))
+def _lloyd_jit(Xe, w, C0, max_iter, tol, mesh):
+    fn = jax.shard_map(
+        functools.partial(_lloyd_shard, max_iter=max_iter, tol=tol),
+        mesh=mesh,
+        in_specs=(P(ROWS), P(ROWS), P()),
+        out_specs=(P(), P(ROWS), P(), P(), P()))
+    return fn(Xe, w, C0)
+
+
+def _plusplus_init(Xe_np, w_np, k, rng):
+    """k-means++ seeding on the host over the (valid-row) matrix."""
+    valid = np.flatnonzero(w_np > 0)
+    X = Xe_np[valid]
+    n = X.shape[0]
+    centers = [X[rng.integers(n)]]
+    d2 = np.full(n, np.inf, dtype=np.float64)
+    for _ in range(1, k):
+        c = centers[-1]
+        d2 = np.minimum(d2, ((X - c) ** 2).sum(axis=1))
+        tot = d2.sum()
+        probs = d2 / tot if tot > 0 else np.full(n, 1.0 / n)
+        centers.append(X[rng.choice(n, p=probs)])
+    return np.stack(centers).astype(np.float32)
+
+
+class KMeansModel(Model):
+    algo = "kmeans"
+
+    def __init__(self, data, params, dinfo, centers, counts,
+                 withinss, iterations):
+        super().__init__(data)
+        self.params = params
+        self.dinfo = dinfo
+        self.centers_std = centers           # in standardized space
+        self.size = counts
+        self.tot_withinss = withinss
+        self.iterations = iterations
+        self.nclasses = 1
+
+    def centers(self) -> np.ndarray:
+        """Cluster centers in the ORIGINAL feature space (numeric part
+        de-standardized; one-hot coordinates stay as level frequencies,
+        as in the reference's standardized-centers output)."""
+        C = np.asarray(self.centers_std, dtype=np.float64).copy()
+        nn = len(self.dinfo.numeric_idx)
+        C[:, :nn] = C[:, :nn] * self.dinfo.stds[None, :] + \
+            self.dinfo.means[None, :]
+        return C
+
+    def _score_matrix(self, X):
+        Xe = self.dinfo.expand(X)[:, :-1]
+        d = _pairwise_sqdist(Xe, self.centers_std)
+        return jnp.argmin(d, axis=1).astype(jnp.float32)
+
+    def predict(self, frame: Frame) -> Frame:
+        out = self.predict_raw(frame).astype(np.int32)
+        return Frame.from_arrays({"predict": out})
+
+    def model_performance(self, frame=None, y=None) -> dict:
+        return {"tot_withinss": float(self.tot_withinss),
+                "iterations": int(self.iterations)}
+
+
+class KMeans:
+    """H2OKMeansEstimator analog."""
+
+    def __init__(self, **kw):
+        from .cv import CVArgs
+
+        CVArgs.pop(kw)                 # accepted, unused (no CV for kmeans)
+        self.params = KMeansParams(**kw)
+
+    def train(self, training_frame: Frame, x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              y: str | None = None) -> KMeansModel:
+        p = self.params
+        if p.k < 1:
+            raise ValueError(f"k must be >= 1, got {p.k}")
+        ignored = list(ignored_columns or [])
+        if y is not None:
+            ignored.append(y)
+        data = resolve_x(training_frame, x, ignored)
+        dinfo = build_datainfo(data, training_frame, p.standardize,
+                               drop_first=False)
+        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]   # no intercept col
+        rng = np.random.default_rng(p.seed)
+
+        Xe_np = np.asarray(Xe)
+        w_np = np.asarray(data.w)
+        if p.init.lower() in ("plusplus", "kmeans++", "auto"):
+            C0 = _plusplus_init(Xe_np, w_np, p.k, rng)
+        elif p.init.lower() == "random":
+            valid = np.flatnonzero(w_np > 0)
+            C0 = Xe_np[rng.choice(valid, size=p.k, replace=False)]
+        elif p.init.lower() == "furthest":
+            C0 = _furthest_init(Xe_np, w_np, p.k, rng)
+        else:
+            raise ValueError(f"unknown init '{p.init}'")
+
+        mesh = global_mesh()
+        C, a, cnts, wss, iters = _lloyd_jit(
+            Xe, data.w, jnp.asarray(C0), p.max_iterations,
+            jnp.float32(1e-6), mesh)
+        model = KMeansModel(data, p, dinfo, C, np.asarray(cnts),
+                            float(wss), int(iters))
+        model.cv = None
+        return model
+
+
+def _furthest_init(Xe_np, w_np, k, rng):
+    valid = np.flatnonzero(w_np > 0)
+    X = Xe_np[valid]
+    centers = [X[rng.integers(X.shape[0])]]
+    d2 = np.full(X.shape[0], np.inf)
+    for _ in range(1, k):
+        d2 = np.minimum(d2, ((X - centers[-1]) ** 2).sum(axis=1))
+        centers.append(X[int(d2.argmax())])
+    return np.stack(centers).astype(np.float32)

@@ -1,0 +1,154 @@
+"""NaiveBayes — class-conditional stats in one MRTask pass.
+
+Reference: hex/naivebayes/NaiveBayes.java (SURVEY.md §2b C17): one pass
+accumulates per-class counts, per-(class, numeric feature) mean/sd and
+per-(class, categorical level) frequencies; prediction scores
+log-priors + gaussian/frequency log-likelihoods. Laplace smoothing for
+categorical probabilities, min_sdev floor for numeric sdevs.
+
+TPU design: all accumulations are one-hot matmuls ([K,r]x[r,F] on the
+MXU) inside a single `doall` (runtime/mrtask.py) — the reference's
+MRTask.map/reduce — with NA-aware masking so missing cells drop out of
+their feature's statistics only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame import Frame
+from ..runtime.mrtask import doall
+from .base import Model, resolve_xy
+
+
+@dataclass
+class NaiveBayesParams:
+    laplace: float = 0.0
+    min_sdev: float = 1e-3
+    seed: int = 0
+
+
+class NaiveBayesModel(Model):
+    algo = "naivebayes"
+
+    def __init__(self, data, params, priors, num_mean, num_sd,
+                 enum_tables, enum_cols, num_cols):
+        super().__init__(data)
+        self.params = params
+        self.priors = priors            # [K]
+        self.num_mean = num_mean        # [K, Fnum]
+        self.num_sd = num_sd            # [K, Fnum]
+        self.enum_tables = enum_tables  # per enum col: [K, L] probs
+        self.enum_cols = enum_cols      # X column indices of enums
+        self.num_cols = num_cols        # X column indices of numerics
+
+    def _score_matrix(self, X):
+        K = self.nclasses
+        ll = jnp.log(self.priors)[None, :]             # [r, K]
+        ll = jnp.broadcast_to(ll, (X.shape[0], K))
+        if self.num_cols:
+            Xn = X[:, jnp.asarray(self.num_cols)]      # [r, Fn]
+            mu = self.num_mean                          # [K, Fn]
+            sd = self.num_sd
+            z = (Xn[:, None, :] - mu[None, :, :]) / sd[None, :, :]
+            lp = -0.5 * z * z - jnp.log(sd)[None, :, :]
+            lp = jnp.where(jnp.isnan(Xn)[:, None, :], 0.0, lp)  # NA drops
+            ll = ll + jnp.sum(lp, axis=2)
+        for ci, tab in zip(self.enum_cols, self.enum_tables):
+            c = X[:, ci]
+            L = tab.shape[1]
+            code = jnp.where(jnp.isnan(c), 0, c).astype(jnp.int32)
+            code = jnp.clip(code, 0, L - 1)
+            lp = jnp.log(tab.T)[code]                  # [r, K]
+            lp = jnp.where(jnp.isnan(c)[:, None], 0.0, lp)
+            ll = ll + lp
+        m = jnp.max(ll, axis=1, keepdims=True)
+        p = jnp.exp(ll - m)
+        return p / jnp.sum(p, axis=1, keepdims=True)
+
+
+class NaiveBayes:
+    """H2ONaiveBayesEstimator analog (classification only)."""
+
+    def __init__(self, **kw):
+        from .cv import CVArgs
+
+        self.cv_args = CVArgs.pop(kw)
+        self.params = NaiveBayesParams(**kw)
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              weights_column: str | None = None,
+              validation_frame: Frame | None = None) -> NaiveBayesModel:
+        p = self.params
+        if self.cv_args.fold_column:
+            ignored_columns = list(ignored_columns or []) + \
+                [self.cv_args.fold_column]
+        data = resolve_xy(training_frame, y, x, ignored_columns,
+                          weights_column, "auto")
+        if data.nclasses < 2:
+            raise ValueError("NaiveBayes needs a categorical response")
+        K = data.nclasses
+        num_cols = [i for i, n in enumerate(data.feature_names)
+                    if n not in data.feature_domains]
+        enum_cols = [i for i, n in enumerate(data.feature_names)
+                     if n in data.feature_domains]
+        enum_L = [len(data.feature_domains[data.feature_names[i]])
+                  for i in enum_cols]
+
+        ni = jnp.asarray(num_cols, dtype=jnp.int32) if num_cols else None
+
+        def map_fn(X, yv, w):
+            yoh = (yv[:, None] == jnp.arange(K)[None, :]) * w[:, None]
+            out = {"class_w": jnp.sum(yoh, axis=0)}       # [K]
+            if ni is not None:
+                Xn = X[:, ni]
+                val = (~jnp.isnan(Xn)).astype(jnp.float32)
+                Xn0 = jnp.nan_to_num(Xn)
+                out["n_sum"] = yoh.T @ Xn0                # [K,Fn] MXU
+                out["n_sumsq"] = yoh.T @ (Xn0 * Xn0)
+                out["n_cnt"] = yoh.T @ (val * 1.0)
+            for j, (ci, L) in enumerate(zip(enum_cols, enum_L)):
+                c = X[:, ci]
+                code = jnp.where(jnp.isnan(c), L, c).astype(jnp.int32)
+                coh = (code[:, None] == jnp.arange(L)[None, :]) * 1.0
+                out[f"e{j}"] = yoh.T @ coh                # [K,L]
+            return out
+
+        stats = doall(map_fn, data.X, data.y, data.w, reduce="sum")
+        cw = np.asarray(stats["class_w"], dtype=np.float64)
+        priors = cw / cw.sum()
+        if num_cols:
+            cnt = np.maximum(np.asarray(stats["n_cnt"]), 1.0)
+            mean = np.asarray(stats["n_sum"]) / cnt
+            var = np.asarray(stats["n_sumsq"]) / cnt - mean ** 2
+            sd = np.sqrt(np.maximum(var, 0.0))
+            sd = np.maximum(sd, p.min_sdev)
+        else:
+            mean = sd = np.zeros((K, 0), dtype=np.float32)
+        tables = []
+        for j, L in enumerate(enum_L):
+            t = np.asarray(stats[f"e{j}"], dtype=np.float64) + p.laplace
+            denom = t.sum(axis=1, keepdims=True)
+            denom = np.where(denom > 0, denom, 1.0)
+            tab = np.maximum(t / denom, 1e-10)            # avoid log(0)
+            tables.append(jnp.asarray(tab.astype(np.float32)))
+
+        model = NaiveBayesModel(
+            data, p, jnp.asarray(priors.astype(np.float32)),
+            jnp.asarray(mean.astype(np.float32)),
+            jnp.asarray(sd.astype(np.float32)),
+            tables, enum_cols, num_cols)
+        from .cv import finalize_train
+
+        return finalize_train(
+            self, model, y, training_frame,
+            {"x": x, "ignored_columns": ignored_columns,
+             "weights_column": weights_column},
+            validation_frame)

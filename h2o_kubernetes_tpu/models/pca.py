@@ -1,0 +1,141 @@
+"""PCA — GramSVD over sharded rows.
+
+Reference: hex/pca/PCA.java (SURVEY.md §2b C17), default method GramSVD:
+an MRTask accumulates the Gram matrix XᵀX over all chunks (the same
+pattern as GLM's Gram, SURVEY.md §3.5), the driver eigendecomposes it,
+and scores are X·V. Transform options mirror the reference's
+(NONE/DEMEAN/DESCALE/STANDARDIZE); categoricals one-hot via DataInfo.
+
+TPU design: per-shard Gram is ONE [F,r]x[r,F] matmul on the MXU,
+`psum` across shards, `eigh` on the replicated [F,F] result — a single
+jitted call, no per-iteration traffic.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..frame import Frame
+from ..runtime.mesh import ROWS, global_mesh
+from .base import Model, resolve_x
+from .datainfo import build_datainfo
+
+
+@dataclass
+class PCAParams:
+    k: int = 3
+    transform: str = "STANDARDIZE"   # NONE|DEMEAN|DESCALE|STANDARDIZE
+    pca_method: str = "GramSVD"
+    use_all_factor_levels: bool = False
+    seed: int = 0
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _gram_psum(Xe, w, mesh):
+    def body(xs, ws):
+        xw = xs * ws[:, None]
+        return (lax.psum(xs.T @ xw, ROWS),      # [F,F] MXU
+                lax.psum(jnp.sum(ws), ROWS))
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P(ROWS), P(ROWS)),
+                         out_specs=(P(), P()))(Xe, w)
+
+
+class PCAModel(Model):
+    algo = "pca"
+
+    def __init__(self, data, params, dinfo, eigvec, eigval, n_obs):
+        super().__init__(data)
+        self.params = params
+        self.dinfo = dinfo
+        self.eigenvectors = eigvec       # [F, k] (expanded space)
+        self.eigenvalues = eigval        # [k] variances
+        self.n_obs = n_obs
+        self.nclasses = 1
+
+    @property
+    def std_deviation(self) -> np.ndarray:
+        return np.sqrt(np.maximum(np.asarray(self.eigenvalues), 0.0))
+
+    def pve(self) -> np.ndarray:
+        """Proportion of variance explained per component."""
+        ev = np.maximum(np.asarray(self.eigenvalues), 0.0)
+        return ev / self._total_var
+
+    def _score_matrix(self, X):
+        Xe = self.dinfo.expand(X)[:, :-1]
+        return Xe @ self.eigenvectors
+
+    def predict(self, frame: Frame) -> Frame:
+        out = self.predict_raw(frame)
+        return Frame.from_arrays(
+            {f"PC{i+1}": out[:, i] for i in range(out.shape[1])})
+
+    def model_performance(self, frame=None, y=None) -> dict:
+        return {"std_deviation": self.std_deviation.tolist(),
+                "pve": self.pve().tolist()}
+
+
+_TRANSFORM = {"NONE": (False, False), "DEMEAN": (True, False),
+              "DESCALE": (False, True), "STANDARDIZE": (True, True)}
+
+
+class PCA:
+    """H2OPrincipalComponentAnalysisEstimator analog."""
+
+    def __init__(self, **kw):
+        from .cv import CVArgs
+
+        CVArgs.pop(kw)
+        self.params = PCAParams(**kw)
+
+    def train(self, training_frame: Frame, x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              y: str | None = None) -> PCAModel:
+        p = self.params
+        t = p.transform.upper()
+        if t not in _TRANSFORM:
+            raise ValueError(f"unknown transform '{p.transform}'")
+        demean, descale = _TRANSFORM[t]
+        ignored = list(ignored_columns or [])
+        if y is not None:
+            ignored.append(y)
+        data = resolve_x(training_frame, x, ignored)
+        # DataInfo standardization = STANDARDIZE; for the other transforms
+        # adjust the means/stds it would apply
+        dinfo = build_datainfo(data, training_frame, standardize=descale,
+                               drop_first=not p.use_all_factor_levels)
+        if not demean:
+            dinfo.means = np.zeros_like(dinfo.means)
+        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]
+        F = Xe.shape[1]
+        if p.k > F:
+            raise ValueError(f"k={p.k} > {F} expanded features")
+
+        mesh = global_mesh()
+        G, n_obs = _gram_psum(Xe, data.w, mesh)
+        # demean in Gram space when DEMEAN/STANDARDIZE: DataInfo already
+        # centered numerics; one-hot cols keep their raw frequencies,
+        # matching the reference (it also centers only numerics)
+        vals, vecs = jnp.linalg.eigh(G / jnp.maximum(n_obs - 1.0, 1.0))
+        order = jnp.argsort(-vals)
+        vals = vals[order][: p.k]
+        vecs = vecs[:, order][:, : p.k]
+        # sign convention: largest-|loading| coordinate positive
+        sign = jnp.sign(vecs[jnp.argmax(jnp.abs(vecs), axis=0),
+                             jnp.arange(p.k)])
+        vecs = vecs * sign[None, :]
+
+        model = PCAModel(data, p, dinfo, vecs, vals, float(n_obs))
+        model._total_var = float(jnp.trace(G) /
+                                 jnp.maximum(n_obs - 1.0, 1.0))
+        model.cv = None
+        return model

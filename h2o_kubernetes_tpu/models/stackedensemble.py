@@ -1,0 +1,151 @@
+"""Stacked Ensembles — metalearner over base-model CV holdout predictions.
+
+Reference: hex/ensemble/StackedEnsemble.java + StackedEnsembleModel
+(SURVEY.md §2b C15): the level-one frame is each base model's
+cross-validation holdout predictions (class-1 probability for binomial,
+all K probabilities for multinomial, raw prediction for regression),
+the metalearner (GLM by default, as in the reference) trains on it, and
+scoring runs every base model then the metalearner on their outputs.
+
+Requirements mirrored from the reference's checks: every base model
+must have been trained with CV holdout predictions kept, on the same
+response, with the SAME fold assignment (verified via the stored
+per-row fold ids, like StackedEnsembleModel.checkAndInheritModelProperties).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..frame import Frame
+from .base import Model
+
+
+def _level_one_columns(m, preds: np.ndarray, tag: str) -> dict[str, np.ndarray]:
+    """Columns a base model contributes to the level-one frame."""
+    if m.nclasses == 2:
+        return {tag: preds[:, 1]}
+    if m.nclasses > 2:
+        return {f"{tag}_p{k}": preds[:, k] for k in range(m.nclasses)}
+    return {tag: preds}
+
+
+class StackedEnsembleModel(Model):
+    algo = "stackedensemble"
+
+    def __init__(self, data, base_models: list, metalearner,
+                 base_tags: list[str]):
+        super().__init__(data)
+        self.base_models = base_models
+        self.metalearner = metalearner
+        self.base_tags = base_tags
+
+    def _level_one_frame(self, frame: Frame) -> Frame:
+        cols: dict[str, np.ndarray] = {}
+        for m, tag in zip(self.base_models, self.base_tags):
+            cols.update(_level_one_columns(m, m.predict_raw(frame), tag))
+        return Frame.from_arrays(cols)
+
+    def predict_raw(self, frame: Frame) -> np.ndarray:
+        # the inherited predict()/model_performance() route through this
+        # override, so the ensemble needs nothing else
+        return self.metalearner.predict_raw(self._level_one_frame(frame))
+
+
+class StackedEnsemble:
+    """H2OStackedEnsembleEstimator analog."""
+
+    def __init__(self, base_models: list,
+                 metalearner_algorithm: str = "glm",
+                 metalearner_params: dict | None = None,
+                 metalearner_nfolds: int = 0):
+        if not base_models:
+            raise ValueError("base_models must be non-empty")
+        self.base_models = list(base_models)
+        self.metalearner_algorithm = metalearner_algorithm
+        self.metalearner_params = dict(metalearner_params or {})
+        self.metalearner_nfolds = metalearner_nfolds
+
+    def train(self, y: str, training_frame: Frame) -> StackedEnsembleModel:
+        models = self.base_models
+        ref = models[0]
+        fold_ref = None
+        for i, m in enumerate(models):
+            if m.cv is None or m.cv.holdout_predictions is None:
+                raise ValueError(
+                    f"base model #{i} ({m.algo}) was not trained with "
+                    "nfolds >= 2 and keep_cross_validation_predictions")
+            if m.nclasses != ref.nclasses:
+                raise ValueError("base models disagree on the response "
+                                 f"({m.nclasses} vs {ref.nclasses} classes)")
+            if m.cv.holdout_predictions.shape[0] != training_frame.nrows:
+                raise ValueError(
+                    f"base model #{i} was trained on a different frame "
+                    f"({m.cv.holdout_predictions.shape[0]} rows vs "
+                    f"{training_frame.nrows})")
+            if fold_ref is None:
+                fold_ref = m.cv.fold_ids
+            elif not np.array_equal(m.cv.fold_ids, fold_ref):
+                raise ValueError(
+                    f"base model #{i} used a different fold assignment; "
+                    "train all base models with the same fold_column or "
+                    "(fold_assignment, seed)")
+
+        tags = []
+        seen: dict[str, int] = {}
+        for m in models:
+            tag = m.algo
+            seen[tag] = seen.get(tag, 0) + 1
+            tags.append(f"{tag}{seen[tag]}" if seen[tag] > 1 else tag)
+
+        cols: dict[str, np.ndarray] = {}
+        for m, tag in zip(models, tags):
+            cols.update(_level_one_columns(m, m.cv.holdout_predictions, tag))
+        lone = Frame.from_arrays(cols)
+        lone[y] = training_frame.vec(y)
+
+        cvkw = {"nfolds": self.metalearner_nfolds,
+                "fold_assignment": "modulo"} \
+            if self.metalearner_nfolds >= 2 else {}
+        if self.metalearner_algorithm == "glm":
+            from .glm import GLM
+
+            params = dict(self.metalearner_params)
+            if ref.nclasses == 2:
+                params.setdefault("family", "binomial")
+            elif ref.nclasses == 1:
+                params.setdefault("family", "gaussian")
+            else:
+                # multinomial metalearning falls back to a DRF metalearner
+                # until GLM grows a multinomial family
+                from .drf import DRF
+
+                meta = DRF(ntrees=50, seed=0, **cvkw).train(
+                    y=y, training_frame=lone)
+                return self._finish(meta, models, tags, training_frame, y)
+            meta = GLM(**params, **cvkw).train(y=y, training_frame=lone)
+        elif self.metalearner_algorithm in ("drf", "gbm"):
+            from .drf import DRF
+            from .gbm import GBM
+
+            cls = DRF if self.metalearner_algorithm == "drf" else GBM
+            meta = cls(**self.metalearner_params, **cvkw).train(
+                y=y, training_frame=lone)
+        else:
+            raise ValueError(
+                f"unknown metalearner '{self.metalearner_algorithm}'")
+        return self._finish(meta, models, tags, training_frame, y)
+
+    def _finish(self, meta, models, tags, training_frame, y):
+        from .base import resolve_xy
+
+        # reuse resolve_xy only for response metadata (features come
+        # from the base models, not the frame)
+        data = resolve_xy(training_frame, y,
+                          x=models[0].feature_names[:1])
+        data.feature_names = []
+        model = StackedEnsembleModel(data, models, meta, tags)
+        # the metalearner's CV (over the level-one holdout frame) is the
+        # honest generalization estimate for the whole ensemble
+        model.cv = meta.cv
+        return model

@@ -34,7 +34,8 @@ from jax import lax
 from .. import metrics as M
 from ..frame import Frame
 from .base import resolve_xy
-from .gbm import GBM, GBMModel, _gain_by_feat, _predict_jit, _tree_sampling
+from .gbm import (GBM, GBMModel, _predict_jit, _stacked_varimp,
+                  _tree_sampling)
 from .tree.binning import apply_bins, fit_bins
 from .tree.core import TreeParams, grow_tree
 
@@ -279,7 +280,6 @@ class XGBoost(GBM):
         F = len(data.feature_names)
         margin = jnp.zeros_like(data.y)
         trees, history = [], []
-        varimp = np.zeros(F, dtype=np.float64)
         batch = min(self._ndcg_group_batch, layout.n_groups)
         for t in range(p.ntrees):
             key, kt = jax.random.split(key)
@@ -292,7 +292,6 @@ class XGBoost(GBM):
             margin = margin + _predict_jit(tree, binned, tp.max_depth,
                                            tp.n_bins)
             trees.append(tree)
-            varimp += _gain_by_feat(tree, F)
             if p.score_every and (t + 1) % p.score_every == 0:
                 sc = np.asarray(margin)[: frame.nrows]
                 yt = np.asarray(data.y)[: frame.nrows]
@@ -300,7 +299,8 @@ class XGBoost(GBM):
                                 "train_ndcg@10": M.ndcg(yt, sc, gids, k=10)})
 
         model = self.model_cls(data, p, bin_spec, trees, init_score=0.0,
-                               varimp=dict(zip(data.feature_names, varimp)))
+                               varimp=None)
+        model._varimp = _stacked_varimp(model.trees, data.feature_names)
         model._group_column = group_column
         sc = np.asarray(margin)[: frame.nrows]
         yt = np.asarray(data.y)[: frame.nrows]

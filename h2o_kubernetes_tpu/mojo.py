@@ -1,0 +1,237 @@
+"""MOJO-analog: standalone scoring artifacts, pure numpy at score time.
+
+Reference: h2o-genmodel + ModelMojoWriter (SURVEY.md §2b C18) — a model
+exports to a self-contained artifact scoreable WITHOUT a running
+cluster. Here the artifact is a zip of npz arrays + JSON metadata, and
+`MojoModel` scores it with numpy only (no jax import needed), so the
+artifact runs on any serving host.
+
+Supported: GBM / DRF / XGBoost (trees + bin edges), GLM (beta + design
+layout), KMeans (centers).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import numpy as np
+
+__all__ = ["export_mojo", "import_mojo", "MojoModel"]
+
+_FORMAT = "h2o_kubernetes_tpu/mojo/1"
+
+
+def _np(a):
+    return np.asarray(a)
+
+
+def export_mojo(model, path: str) -> str:
+    """Write `model` as a standalone scoring artifact at `path`."""
+    algo = model.algo
+    meta = {
+        "format": _FORMAT,
+        "algo": algo,
+        "feature_names": model.feature_names,
+        "feature_domains": model.feature_domains,
+        "nclasses": model.nclasses,
+        "response_domain": model.response_domain,
+        "distribution": model.distribution,
+    }
+    arrays: dict[str, np.ndarray] = {}
+    if algo in ("gbm", "drf", "xgboost"):
+        meta["max_depth"] = model.params.max_depth
+        meta["nbins"] = model.params.nbins
+        meta["drf_mode"] = bool(model.params._drf_mode)
+        meta["ntrees"] = model.ntrees
+        meta["na_bin"] = model.bin_spec.na_bin
+        arrays["init_score"] = _np(model.init_score)
+        arrays["edges"] = _np(model._edges)
+        arrays["enum_mask"] = _np(model._enum_mask)
+        for f in ("split_feat", "split_bin", "na_left", "is_split",
+                  "value"):
+            arrays[f"tree_{f}"] = _np(getattr(model.trees, f))
+    elif algo == "glm":
+        meta["family"] = model.params.family
+        arrays["beta"] = _np(model.beta)
+        d = model.dinfo
+        meta["numeric_idx"] = list(d.numeric_idx)
+        meta["enum_specs"] = [list(s) for s in d.enum_specs]
+        meta["drop_first"] = d.drop_first
+        arrays["means"] = _np(d.means)
+        arrays["stds"] = _np(d.stds)
+    elif algo == "kmeans":
+        arrays["centers"] = _np(model.centers_std)
+        d = model.dinfo
+        meta["numeric_idx"] = list(d.numeric_idx)
+        meta["enum_specs"] = [list(s) for s in d.enum_specs]
+        meta["drop_first"] = d.drop_first
+        arrays["means"] = _np(d.means)
+        arrays["stds"] = _np(d.stds)
+    else:
+        raise ValueError(f"mojo export not supported for algo '{algo}'")
+
+    npz = io.BytesIO()
+    np.savez_compressed(npz, **arrays)
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("model.json", json.dumps(meta))
+        z.writestr("arrays.npz", npz.getvalue())
+    return path
+
+
+def import_mojo(path: str) -> "MojoModel":
+    return MojoModel(path)
+
+
+class MojoModel:
+    """Loads and scores a mojo artifact with numpy only."""
+
+    def __init__(self, path: str):
+        with zipfile.ZipFile(path) as z:
+            self.meta = json.loads(z.read("model.json"))
+            if self.meta.get("format") != _FORMAT:
+                raise ValueError(f"{path}: not a {_FORMAT} artifact")
+            with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
+                self.arrays = {k: npz[k] for k in npz.files}
+        self.algo = self.meta["algo"]
+        self.feature_names = self.meta["feature_names"]
+        self.nclasses = self.meta["nclasses"]
+
+    # -- feature matrix from a dict of columns ------------------------------
+
+    def _matrix(self, data) -> np.ndarray:
+        """data: mapping name -> array (numeric values or string levels)."""
+        cols = []
+        doms = self.meta["feature_domains"]
+        for name in self.feature_names:
+            if name not in data:
+                raise ValueError(f"missing feature column '{name}'")
+            col = np.asarray(data[name])
+            dom = doms.get(name)
+            if dom is not None and col.dtype.kind in ("U", "S", "O"):
+                lut = {d: i for i, d in enumerate(dom)}
+                col = np.array([lut.get(str(s), -1) for s in col],
+                               dtype=np.float32)
+                col[col < 0] = np.nan
+            cols.append(col.astype(np.float32))
+        return np.stack(cols, axis=1)
+
+    def predict(self, data) -> np.ndarray:
+        """[n, K] probabilities / [n] predictions / [n] cluster ids."""
+        X = self._matrix(data) if not isinstance(data, np.ndarray) \
+            else data.astype(np.float32)
+        if self.algo in ("gbm", "drf", "xgboost"):
+            return self._predict_trees(X)
+        if self.algo == "glm":
+            return self._predict_glm(X)
+        if self.algo == "kmeans":
+            return self._predict_kmeans(X)
+        raise ValueError(self.algo)
+
+    # -- scorers -------------------------------------------------------------
+
+    def _expand(self, X):
+        """DataInfo.expand re-implemented in numpy (glm / kmeans)."""
+        m = self.meta
+        means, stds = self.arrays["means"], self.arrays["stds"]
+        out = []
+        for j, i in enumerate(m["numeric_idx"]):
+            c = X[:, i].copy()
+            c[np.isnan(c)] = means[j]
+            out.append((c - means[j]) / stds[j])
+        mats = [np.stack(out, axis=1)] if out else []
+        for (i, L, has_na, mode) in m["enum_specs"]:
+            c = X[:, i]
+            code = np.where(np.isnan(c), L, c).astype(np.int32)
+            if not has_na:
+                code = np.where(code >= L, mode, code)
+            lo = 1 if m["drop_first"] else 0
+            width = L - lo + (1 if has_na else 0)
+            levels = np.arange(lo, lo + width)
+            mats.append((code[:, None] == levels[None, :])
+                        .astype(np.float32))
+        mats.append(np.ones((X.shape[0], 1), dtype=np.float32))
+        return np.concatenate(mats, axis=1)
+
+    def _bin(self, X):
+        edges = self.arrays["edges"]
+        enum_mask = self.arrays["enum_mask"]
+        na_bin = self.meta["na_bin"]
+        out = np.empty(X.shape, dtype=np.int32)
+        for f in range(X.shape[1]):
+            col = X[:, f]
+            if enum_mask[f]:
+                b = np.clip(np.nan_to_num(col, nan=-1), -1,
+                            na_bin - 1).astype(np.int32)
+                b[(col < 0) | np.isnan(col)] = na_bin
+            else:
+                b = np.searchsorted(edges[f], col, side="right")
+                b = b.astype(np.int32)
+                b[np.isnan(col)] = na_bin
+            out[:, f] = b
+        return out
+
+    def _predict_trees(self, X):
+        m = self.meta
+        binned = self._bin(X)
+        sf = self.arrays["tree_split_feat"]      # [T, N]
+        sb = self.arrays["tree_split_bin"]
+        nl = self.arrays["tree_na_left"]
+        sp = self.arrays["tree_is_split"]
+        val = self.arrays["tree_value"]
+        T = sf.shape[0]
+        n = binned.shape[0]
+        na_bin = m["na_bin"]
+        total = np.zeros(n, dtype=np.float64)
+        K = m["nclasses"] if m["nclasses"] > 2 else 1
+        totals = np.zeros((n, K), dtype=np.float64)
+        for t in range(T):
+            node = np.zeros(n, dtype=np.int64)
+            for _ in range(m["max_depth"]):
+                f = sf[t][node]
+                b = sb[t][node]
+                nleft = nl[t][node]
+                split = sp[t][node]
+                rowbin = binned[np.arange(n), np.maximum(f, 0)]
+                is_na = rowbin == na_bin
+                go_right = np.where(is_na, ~nleft, rowbin > b)
+                child = 2 * node + 1 + go_right.astype(np.int64)
+                node = np.where(split, child, node)
+            totals[:, t % K] += val[t][node]
+        init = np.atleast_1d(self.arrays["init_score"].astype(np.float64))
+        if m["drf_mode"]:
+            totals = totals / (T // K)
+        probsum = totals + init[None, :]
+        d = m["distribution"]
+        if d == "bernoulli":
+            mgn = probsum[:, 0]
+            p1 = np.clip(mgn, 0, 1) if m["drf_mode"] else \
+                1.0 / (1.0 + np.exp(-mgn))
+            return np.stack([1 - p1, p1], axis=1)
+        if d == "multinomial":
+            if m["drf_mode"]:
+                z = np.clip(probsum, 0, None)
+                return z / (z.sum(axis=1, keepdims=True) + 1e-10)
+            z = np.exp(probsum - probsum.max(axis=1, keepdims=True))
+            return z / z.sum(axis=1, keepdims=True)
+        if d == "poisson":
+            return np.exp(probsum[:, 0])
+        return probsum[:, 0]
+
+    def _predict_glm(self, X):
+        Xe = self._expand(X)
+        eta = Xe @ self.arrays["beta"]
+        fam = self.meta["family"]
+        if fam == "binomial":
+            mu = 1.0 / (1.0 + np.exp(-eta))
+            return np.stack([1 - mu, mu], axis=1)
+        if fam == "poisson":
+            return np.exp(np.clip(eta, -30, 30))
+        return eta
+
+    def _predict_kmeans(self, X):
+        Xe = self._expand(X)[:, :-1]
+        C = self.arrays["centers"]
+        d = (Xe * Xe).sum(1)[:, None] - 2 * Xe @ C.T + (C * C).sum(1)[None]
+        return d.argmin(axis=1)

@@ -1,0 +1,182 @@
+"""Persistence — model/frame save & load (local filesystem).
+
+Reference: water/persist/* (SURVEY.md §2b C20) provides binary model
+save/load and frame export over pluggable backends (local/S3/HDFS/GCS);
+h2o.save_model / h2o.load_model / h2o.export_file are the client verbs
+(h2o-py). This build implements the local backend; remote schemes can
+register via PERSIST_SCHEMES (the reference's PersistManager registry).
+
+Device arrays are converted to host numpy on save (a model file is
+readable on any backend — the reference's binary models are likewise
+cluster-independent), and flow back to device lazily on first use.
+"""
+
+from __future__ import annotations
+
+import copyreg
+import dataclasses
+import io
+import os
+import pickle
+from typing import Any, Callable
+
+import numpy as np
+
+__all__ = ["save_model", "load_model", "export_file", "save_frame",
+           "load_frame", "PERSIST_SCHEMES"]
+
+_MAGIC = b"H2OTPU1\n"
+
+# scheme -> (reader: path->bytes, writer: path,bytes->None); the local
+# backend is the only built-in (PersistManager analog)
+PERSIST_SCHEMES: dict[str, tuple[Callable, Callable]] = {}
+
+
+def _write_bytes(path: str, data: bytes) -> None:
+    scheme = path.split("://", 1)[0] if "://" in path else ""
+    if scheme:
+        if scheme not in PERSIST_SCHEMES:
+            raise ValueError(f"no persist backend for scheme "
+                             f"'{scheme}://' (register in PERSIST_SCHEMES)")
+        PERSIST_SCHEMES[scheme][1](path, data)
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _read_bytes(path: str) -> bytes:
+    scheme = path.split("://", 1)[0] if "://" in path else ""
+    if scheme:
+        if scheme not in PERSIST_SCHEMES:
+            raise ValueError(f"no persist backend for scheme "
+                             f"'{scheme}://'")
+        return PERSIST_SCHEMES[scheme][0](path)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+class _HostPickler(pickle.Pickler):
+    """Pickler that lands every jax.Array as host numpy."""
+
+    def persistent_id(self, obj):
+        import jax
+
+        if isinstance(obj, jax.Array):
+            return ("jax_array", np.asarray(obj))
+        return None
+
+
+class _HostUnpickler(pickle.Unpickler):
+    def persistent_load(self, pid):
+        tag, val = pid
+        if tag == "jax_array":
+            return val          # numpy; flows back to device on first use
+        raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+
+
+def save_model(model, path: str, force: bool = True) -> str:
+    """h2o.save_model analog: binary model file at `path`.
+
+    If `path` has no extension it is treated as a directory and the
+    file is named <algo>.model inside it (h2o-py's directory behavior).
+    """
+    if not force and os.path.exists(path):
+        raise FileExistsError(path)
+    if "://" not in path and not os.path.splitext(path)[1]:
+        path = os.path.join(path, f"{model.algo}.model")
+    buf = io.BytesIO()
+    buf.write(_MAGIC)
+    _HostPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(model)
+    _write_bytes(path, buf.getvalue())
+    return path
+
+
+def load_model(path: str):
+    """h2o.load_model analog."""
+    data = _read_bytes(path)
+    if not data.startswith(_MAGIC):
+        raise ValueError(f"{path} is not an h2o_kubernetes_tpu model file")
+    return _HostUnpickler(io.BytesIO(data[len(_MAGIC):])).load()
+
+
+def export_file(frame, path: str, header: bool = True,
+                sep: str = ",") -> str:
+    """h2o.export_file analog: write a Frame as CSV (local or scheme)."""
+    from .frame.frame import NA_ENUM
+
+    cols = []
+    for name in frame.names:
+        v = frame.vec(name)
+        if v.is_enum():
+            codes = v.to_numpy()
+            dom = np.array(list(v.domain) + [""], dtype=object)
+            col = dom[np.where(codes < 0, len(dom) - 1, codes)]
+        elif v.kind == "time":
+            ms = v.to_numpy()
+            col = np.array(
+                [np.datetime64(int(m), "ms").astype(str) if m == m else ""
+                 for m in ms], dtype=object)
+        else:
+            x = v.to_numpy()
+            col = np.where(np.isnan(x), "",
+                           np.char.mod("%g", np.nan_to_num(x)))
+        cols.append(col.astype(object))
+    out = io.StringIO()
+    if header:
+        out.write(sep.join(frame.names) + "\n")
+    quoted = []
+    for c in cols:
+        # RFC 4180: embedded quotes double inside a quoted field
+        q = np.array(
+            [f'"{str(s).replace(chr(34), chr(34) * 2)}"'
+             if (sep in str(s) or '"' in str(s) or "\n" in str(s))
+             else str(s) for s in c], dtype=object)
+        quoted.append(q)
+    for i in range(frame.nrows):
+        out.write(sep.join(str(q[i]) for q in quoted) + "\n")
+    _write_bytes(path, out.getvalue().encode())
+    return path
+
+
+def save_frame(frame, path: str) -> str:
+    """Binary frame save (npz of columns + metadata) — the analog of the
+    reference's distributed frame snapshot in the persist layer."""
+    arrays: dict[str, np.ndarray] = {}
+    meta: dict[str, Any] = {"names": frame.names, "kinds": {},
+                            "domains": {}, "origins": {}}
+    for name in frame.names:
+        v = frame.vec(name)
+        arrays[f"col_{name}"] = v.to_numpy()
+        meta["kinds"][name] = v.kind
+        if v.domain is not None:
+            meta["domains"][name] = list(v.domain)
+        if v.kind == "time":
+            meta["origins"][name] = v.origin
+    buf = io.BytesIO()
+    np.savez_compressed(buf, __meta__=np.frombuffer(
+        pickle.dumps(meta), dtype=np.uint8), **arrays)
+    _write_bytes(path, buf.getvalue())
+    return path
+
+
+def load_frame(path: str):
+    from .frame import Frame, Vec
+
+    with np.load(io.BytesIO(_read_bytes(path)), allow_pickle=False) as z:
+        meta = pickle.loads(z["__meta__"].tobytes())
+        vecs = {}
+        for name in meta["names"]:
+            arr = z[f"col_{name}"]
+            kind = meta["kinds"][name]
+            if kind == "time":
+                # to_numpy returned absolute epoch-ms float64
+                vecs[name] = Vec.from_numpy(arr, name, kind="time")
+            elif kind == "enum":
+                vecs[name] = Vec.from_numpy(
+                    arr.astype(np.int32), name,
+                    domain=meta["domains"][name], kind="enum")
+            else:
+                vecs[name] = Vec.from_numpy(arr, name)
+    return Frame(vecs)

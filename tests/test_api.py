@@ -1,0 +1,53 @@
+"""Client-API parity tests: estimator aliases, jobs, timeline,
+diagnostics (SURVEY.md §2b C9/C19, §5.1/§5.5)."""
+
+import numpy as np
+
+import h2o_kubernetes_tpu as h2o
+
+
+def _frame(n=200, seed=31):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.4, size=n) > 0, "a", "b")
+    return h2o.Frame.from_arrays({"x": x, "y": y})
+
+
+def test_estimator_aliases(mesh8):
+    from h2o_kubernetes_tpu.estimators import (
+        H2OGradientBoostingEstimator, H2OGeneralizedLinearEstimator)
+
+    fr = _frame()
+    m = H2OGradientBoostingEstimator(ntrees=3, max_depth=3).train(
+        y="y", training_frame=fr)
+    assert m.algo == "gbm"
+    g = H2OGeneralizedLinearEstimator(family="binomial").train(
+        y="y", training_frame=fr)
+    assert g.algo == "glm"
+
+
+def test_jobs_and_timeline(mesh8):
+    fr = _frame()
+    h2o.timeline.clear()
+    am = h2o.AutoML(max_models=1, nfolds=2, seed=0,
+                    include_algos=["glm"], verbosity=None,
+                    project_name="jobs_test")
+    am.train(y="y", training_frame=fr)
+    js = h2o.jobs()
+    mine = [j for j in js if j["dest"] == "jobs_test"]
+    assert mine and mine[0]["status"] == "DONE"
+    kinds = {e["kind"] for e in h2o.timeline.events()}
+    assert {"job_start", "job_done"} <= kinds
+
+
+def test_device_memory_and_cluster_status(mesh8):
+    st = h2o.cluster_status()
+    assert st["cloud_size"] == 8
+    dm = h2o.device_memory()
+    assert len(dm) >= 1 and "device" in dm[0]
+
+
+def test_log_levels():
+    h2o.log.setLevel("INFO")
+    h2o.log.info("hello from tests")
+    h2o.log.setLevel("WARNING")

@@ -1,0 +1,106 @@
+"""StackedEnsemble + AutoML + Leaderboard tests (reference: hex/ensemble
+StackedEnsemble, h2o-automl AutoML/Leaderboard — SURVEY.md §2b C15/C16)."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.automl import AutoML, Leaderboard
+from h2o_kubernetes_tpu.models import GBM, GLM, StackedEnsemble
+
+
+def _frame(n=500, seed=11):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = np.where(x0 + 0.7 * x1 - 0.3 * x2 +
+                 rng.normal(scale=0.5, size=n) > 0, "y", "n")
+    return h2o.Frame.from_arrays({"x0": x0, "x1": x1, "x2": x2, "y": y})
+
+
+class TestStackedEnsemble:
+    def test_stacking_binomial(self, mesh8):
+        fr = _frame()
+        common = dict(nfolds=3, fold_assignment="modulo", seed=5)
+        b1 = GBM(ntrees=8, max_depth=3, **common).train(
+            y="y", training_frame=fr)
+        b2 = GLM(family="binomial", **common).train(
+            y="y", training_frame=fr)
+        se = StackedEnsemble([b1, b2]).train(y="y", training_frame=fr)
+        perf = se.model_performance(fr, "y")
+        base_auc = max(b1.cross_validation_metrics()["auc"],
+                       b2.cross_validation_metrics()["auc"])
+        assert perf["auc"] > base_auc - 0.05
+        pred = se.predict(fr)
+        assert "predict" in pred.names and pred.nrows == fr.nrows
+
+    def test_rejects_no_cv_models(self, mesh8):
+        fr = _frame(300)
+        m = GBM(ntrees=3, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="nfolds"):
+            StackedEnsemble([m]).train(y="y", training_frame=fr)
+
+    def test_rejects_mismatched_folds(self, mesh8):
+        fr = _frame(300)
+        m1 = GBM(ntrees=3, max_depth=3, nfolds=3,
+                 fold_assignment="modulo", seed=1).train(
+            y="y", training_frame=fr)
+        m2 = GBM(ntrees=3, max_depth=3, nfolds=3,
+                 fold_assignment="random", seed=9).train(
+            y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="fold assignment"):
+            StackedEnsemble([m1, m2]).train(y="y", training_frame=fr)
+
+
+class TestLeaderboard:
+    def test_ordering_desc_metric(self):
+        lb = Leaderboard("auc", ascending=False)
+        lb.add("a", object(), {"auc": 0.7})
+        lb.add("b", object(), {"auc": 0.9})
+        lb.add("c", object(), {"auc": 0.8})
+        assert [r["model_id"] for r in lb.rows] == ["b", "c", "a"]
+
+    def test_ordering_asc_metric(self):
+        lb = Leaderboard("rmse", ascending=True)
+        lb.add("a", object(), {"rmse": 3.0})
+        lb.add("b", object(), {"rmse": 1.0})
+        assert lb.rows[0]["model_id"] == "b"
+
+
+class TestAutoML:
+    def test_automl_binomial(self, mesh8):
+        fr = _frame(400)
+        am = AutoML(max_models=2, nfolds=3, seed=0,
+                    include_algos=["glm", "gbm", "stackedensemble"],
+                    verbosity=None)
+        am.train(y="y", training_frame=fr)
+        lb = am.leaderboard.as_list()
+        # 2 base models capped; SE(s) extra
+        base_rows = [r for r in lb if "StackedEnsemble" not in r["model_id"]]
+        assert len(base_rows) == 2
+        assert any("StackedEnsemble" in r["model_id"] for r in lb)
+        assert am.leader is not None
+        assert am.leaderboard.rows[0]["auc"] >= \
+            am.leaderboard.rows[-1]["auc"]
+        assert am.job.status == "DONE"
+        pred = am.predict(fr)
+        assert pred.nrows == fr.nrows
+
+    def test_automl_regression_sorts_rmse(self, mesh8):
+        rng = np.random.default_rng(2)
+        n = 300
+        x = rng.normal(size=n).astype(np.float32)
+        yv = (2 * x + rng.normal(scale=0.3, size=n)).astype(np.float32)
+        fr = h2o.Frame.from_arrays({"x": x, "resp": yv})
+        am = AutoML(max_models=2, nfolds=3, seed=1,
+                    include_algos=["glm", "gbm"], verbosity=None)
+        am.train(y="resp", training_frame=fr)
+        assert am.leaderboard.sort_metric == "rmse"
+        assert am.leaderboard.rows[0]["rmse"] <= \
+            am.leaderboard.rows[-1]["rmse"]
+
+    def test_include_exclude_mutually_exclusive(self):
+        with pytest.raises(ValueError):
+            AutoML(include_algos=["gbm"], exclude_algos=["glm"])

@@ -1,0 +1,162 @@
+"""Persistence, MOJO export, and checkpoint-continuation tests
+(reference: water/persist C20, h2o-genmodel/MOJO C18, SharedTree/DL
+checkpoint §5.4 — SURVEY.md)."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import GBM, GLM, DeepLearning, KMeans
+
+
+def _frame(n=400, seed=21):
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x0[::31] = np.nan
+    g = np.array(["u", "v", "w"])[rng.integers(0, 3, n)]
+    y = np.where(x1 + (g == "u") + rng.normal(scale=0.4, size=n) > 0,
+                 "p", "n")
+    return h2o.Frame.from_arrays({"x0": x0, "x1": x1, "g": g, "y": y})
+
+
+class TestModelSaveLoad:
+    def test_gbm_roundtrip(self, tmp_path, mesh8):
+        fr = _frame()
+        m = GBM(ntrees=5, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        path = h2o.save_model(m, str(tmp_path / "gbm.model"))
+        m2 = h2o.load_model(path)
+        np.testing.assert_allclose(m.predict_raw(fr), m2.predict_raw(fr),
+                                   rtol=1e-6)
+        assert m2.algo == "gbm" and m2.feature_names == m.feature_names
+
+    def test_directory_naming_and_magic(self, tmp_path, mesh8):
+        fr = _frame(200)
+        m = GLM(family="binomial").train(y="y", training_frame=fr)
+        path = h2o.save_model(m, str(tmp_path))
+        assert path.endswith("glm.model")
+        bad = tmp_path / "junk.model"
+        bad.write_bytes(b"not a model")
+        with pytest.raises(ValueError, match="not an h2o"):
+            h2o.load_model(str(bad))
+
+
+class TestFrameIO:
+    def test_export_import_roundtrip(self, tmp_path, mesh8):
+        fr = _frame(150)
+        p = str(tmp_path / "out.csv")
+        h2o.export_file(fr, p)
+        fr2 = h2o.import_file(p)
+        assert fr2.names == fr.names
+        assert fr2.nrows == fr.nrows
+        np.testing.assert_allclose(
+            fr2["x1"].to_numpy(), fr["x1"].to_numpy(), rtol=1e-5)
+        # NAs survive the trip
+        assert np.isnan(fr2["x0"].to_numpy()[0:32:31]).all()
+        assert list(fr2["g"].domain) == list(fr["g"].domain)
+
+    def test_binary_frame_roundtrip(self, tmp_path, mesh8):
+        fr = _frame(120)
+        p = str(tmp_path / "fr.h2oframe")
+        h2o.save_frame(fr, p)
+        fr2 = h2o.load_frame(p)
+        assert fr2.names == fr.names and fr2.nrows == fr.nrows
+        np.testing.assert_array_equal(fr2["g"].to_numpy(),
+                                      fr["g"].to_numpy())
+
+
+class TestMojo:
+    def test_gbm_mojo_matches(self, tmp_path, mesh8):
+        fr = _frame()
+        m = GBM(ntrees=6, max_depth=3, seed=2).train(
+            y="y", training_frame=fr)
+        p = str(tmp_path / "gbm.mojo")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        cols = {n: fr[n].to_numpy() if not fr[n].is_enum() else
+                np.array(fr[n].domain, dtype=object)[
+                    np.maximum(fr[n].to_numpy(), 0)]
+                for n in m.feature_names}
+        # put NA back for enum codes < 0
+        got = mj.predict(cols)
+        want = m.predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_glm_mojo_matches(self, tmp_path, mesh8):
+        fr = _frame(250)
+        m = GLM(family="binomial").train(y="y", training_frame=fr)
+        p = str(tmp_path / "glm.mojo")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        X = np.stack([fr["x0"].to_numpy(), fr["x1"].to_numpy(),
+                      fr["g"].to_numpy().astype(np.float32)], axis=1)
+        got = mj.predict(X)
+        want = m.predict_raw(fr)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_kmeans_mojo(self, tmp_path, mesh8):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(200, 2)).astype(np.float32)
+        fr = h2o.Frame.from_arrays({"a": X[:, 0], "b": X[:, 1]})
+        m = KMeans(k=3, seed=1).train(training_frame=fr)
+        p = str(tmp_path / "km.mojo")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        got = mj.predict({"a": X[:, 0], "b": X[:, 1]})
+        want = m.predict(fr)["predict"].to_numpy()
+        np.testing.assert_array_equal(got, want)
+
+
+class TestCheckpoint:
+    def test_gbm_continue(self, mesh8):
+        fr = _frame()
+        m5 = GBM(ntrees=5, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        m10 = GBM(ntrees=10, max_depth=3, seed=1, checkpoint=m5).train(
+            y="y", training_frame=fr)
+        assert m10.ntrees == 10
+        # continued model fits training data at least as well
+        a5 = m5.model_performance(fr, "y")["auc"]
+        a10 = m10.model_performance(fr, "y")["auc"]
+        assert a10 >= a5 - 1e-6
+
+    def test_gbm_checkpoint_validation(self, mesh8):
+        fr = _frame(200)
+        m = GBM(ntrees=5, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="must exceed"):
+            GBM(ntrees=5, max_depth=3, checkpoint=m).train(
+                y="y", training_frame=fr)
+        with pytest.raises(ValueError, match="nbins/max_depth"):
+            GBM(ntrees=9, max_depth=4, checkpoint=m).train(
+                y="y", training_frame=fr)
+
+    def test_dl_continue(self, mesh8):
+        fr = _frame(300)
+        m1 = DeepLearning(hidden=(16,), epochs=2, seed=1).train(
+            y="y", training_frame=fr)
+        m2 = DeepLearning(hidden=(16,), epochs=2, seed=1,
+                          checkpoint=m1).train(y="y", training_frame=fr)
+        a1 = m1.model_performance(fr, "y")["logloss"]
+        a2 = m2.model_performance(fr, "y")["logloss"]
+        assert a2 <= a1 * 1.1   # continued training didn't regress badly
+
+
+def test_checkpoint_with_cv_rejected(mesh8):
+    fr = _frame(200)
+    m = GBM(ntrees=3, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="cross-validation"):
+        GBM(ntrees=6, max_depth=3, nfolds=3, checkpoint=m).train(
+            y="y", training_frame=fr)
+
+
+def test_export_quotes_roundtrip(tmp_path, mesh8):
+    vals = np.array(['he said "hi"', "plain", "with,comma"], dtype=object)
+    fr = h2o.Frame.from_arrays({"s": vals.astype(str),
+                                "x": np.arange(3, dtype=np.float32)})
+    p = str(tmp_path / "q.csv")
+    h2o.export_file(fr, p)
+    fr2 = h2o.import_file(p)
+    assert sorted(fr2["s"].domain) == sorted(set(vals.astype(str)))
+    assert fr2.nrows == 3

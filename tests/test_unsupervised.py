@@ -1,0 +1,149 @@
+"""KMeans / PCA / NaiveBayes / IsolationForest tests (SURVEY.md §2b C17),
+known-answer checked against sklearn on small data (the reference's
+accuracy-suite approach, SURVEY.md §4b)."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import (PCA, IsolationForest, KMeans,
+                                       NaiveBayes)
+
+
+def _blobs(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    c = rng.integers(0, 3, size=n)
+    centers = np.array([[0, 0], [6, 0], [0, 6]], dtype=np.float32)
+    X = centers[c] + rng.normal(scale=0.6, size=(n, 2)).astype(np.float32)
+    return X, c
+
+
+class TestKMeans:
+    def test_recovers_blobs(self, mesh8):
+        X, c = _blobs()
+        fr = h2o.Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1]})
+        m = KMeans(k=3, max_iterations=20, seed=1,
+                   standardize=False).train(training_frame=fr)
+        assert m.iterations <= 20
+        pred = m.predict(fr)["predict"].to_numpy().astype(int)
+        # each true blob maps to one distinct cluster
+        maps = [np.bincount(pred[c == j], minlength=3).argmax()
+                for j in range(3)]
+        assert len(set(maps)) == 3
+        acc = np.mean([maps[cj] == pj for cj, pj in zip(c, pred)])
+        assert acc > 0.95
+        # centers land near the true blob centers
+        C = m.centers()
+        got = sorted(np.round(C).tolist())
+        assert sorted(np.round(np.array(
+            [[0, 0], [6, 0], [0, 6]], dtype=float)).tolist()) == got
+
+    def test_withinss_vs_sklearn(self, mesh8):
+        from sklearn.cluster import KMeans as SK
+
+        X, _ = _blobs(300, seed=2)
+        fr = h2o.Frame.from_arrays({"a": X[:, 0], "b": X[:, 1]})
+        m = KMeans(k=3, max_iterations=30, seed=3,
+                   standardize=False).train(training_frame=fr)
+        sk = SK(n_clusters=3, n_init=5, random_state=0).fit(X)
+        assert m.tot_withinss < sk.inertia_ * 1.15
+
+    def test_categorical_onehot(self, mesh8):
+        rng = np.random.default_rng(4)
+        g = np.array(["a", "b"])[rng.integers(0, 2, 200)]
+        x = rng.normal(size=200).astype(np.float32)
+        fr = h2o.Frame.from_arrays({"g": g, "x": x})
+        m = KMeans(k=2, seed=0).train(training_frame=fr)
+        assert m.predict(fr).nrows == 200
+
+
+class TestPCA:
+    def test_matches_sklearn(self, mesh8):
+        from sklearn.decomposition import PCA as SK
+
+        rng = np.random.default_rng(5)
+        z = rng.normal(size=(500, 2)).astype(np.float32)
+        A = np.array([[2.0, 0.3, 0.1], [0.1, 1.0, -0.5]], dtype=np.float32)
+        X = z @ A
+        fr = h2o.Frame.from_arrays({f"x{i}": X[:, i] for i in range(3)})
+        m = PCA(k=2, transform="DEMEAN").train(training_frame=fr)
+        sk = SK(n_components=2).fit(X)
+        # eigenvalue spectrum matches
+        np.testing.assert_allclose(np.asarray(m.eigenvalues),
+                                   sk.explained_variance_, rtol=0.05)
+        # loadings match up to sign
+        V = np.asarray(m.eigenvectors)
+        for j in range(2):
+            dot = abs(float(V[:, j] @ sk.components_[j]))
+            assert dot > 0.99
+        scores = m.predict(fr)
+        assert scores.names == ["PC1", "PC2"]
+
+    def test_pve_sums_below_one(self, mesh8):
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        fr = h2o.Frame.from_arrays({f"x{i}": X[:, i] for i in range(4)})
+        m = PCA(k=2, transform="STANDARDIZE").train(training_frame=fr)
+        pve = m.pve()
+        assert 0 < pve.sum() <= 1.0 + 1e-6
+
+
+class TestNaiveBayes:
+    def test_matches_sklearn_gaussian(self, mesh8):
+        from sklearn.naive_bayes import GaussianNB
+
+        rng = np.random.default_rng(7)
+        n = 600
+        c = rng.integers(0, 2, n)
+        X = rng.normal(size=(n, 3)).astype(np.float32) + \
+            c[:, None].astype(np.float32) * 1.5
+        yl = np.array(["neg", "pos"])[c]
+        fr = h2o.Frame.from_arrays({"x0": X[:, 0], "x1": X[:, 1],
+                                    "x2": X[:, 2], "y": yl})
+        m = NaiveBayes().train(y="y", training_frame=fr)
+        sk = GaussianNB().fit(X, c)
+        p = m.predict_raw(fr)[:, 1]
+        psk = sk.predict_proba(X)[:, 1]
+        assert np.corrcoef(p, psk)[0, 1] > 0.99
+        assert ((p > 0.5) == c).mean() > 0.85
+
+    def test_categorical_laplace(self, mesh8):
+        rng = np.random.default_rng(8)
+        n = 400
+        c = rng.integers(0, 2, n)
+        g = np.where(c == 1,
+                     np.array(["u", "v"])[rng.integers(0, 2, n)],
+                     np.array(["v", "w"])[rng.integers(0, 2, n)])
+        fr = h2o.Frame.from_arrays({"g": g,
+                                    "y": np.array(["a", "b"])[c]})
+        m = NaiveBayes(laplace=1.0).train(y="y", training_frame=fr)
+        acc = (m.predict_raw(fr).argmax(1) == c).mean()
+        assert acc > 0.6
+
+    def test_nb_with_cv(self, mesh8):
+        rng = np.random.default_rng(9)
+        n = 300
+        x = rng.normal(size=n).astype(np.float32)
+        yl = np.where(x + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+        fr = h2o.Frame.from_arrays({"x": x, "y": yl})
+        m = NaiveBayes(nfolds=3).train(y="y", training_frame=fr)
+        assert m.cross_validation_metrics()["auc"] > 0.8
+
+
+class TestIsolationForest:
+    def test_outliers_score_higher(self, mesh8):
+        rng = np.random.default_rng(10)
+        X = rng.normal(size=(500, 2)).astype(np.float32)
+        out = np.array([[8, 8], [-9, 7], [10, -8]], dtype=np.float32)
+        Xall = np.vstack([X, out])
+        fr = h2o.Frame.from_arrays({"a": Xall[:, 0], "b": Xall[:, 1]})
+        m = IsolationForest(ntrees=30, sample_size=128, seed=1).train(
+            training_frame=fr)
+        pred = m.predict(fr)
+        s = pred["predict"].to_numpy()
+        assert s[-3:].min() > np.median(s[:-3])
+        # anomaly scores live in (0, 1]
+        assert 0 < s.min() and s.max() <= 1.0
+        # mean path length of outliers is shorter
+        ln = pred["mean_length"].to_numpy()
+        assert ln[-3:].max() < np.median(ln[:-3])
