@@ -51,6 +51,8 @@ class GBMParams:
     # continue training from a previous model (reference SharedTree
     # checkpoint semantics, SURVEY.md §5.4): ntrees is the TOTAL count
     checkpoint: object = None
+    # histogram kernel selection (ops/histogram: auto|segment|pallas)
+    _hist_impl: str = "auto"
     # DRF mode: no shrinkage on margins, trees vote/average
     _drf_mode: bool = False
 
@@ -237,7 +239,8 @@ class GBM:
                         min_rows=p.min_rows, reg_lambda=p.reg_lambda,
                         reg_alpha=p.reg_alpha,
                         gamma=p.min_split_improvement, mtries=p.mtries,
-                        min_child_weight=p.min_child_weight)
+                        min_child_weight=p.min_child_weight,
+                        hist_impl=p._hist_impl)
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 

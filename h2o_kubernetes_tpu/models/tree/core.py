@@ -45,6 +45,7 @@ class TreeParams(NamedTuple):
     gamma: float = 0.0              # min split gain improvement
     mtries: int = -1                # per-node feature subsampling (DRF); -1=all
     min_child_weight: float = 0.0   # min hessian mass per child (XGBoost)
+    hist_impl: str = "auto"         # auto | segment | pallas (ops/histogram)
 
 
 class Tree(NamedTuple):
@@ -70,26 +71,10 @@ def _gain_term(G, H, p: TreeParams):
     return _soft_thresh(G, p.reg_alpha) ** 2 / (H + p.reg_lambda + 1e-10)
 
 
-def _build_histogram(binned, rel, g, h, w, n_nodes, n_bins):
-    """Masked per-shard histogram: [n_nodes, F, B, 3] of (G, H, count).
-
-    binned: [r, F] uint8; rel: [r] int32 relative node id (-1 = dead);
-    w: [r] f32 row weight (0 for padding / unsampled rows).
-    """
-    live = (rel >= 0) & (w > 0)
-    seg_node = jnp.where(live, rel, n_nodes)  # overflow row dropped below
-    # where() (not just *w) so NaN g/h in dead/padded rows can't poison sums
-    vals = jnp.where(live[:, None],
-                     jnp.stack([g * w, h * w, w], axis=1), 0.0)  # [r, 3]
-
-    def per_feature(bins_f):
-        seg = seg_node * n_bins + bins_f.astype(jnp.int32)
-        out = jax.ops.segment_sum(vals, seg,
-                                  num_segments=(n_nodes + 1) * n_bins)
-        return out[: n_nodes * n_bins].reshape(n_nodes, n_bins, 3)
-
-    hist = jax.vmap(per_feature, in_axes=1, out_axes=1)(binned)
-    return hist  # [n_nodes, F, B, 3]
+# histogram accumulation lives in ops/histogram.py (segment_sum on CPU,
+# the Pallas one-hot-matmul kernel on TPU)
+from ...ops.histogram import build_histogram as _build_histogram_op
+from ...ops.histogram import resolve_impl as _resolve_impl
 
 
 def _find_splits(hist, p: TreeParams, feat_ok=None):
@@ -160,7 +145,8 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
     for d in range(p.max_depth + 1):
         n_nodes = 2 ** d
         off = n_nodes - 1
-        hist = _build_histogram(binned, rel, g, h, w, n_nodes, p.n_bins)
+        hist = _build_histogram_op(binned, rel, g, h, w, n_nodes,
+                                   p.n_bins, impl=p.hist_impl)
         hist = lax.psum(hist, ROWS)                     # MRTask reduce
         feat_ok = jnp.broadcast_to(col_mask[None, :], (n_nodes, F))
         if p.mtries > 0 and p.mtries < F:
@@ -217,7 +203,10 @@ def _grow_tree_jit(binned, g, h, w, col_mask, key, p: TreeParams,
         functools.partial(_grow_tree_shard, p=p),
         mesh=mesh,
         in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
-        out_specs=P())
+        out_specs=P(),
+        # pallas_call's interpret mode can't thread vma through its
+        # internal slices (jax 0.9 limitation) — disable the check here
+        check_vma=_resolve_impl(p.hist_impl) == "segment")
     return fn(binned, g, h, w, col_mask, key)
 
 

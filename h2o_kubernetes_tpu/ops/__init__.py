@@ -1,0 +1,3 @@
+from .histogram import build_histogram
+
+__all__ = ["build_histogram"]

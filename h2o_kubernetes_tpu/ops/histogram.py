@@ -1,0 +1,137 @@
+"""Histogram accumulation kernels for the tree learners' hot loop.
+
+This is THE hot op of the framework (SURVEY.md §3.4: the reference's
+ScoreBuildHistogram2 row×column binning loop; BASELINE.json names a
+Pallas histogram kernel as the TPU answer). Per tree level every live
+row contributes (g·w, h·w, w) to histogram cell [node, feature, bin].
+
+Two implementations:
+
+- `segment`: jax.ops.segment_sum per feature — XLA lowers this to
+  scatter-add, which is fine on CPU but serializes on TPU.
+- `pallas`: scatter-free MXU formulation. For a row tile, the one-hot
+  membership matrix over (node·B + bin) is built in VMEM and multiplied
+  against the per-row value rows: histᵀ += valsᵀ @ onehot — a [3,T] x
+  [T, NBT] matmul per (feature, bin-block, row-tile) grid cell, so the
+  entire histogram build rides the systolic array (the GPU literature's
+  shared-memory atomics have no TPU analog; matmul inflation is the
+  right trade — see PAPERS.md GBDT-on-accelerator entries).
+
+`build_histogram(..., impl="auto")` picks pallas on TPU, segment
+elsewhere. Both run under shard_map (per-shard rows); callers psum the
+result across the ROWS mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+ROW_TILE = 512
+
+
+def _hist_segment(binned, rel, vals, n_nodes: int, n_bins: int):
+    """[r,F] bins + [r] rel + [r,3] vals -> [n_nodes, F, B, 3]."""
+    live = rel >= 0
+    seg_node = jnp.where(live, rel, n_nodes)
+
+    def per_feature(bins_f):
+        seg = seg_node * n_bins + bins_f.astype(jnp.int32)
+        out = jax.ops.segment_sum(
+            vals, seg, num_segments=(n_nodes + 1) * n_bins)
+        return out[: n_nodes * n_bins].reshape(n_nodes, n_bins, 3)
+
+    return jax.vmap(per_feature, in_axes=1, out_axes=1)(binned)
+
+
+def _bin_block(n_nodes: int, n_bins: int) -> int:
+    """Bin-block width: B times the largest power-of-2 node group that
+    keeps the one-hot tile around ~2k lanes (VMEM-bounded)."""
+    k = 1
+    while k * 2 <= n_nodes and (k * 2) * n_bins <= 2048:
+        k *= 2
+    return k * n_bins
+
+
+def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
+    nb = pl.program_id(1)
+    rt = pl.program_id(2)
+
+    @pl.when(rt == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = binned_ref[0, :].astype(jnp.int32)        # [T]
+    rel = rel_ref[:, 0]                              # [T]
+    seg = rel * n_bins + bins                        # dead rows: negative
+    base = nb * nbt
+    iota = lax.broadcasted_iota(jnp.int32, (bins.shape[0], nbt), 1)
+    onehot = ((seg[:, None] - base) == iota) & (rel >= 0)[:, None]
+    vals_t = vals_ref[:].T                           # [3, T]
+    out_ref[0] += lax.dot_general(
+        vals_t, onehot.astype(jnp.float32),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)          # [3, NBT] on the MXU
+
+
+def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
+    r, F = binned.shape
+    nB = n_nodes * n_bins
+    nbt = _bin_block(n_nodes, n_bins)
+    pad = (-r) % ROW_TILE
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        rel = jnp.pad(rel, (0, pad), constant_values=-1)
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    rp = r + pad
+    binned_t = binned.T.astype(jnp.int32)            # [F, rp]
+    rel2 = rel[:, None]                              # [rp, 1]
+
+    grid = (F, nB // nbt, rp // ROW_TILE)
+    # under shard_map the output varies per shard: propagate the input's
+    # varying-mesh-axes set or jax's vma check rejects the call
+    vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, n_bins=n_bins, nbt=nbt),
+        out_shape=jax.ShapeDtypeStruct((F, 3, nB), jnp.float32, vma=vma),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, ROW_TILE), lambda f, nb, rt: (f, rt)),
+            pl.BlockSpec((ROW_TILE, 1), lambda f, nb, rt: (rt, 0)),
+            pl.BlockSpec((ROW_TILE, 3), lambda f, nb, rt: (rt, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 3, nbt), lambda f, nb, rt: (f, 0, nb)),
+        interpret=jax.default_backend() != "tpu",
+    )(binned_t, rel2, vals)
+    # [F, 3, n*B] -> [n, F, B, 3]
+    return out.reshape(F, 3, n_nodes, n_bins).transpose(2, 0, 3, 1)
+
+
+def resolve_impl(impl: str) -> str:
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "segment"
+    if impl not in ("segment", "pallas"):
+        raise ValueError(f"unknown histogram impl '{impl}'")
+    return impl
+
+
+def build_histogram(binned, rel, g, h, w, n_nodes: int, n_bins: int,
+                    impl: str = "auto"):
+    """Per-shard histogram [n_nodes, F, B, 3] of (Σgw, Σhw, Σw).
+
+    binned: [r, F] uint8 bin codes; rel: [r] int32 node id (-1 dead);
+    w: [r] row weight (0 for padding/unsampled rows).
+    """
+    live = (rel >= 0) & (w > 0)
+    rel = jnp.where(live, rel, -1)
+    # where() (not just *w) so NaN g/h in dead rows can't poison sums
+    vals = jnp.where(live[:, None],
+                     jnp.stack([g * w, h * w, w], axis=1), 0.0)
+    impl = resolve_impl(impl)
+    if impl == "pallas":
+        return _hist_pallas(binned, rel, vals, n_nodes, n_bins)
+    return _hist_segment(binned, rel, vals, n_nodes, n_bins)
