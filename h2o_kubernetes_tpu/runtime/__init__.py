@@ -1,3 +1,4 @@
+from .backend import ensure_live_backend, force_cpu_devices
 from .mesh import (COLS, ROWS, global_mesh, initialize_distributed, make_mesh,
                    n_row_shards, replicated, row_sharding, set_global_mesh,
                    use_mesh)
@@ -6,5 +7,6 @@ from .mrtask import doall, shard_rows
 __all__ = [
     "COLS", "ROWS", "global_mesh", "initialize_distributed", "make_mesh",
     "n_row_shards", "replicated", "row_sharding", "set_global_mesh",
-    "use_mesh", "doall", "shard_rows",
+    "use_mesh", "doall", "shard_rows", "ensure_live_backend",
+    "force_cpu_devices",
 ]

@@ -1,0 +1,96 @@
+"""Backend liveness probing + virtual-CPU forcing.
+
+The scoreboard files (`bench.py`, `__graft_entry__.py`) must never hang
+or crash on a flaky TPU backend: the axon/TPU client init *hangs* (not
+errors) when the tunneled chip is unavailable, and an env-level
+``JAX_PLATFORMS=cpu`` override is re-asserted by ``sitecustomize`` —
+the only reliable controls are an out-of-process probe and an
+in-process ``jax.config`` update before first backend use.  This module
+is the single shared implementation of both.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_devices(n: int) -> None:
+    """Pin this process to the CPU platform with >= n virtual devices.
+
+    Must run before jax initializes its backends; raises/parses nothing
+    if they already exist (callers detect that via device count).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = f"{flags} {_COUNT_FLAG}={max(n, 1)}".strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{_COUNT_FLAG}={n}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def backends_initialized() -> bool:
+    if "jax" not in sys.modules:
+        return False
+    try:
+        import jax._src.xla_bridge as xb
+
+        return bool(xb._backends)  # noqa: SLF001
+    except Exception:
+        return False
+
+
+def ensure_live_backend(timeout: float = 90.0, retries: int = 2) -> str:
+    """Probe default-backend init in a throwaway subprocess; pin this
+    process to CPU if the probe crashes or hangs.
+
+    Returns the platform this process should proceed on: "cpu" after a
+    fallback, "initialized" when backends are already up (trusted
+    as-is), else the environment's default platform name.
+
+    Budget: first attempt gets the full timeout, later attempts 30s, no
+    trailing sleep — worst case ~timeout+30s, small enough to fit under
+    the driver's own watchdog.
+    """
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu"
+    if "jax" in sys.modules:
+        try:
+            if sys.modules["jax"].config.jax_platforms == "cpu":
+                return "cpu"
+        except Exception:
+            pass
+    if backends_initialized():
+        return "initialized"
+    for attempt in range(max(retries, 1)):
+        t = timeout if attempt == 0 else min(30.0, timeout)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=t, capture_output=True)
+            if r.returncode == 0:
+                return os.environ.get("JAX_PLATFORMS") or "default"
+            sys.stderr.write(
+                f"backend probe attempt {attempt + 1} rc={r.returncode}: "
+                f"{r.stderr.decode(errors='replace')[-400:]}\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"backend probe attempt {attempt + 1} hung >{t}s\n")
+    sys.stderr.write("backend unavailable; pinning this process to CPU\n")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return "cpu"
