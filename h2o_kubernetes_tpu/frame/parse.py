@@ -248,7 +248,9 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
                 skipped_columns: Sequence[str] | None = None) -> Frame:
     """h2o.import_file analog: parse CSV file(s) into a sharded Frame."""
     setup = parse_setup(path, sep=sep, header=header, na_strings=na_strings)
-    names = list(col_names) if col_names else setup["names"]
+    # copy: uniquification below must not leak into setup["names"], which
+    # later files' first records are compared against verbatim
+    names = list(col_names) if col_names else list(setup["names"])
     # uniquify duplicate headers like the reference parser (a, a -> a, a2)
     # instead of silently collapsing same-named columns into one dict key
     seen: dict[str, int] = {}

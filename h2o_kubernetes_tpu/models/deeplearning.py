@@ -54,7 +54,8 @@ class DeepLearningParams:
     seed: int = 0
     distribution: str = "auto"
     # continue training from a previous model (reference DeepLearning
-    # checkpoint semantics, SURVEY.md §5.4): runs `epochs` MORE epochs
+    # checkpoint semantics, SURVEY.md §5.4): `epochs` is the TOTAL
+    # target and must exceed the checkpoint's, mirroring GBM's ntrees
     checkpoint: object = None
 
 
@@ -253,7 +254,16 @@ class DeepLearning:
         samples_per_iter = p.train_samples_per_iteration \
             if p.train_samples_per_iteration > 0 else data.nrows
         local_steps = max(1, samples_per_iter // (batch * n_shards))
-        total_samples = p.epochs * data.nrows
+        if p.checkpoint is not None:
+            prev_epochs = p.checkpoint.params.epochs
+            if p.epochs <= prev_epochs:
+                raise ValueError(
+                    f"epochs ({p.epochs}) must exceed the checkpoint "
+                    f"model's ({prev_epochs}) — epochs is the total "
+                    f"training target, not an increment")
+            total_samples = (p.epochs - prev_epochs) * data.nrows
+        else:
+            total_samples = p.epochs * data.nrows
         n_iters = max(1, int(round(total_samples /
                                    (local_steps * batch * n_shards))))
 

@@ -13,9 +13,8 @@ cluster-independent), and flow back to device lazily on first use.
 
 from __future__ import annotations
 
-import copyreg
-import dataclasses
 import io
+import json
 import os
 import pickle
 from typing import Any, Callable
@@ -94,7 +93,14 @@ def save_model(model, path: str, force: bool = True) -> str:
 
 
 def load_model(path: str):
-    """h2o.load_model analog."""
+    """h2o.load_model analog.
+
+    Trust model: binary model files are pickle-based (like the
+    reference's binary models, they are for same-owner save/restore
+    only) — loading executes code, so never load an untrusted file.
+    For artifacts that must cross a trust boundary use the MOJO path
+    (mojo.py), whose npz+JSON format is data-only.
+    """
     data = _read_bytes(path)
     if not data.startswith(_MAGIC):
         raise ValueError(f"{path} is not an h2o_kubernetes_tpu model file")
@@ -155,8 +161,11 @@ def save_frame(frame, path: str) -> str:
         if v.kind == "time":
             meta["origins"][name] = v.origin
     buf = io.BytesIO()
+    # JSON, not pickle: frame files stay data-only so load_frame is safe
+    # on untrusted input (matching the reference's data-only formats)
+    meta_bytes = json.dumps(meta).encode()
     np.savez_compressed(buf, __meta__=np.frombuffer(
-        pickle.dumps(meta), dtype=np.uint8), **arrays)
+        meta_bytes, dtype=np.uint8), **arrays)
     _write_bytes(path, buf.getvalue())
     return path
 
@@ -165,7 +174,13 @@ def load_frame(path: str):
     from .frame import Frame, Vec
 
     with np.load(io.BytesIO(_read_bytes(path)), allow_pickle=False) as z:
-        meta = pickle.loads(z["__meta__"].tobytes())
+        try:
+            meta = json.loads(z["__meta__"].tobytes().decode())
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            raise ValueError(
+                f"{path}: frame metadata is not JSON — this looks like a "
+                f"frame saved by a pre-0.2 build (pickle metadata); "
+                f"re-export it with export_file/save_frame") from None
         vecs = {}
         for name in meta["names"]:
             arr = z[f"col_{name}"]

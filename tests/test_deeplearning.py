@@ -82,3 +82,21 @@ def test_dl_autoencoder_predict_reconstruction_frame(mesh8):
     assert rec.nrows == 300
     perf = m.model_performance(fr)
     assert "mse" in perf
+
+
+def test_dl_checkpoint_epochs_total(mesh8):
+    rng = np.random.default_rng(5)
+    n = 256
+    x = rng.normal(size=(n, 3)).astype(np.float32)
+    y = np.where(x[:, 0] + x[:, 1] > 0, "a", "b")
+    fr = Frame.from_arrays({"x0": x[:, 0], "x1": x[:, 1], "x2": x[:, 2],
+                            "y": y})
+    m1 = DeepLearning(hidden=(8,), epochs=2, seed=0).train(
+        y="y", training_frame=fr)
+    # epochs is the TOTAL target (like GBM ntrees): <= checkpoint rejected
+    with pytest.raises(ValueError, match="must exceed"):
+        DeepLearning(hidden=(8,), epochs=2, seed=0,
+                     checkpoint=m1).train(y="y", training_frame=fr)
+    m2 = DeepLearning(hidden=(8,), epochs=4, seed=0,
+                      checkpoint=m1).train(y="y", training_frame=fr)
+    assert m2 is not None

@@ -181,3 +181,15 @@ def test_multifile_repeated_headers_dropped(tmp_path, mesh8):
     fr = import_file(str(tmp_path))
     assert fr.nrows == 2
     assert sorted(fr["a"].to_numpy().tolist()) == [1.0, 3.0]
+
+
+def test_multifile_duplicate_repeated_headers_dropped(tmp_path, mesh8):
+    # regression: uniquification must not mutate setup["names"], or the
+    # second file's repeated header no longer matches and is kept as data
+    (tmp_path / "p1.csv").write_text("a,a,b\n1,2,x\n")
+    (tmp_path / "p2.csv").write_text("a,a,b\n3,4,y\n")
+    fr = import_file(str(tmp_path))
+    assert fr.nrows == 2
+    assert fr.names == ["a", "a2", "b"]
+    assert sorted(fr["a"].to_numpy().tolist()) == [1.0, 3.0]
+    assert sorted(fr["b"].domain) == ["x", "y"]

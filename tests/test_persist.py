@@ -136,7 +136,7 @@ class TestCheckpoint:
         fr = _frame(300)
         m1 = DeepLearning(hidden=(16,), epochs=2, seed=1).train(
             y="y", training_frame=fr)
-        m2 = DeepLearning(hidden=(16,), epochs=2, seed=1,
+        m2 = DeepLearning(hidden=(16,), epochs=4, seed=1,
                           checkpoint=m1).train(y="y", training_frame=fr)
         a1 = m1.model_performance(fr, "y")["logloss"]
         a2 = m2.model_performance(fr, "y")["logloss"]
