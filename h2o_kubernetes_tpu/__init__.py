@@ -1,8 +1,9 @@
 """h2o_kubernetes_tpu — a TPU-native rebuild of the H2O-3 + h2o-kubernetes
 capability surface: distributed columnar Frames as sharded JAX arrays, an
 MRTask-style map/reduce runtime on ICI collectives, histogram tree learners
-(GBM/DRF/XGBoost-hist) and GLM/DeepLearning/Word2Vec on JAX/Pallas, and
-AutoML with stacked ensembles.
+(GBM/DRF/XGBoost-hist) and GLM/DeepLearning/Word2Vec on JAX/Pallas, AutoML
+with stacked ensembles, and a C++ Kubernetes deployment stack (native/:
+tpuk CLI + h2o-tpu-operator reconciling the H2OTpu CRD).
 
 See SURVEY.md for the reference blueprint this is built against.
 """

@@ -1,0 +1,256 @@
+// tpuk — user-facing CLI (the reference's `h2ok`, cli/src/main.rs [U]):
+//   tpuk deploy   --name n --cluster-size 3 [...]   create + wait + descriptor
+//   tpuk undeploy --name n | -f n.tpuk              tear down
+//   tpuk ingress  add|delete --name n [--host h]    external route
+//   tpuk status   --name n                          CR/StatefulSet state
+//   tpuk manifest --name n [...]                    print manifests (no
+//                                                   cluster needed)
+// After deploy a <name>.tpuk descriptor file is written so undeploy can
+// find the resources later (SURVEY.md §2a R1).
+#include <cstdio>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "../deployment/crd.h"
+#include "../deployment/deploy.h"
+#include "../deployment/k8s_client.h"
+#include "../deployment/manifests.h"
+
+namespace {
+
+using tpuk::H2OTpu;
+
+void usage() {
+  std::fprintf(stderr, R"(tpuk — deploy h2o_kubernetes_tpu clusters on Kubernetes
+
+usage: tpuk <deploy|undeploy|ingress|status|manifest> [flags]
+
+common flags:
+  --name NAME              cluster name (required unless -f)
+  --namespace NS           namespace (default: default)
+  --kubeconfig PATH        kubeconfig (default $KUBECONFIG, ~/.kube/config,
+                           then in-cluster)
+  --server URL --token T   direct API access instead of kubeconfig
+
+deploy flags (also honored by manifest):
+  --cluster-size N         number of hosts/pods (default 1)
+  --version V              image tag (default latest)
+  --custom-image IMG       full image override
+  --memory QTY             pod memory request/limit (default 16Gi)
+  --cpus QTY               pod cpu request (default 4)
+  --memory-percentage P    runtime memory fraction (default 90)
+  --accelerator TYPE       GKE TPU accelerator (default tpu-v5-lite-podslice)
+  --topology T             TPU topology (default 2x4)
+  --chips-per-host N       google.com/tpu per pod (default 4)
+  --timeout SECS           deploy readiness wait (default 300)
+
+ingress:  tpuk ingress add|delete --name n [--host example.com]
+undeploy: tpuk undeploy --name n | -f name.tpuk
+)");
+}
+
+struct Args {
+  std::string cmd;
+  std::vector<std::string> positional;
+  std::map<std::string, std::string> flags;
+
+  std::string get(const std::string& k, const std::string& dflt = "") const {
+    auto it = flags.find(k);
+    return it == flags.end() ? dflt : it->second;
+  }
+  int get_int(const std::string& k, int dflt) const {
+    auto it = flags.find(k);
+    return it == flags.end() ? dflt : std::stoi(it->second);
+  }
+  bool has(const std::string& k) const { return flags.count(k) > 0; }
+};
+
+const std::set<std::string> kBoolFlags = {"insecure"};
+const std::set<std::string> kValueFlags = {
+    "name", "namespace", "kubeconfig", "server", "token", "cluster-size",
+    "version", "custom-image", "memory", "cpus", "memory-percentage",
+    "accelerator", "topology", "chips-per-host", "timeout", "host", "file"};
+
+Args parse_args(int argc, char** argv) {
+  Args a;
+  if (argc < 2) { usage(); std::exit(2); }
+  a.cmd = argv[1];
+  for (int i = 2; i < argc; ++i) {
+    std::string s = argv[i];
+    if (s.rfind("--", 0) == 0 || s == "-f") {
+      std::string key = s == "-f" ? "file" : s.substr(2);
+      if (kBoolFlags.count(key)) {
+        a.flags[key] = "true";
+      } else if (kValueFlags.count(key)) {
+        if (i + 1 >= argc) {
+          std::fprintf(stderr, "tpuk: %s needs a value\n", s.c_str());
+          std::exit(2);
+        }
+        a.flags[key] = argv[++i];
+      } else {
+        std::fprintf(stderr, "tpuk: unknown flag %s\n", s.c_str());
+        std::exit(2);
+      }
+    } else {
+      a.positional.push_back(s);
+    }
+  }
+  return a;
+}
+
+H2OTpu cr_from_args(const Args& a) {
+  H2OTpu cr;
+  cr.name = a.get("name");
+  if (cr.name.empty()) {
+    std::fprintf(stderr, "tpuk: --name is required\n");
+    std::exit(2);
+  }
+  cr.ns = a.get("namespace", "default");
+  cr.spec.nodes = a.get_int("cluster-size", 1);
+  cr.spec.version = a.get("version", "latest");
+  if (a.has("custom-image")) cr.spec.custom_image = a.get("custom-image");
+  cr.spec.resources.cpu = a.get("cpus", cr.spec.resources.cpu);
+  cr.spec.resources.memory = a.get("memory", cr.spec.resources.memory);
+  cr.spec.resources.memory_percentage =
+      a.get_int("memory-percentage", cr.spec.resources.memory_percentage);
+  cr.spec.tpu.accelerator = a.get("accelerator", cr.spec.tpu.accelerator);
+  cr.spec.tpu.topology = a.get("topology", cr.spec.tpu.topology);
+  cr.spec.tpu.chips_per_host =
+      a.get_int("chips-per-host", cr.spec.tpu.chips_per_host);
+  return cr;
+}
+
+std::unique_ptr<tpuk::ApiClient> client_from_args(const Args& a) {
+  tpuk::K8sConfig cfg;
+  if (a.has("server")) {
+    cfg.server = a.get("server");
+    cfg.token = a.get("token");
+    cfg.insecure_skip_verify = a.has("insecure");
+  } else {
+    cfg = tpuk::K8sConfig::resolve(a.get("kubeconfig"));
+  }
+  return tpuk::make_curl_client(cfg);
+}
+
+int cmd_deploy(const Args& a) {
+  H2OTpu cr = cr_from_args(a);
+  auto api = client_from_args(a);
+  tpuk::deploy_cluster(*api, cr);
+  tpuk::write_descriptor(cr);
+  std::printf("deployed %s/%s (%d nodes); descriptor: %s.tpuk\n",
+              cr.ns.c_str(), cr.name.c_str(), cr.spec.nodes,
+              cr.name.c_str());
+  int timeout = a.get_int("timeout", 300);
+  if (timeout > 0) {
+    if (tpuk::wait_ready(*api, cr, timeout)) {
+      std::printf("cluster ready; coordinator %s\n",
+                  tpuk::coordinator_address(cr).c_str());
+    } else {
+      std::fprintf(stderr, "tpuk: timed out after %ds waiting for ready\n",
+                   timeout);
+      return 1;
+    }
+  }
+  return 0;
+}
+
+int cmd_undeploy(const Args& a) {
+  std::string name = a.get("name");
+  std::string ns = a.get("namespace", "default");
+  if (a.has("file")) {
+    H2OTpu cr = tpuk::read_descriptor(a.get("file"));
+    name = cr.name;
+    ns = cr.ns;
+  }
+  if (name.empty()) {
+    std::fprintf(stderr, "tpuk: undeploy needs --name or -f descriptor\n");
+    return 2;
+  }
+  auto api = client_from_args(a);
+  tpuk::undeploy_cluster(*api, name, ns);
+  std::printf("undeployed %s/%s\n", ns.c_str(), name.c_str());
+  return 0;
+}
+
+int cmd_ingress(const Args& a) {
+  if (a.positional.empty() ||
+      (a.positional[0] != "add" && a.positional[0] != "delete")) {
+    std::fprintf(stderr, "tpuk: ingress add|delete\n");
+    return 2;
+  }
+  H2OTpu cr = cr_from_args(a);
+  auto api = client_from_args(a);
+  if (a.positional[0] == "add") {
+    tpuk::create_ingress(*api, cr, a.get("host"));
+    std::printf("ingress created for %s/%s\n", cr.ns.c_str(),
+                cr.name.c_str());
+  } else {
+    tpuk::delete_ingress(*api, cr.name, cr.ns);
+    std::printf("ingress deleted for %s/%s\n", cr.ns.c_str(),
+                cr.name.c_str());
+  }
+  return 0;
+}
+
+int cmd_status(const Args& a) {
+  H2OTpu cr = cr_from_args(a);
+  auto api = client_from_args(a);
+  tpuk::Response r =
+      api->request("GET", tpuk::statefulsets_path(cr.ns, cr.name));
+  if (r.not_found()) {
+    std::printf("%s/%s: not deployed\n", cr.ns.c_str(), cr.name.c_str());
+    return 1;
+  }
+  if (!r.ok()) {
+    std::fprintf(stderr, "tpuk: status failed (%ld): %s\n", r.status,
+                 r.body.c_str());
+    return 1;
+  }
+  tpuk::Json sts = r.json();
+  auto num = [&](const char* path) -> long long {
+    const tpuk::Json* v = sts.get_path(path);
+    return v && v->is_number() ? v->as_int() : 0;
+  };
+  std::printf("%s/%s: %lld/%lld ready (coordinator %s)\n", cr.ns.c_str(),
+              cr.name.c_str(), num("status.readyReplicas"),
+              num("spec.replicas"),
+              tpuk::coordinator_address(cr).c_str());
+  return 0;
+}
+
+int cmd_manifest(const Args& a) {
+  H2OTpu cr = cr_from_args(a);
+  tpuk::Json bundle = tpuk::Json::object();
+  bundle["service"] = tpuk::headless_service(cr);
+  bundle["statefulSet"] = tpuk::stateful_set(cr);
+  if (a.has("host")) bundle["ingress"] = tpuk::ingress(cr, a.get("host"));
+  bundle["customResource"] = cr.to_json();
+  bundle["customResourceDefinition"] = tpuk::crd_manifest();
+  std::printf("%s", bundle.dump(2).c_str());
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args a = parse_args(argc, argv);
+  try {
+    if (a.cmd == "deploy") return cmd_deploy(a);
+    if (a.cmd == "undeploy") return cmd_undeploy(a);
+    if (a.cmd == "ingress") return cmd_ingress(a);
+    if (a.cmd == "status") return cmd_status(a);
+    if (a.cmd == "manifest") return cmd_manifest(a);
+    if (a.cmd == "-h" || a.cmd == "--help" || a.cmd == "help") {
+      usage();
+      return 0;
+    }
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "tpuk: %s\n", e.what());
+    return 1;
+  }
+  std::fprintf(stderr, "tpuk: unknown command '%s'\n", a.cmd.c_str());
+  usage();
+  return 2;
+}

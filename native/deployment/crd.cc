@@ -1,0 +1,140 @@
+#include "crd.h"
+
+namespace tpuk {
+
+H2OTpuSpec H2OTpuSpec::from_json(const Json& spec) {
+  H2OTpuSpec s;
+  s.nodes = static_cast<int>(spec.int_or("nodes", 1));
+  if (s.nodes < 1) throw std::runtime_error("spec.nodes must be >= 1");
+  s.version = spec.string_or("version", "latest");
+  if (const Json* ci = spec.find("customImage"); ci && ci->is_string())
+    s.custom_image = ci->as_string();
+  if (const Json* r = spec.find("resources")) {
+    s.resources.cpu = r->string_or("cpu", s.resources.cpu);
+    s.resources.memory = r->string_or("memory", s.resources.memory);
+    s.resources.memory_percentage = static_cast<int>(
+        r->int_or("memoryPercentage", s.resources.memory_percentage));
+    if (s.resources.memory_percentage < 1 ||
+        s.resources.memory_percentage > 100)
+      throw std::runtime_error("spec.resources.memoryPercentage not in 1..100");
+  }
+  if (const Json* t = spec.find("tpu")) {
+    s.tpu.accelerator = t->string_or("accelerator", s.tpu.accelerator);
+    s.tpu.topology = t->string_or("topology", s.tpu.topology);
+    s.tpu.chips_per_host =
+        static_cast<int>(t->int_or("chipsPerHost", s.tpu.chips_per_host));
+    if (s.tpu.chips_per_host < 1)
+      throw std::runtime_error("spec.tpu.chipsPerHost must be >= 1");
+  }
+  return s;
+}
+
+Json H2OTpuSpec::to_json() const {
+  Json spec = Json::object();
+  spec["nodes"] = nodes;
+  spec["version"] = version;
+  if (custom_image) spec["customImage"] = *custom_image;
+  Json res = Json::object();
+  res["cpu"] = resources.cpu;
+  res["memory"] = resources.memory;
+  res["memoryPercentage"] = resources.memory_percentage;
+  spec["resources"] = res;
+  Json tpu_j = Json::object();
+  tpu_j["accelerator"] = tpu.accelerator;
+  tpu_j["topology"] = tpu.topology;
+  tpu_j["chipsPerHost"] = tpu.chips_per_host;
+  spec["tpu"] = tpu_j;
+  return spec;
+}
+
+H2OTpu H2OTpu::from_json(const Json& obj) {
+  H2OTpu cr;
+  const Json* meta = obj.find("metadata");
+  if (!meta) throw std::runtime_error("resource has no metadata");
+  cr.name = meta->string_or("name", "");
+  if (cr.name.empty()) throw std::runtime_error("resource has no name");
+  cr.ns = meta->string_or("namespace", "default");
+  cr.uid = meta->string_or("uid", "");
+  cr.resource_version = meta->string_or("resourceVersion", "");
+  cr.deleting = meta->find("deletionTimestamp") != nullptr;
+  if (const Json* fins = meta->find("finalizers"); fins && fins->is_array())
+    for (const Json& f : fins->as_array())
+      if (f.is_string() && f.as_string() == kFinalizer)
+        cr.has_finalizer = true;
+  const Json* spec = obj.find("spec");
+  cr.spec = spec ? H2OTpuSpec::from_json(*spec) : H2OTpuSpec{};
+  return cr;
+}
+
+Json H2OTpu::to_json() const {
+  Json obj = Json::object();
+  obj["apiVersion"] = std::string(kGroup) + "/" + kVersion;
+  obj["kind"] = kKind;
+  Json meta = Json::object();
+  meta["name"] = name;
+  meta["namespace"] = ns;
+  if (has_finalizer) meta["finalizers"] = Json(JsonArray{Json(kFinalizer)});
+  obj["metadata"] = meta;
+  obj["spec"] = spec.to_json();
+  return obj;
+}
+
+Json crd_manifest() {
+  // openAPIV3Schema kept permissive-but-typed, like the reference's
+  // schema for {nodes, version, resources} (crd.rs [U])
+  Json props = Json::object();
+  props["nodes"] = Json(JsonObject{{"type", Json("integer")},
+                                   {"minimum", Json(1)}});
+  props["version"] = Json(JsonObject{{"type", Json("string")}});
+  props["customImage"] = Json(JsonObject{{"type", Json("string")}});
+  Json res_props = Json::object();
+  res_props["cpu"] = Json(JsonObject{{"type", Json("string")}});
+  res_props["memory"] = Json(JsonObject{{"type", Json("string")}});
+  res_props["memoryPercentage"] = Json(JsonObject{
+      {"type", Json("integer")}, {"minimum", Json(1)},
+      {"maximum", Json(100)}});
+  props["resources"] = Json(JsonObject{{"type", Json("object")},
+                                       {"properties", Json(res_props)}});
+  Json tpu_props = Json::object();
+  tpu_props["accelerator"] = Json(JsonObject{{"type", Json("string")}});
+  tpu_props["topology"] = Json(JsonObject{{"type", Json("string")}});
+  tpu_props["chipsPerHost"] = Json(JsonObject{{"type", Json("integer")},
+                                              {"minimum", Json(1)}});
+  props["tpu"] = Json(JsonObject{{"type", Json("object")},
+                                 {"properties", Json(tpu_props)}});
+
+  Json schema = Json::object();
+  schema["type"] = "object";
+  schema["properties"] = Json(JsonObject{
+      {"spec", Json(JsonObject{{"type", Json("object")},
+                               {"properties", Json(props)}})},
+      {"status", Json(JsonObject{
+          {"type", Json("object")},
+          {"x-kubernetes-preserve-unknown-fields", Json(true)}})}});
+
+  Json version = Json::object();
+  version["name"] = kVersion;
+  version["served"] = true;
+  version["storage"] = true;
+  version["schema"] = Json(JsonObject{{"openAPIV3Schema", schema}});
+  version["subresources"] = Json(JsonObject{{"status", Json::object()}});
+
+  Json crd = Json::object();
+  crd["apiVersion"] = "apiextensions.k8s.io/v1";
+  crd["kind"] = "CustomResourceDefinition";
+  crd["metadata"] = Json(JsonObject{
+      {"name", Json(std::string(kPlural) + "." + kGroup)}});
+  Json spec = Json::object();
+  spec["group"] = kGroup;
+  spec["scope"] = "Namespaced";
+  spec["names"] = Json(JsonObject{
+      {"plural", Json(kPlural)},
+      {"singular", Json("h2otpu")},
+      {"kind", Json(kKind)},
+      {"shortNames", Json(JsonArray{Json("h2ot")})}});
+  spec["versions"] = Json(JsonArray{version});
+  crd["spec"] = spec;
+  return crd;
+}
+
+}  // namespace tpuk

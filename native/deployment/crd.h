@@ -1,0 +1,78 @@
+// The H2OTpu custom resource: declarative spec for a TPU-backed
+// h2o_kubernetes_tpu cluster, the analog of the reference's `kind: H2O`
+// CRD (group h2o.ai, spec {nodes, version|customImage,
+// resources{cpu,memory,memoryPercentage}} — deployment/src/crd.rs [U],
+// SURVEY.md §1a/§2a R3).  Differences are deliberate and TPU-first: the
+// spec names a TPU accelerator/topology (provisioned as GKE TPU slice
+// pods) and the injected env is the JAX distributed-runtime contract
+// (H2O_TPU_COORDINATOR / H2O_TPU_NUM_PROCESSES / H2O_TPU_PROCESS_ID,
+// consumed by h2o_kubernetes_tpu.runtime.mesh.initialize_distributed)
+// instead of H2O-3's flatfile DNS lookup vars.
+#pragma once
+
+#include <optional>
+#include <string>
+
+#include "json.h"
+
+namespace tpuk {
+
+inline constexpr const char* kGroup = "tpu.h2o.ai";
+inline constexpr const char* kVersion = "v1";
+inline constexpr const char* kKind = "H2OTpu";
+inline constexpr const char* kPlural = "h2otpus";
+inline constexpr const char* kFinalizer = "tpu.h2o.ai/finalizer";
+inline constexpr const char* kDefaultImage = "h2o-kubernetes-tpu";
+inline constexpr int kClientPort = 54321;   // REST/client port (reference's)
+inline constexpr int kCoordinatorPort = 8476;  // jax.distributed coordinator
+
+struct Resources {
+  std::string cpu = "4";        // k8s quantity
+  std::string memory = "16Gi";  // k8s quantity
+  // fraction of pod memory handed to the runtime process (the
+  // reference's memoryPercentage flag for the JVM -Xmx)
+  int memory_percentage = 90;
+};
+
+struct TpuSpec {
+  // GKE TPU nodeselector values, e.g. "tpu-v5-lite-podslice" / "2x4"
+  std::string accelerator = "tpu-v5-lite-podslice";
+  std::string topology = "2x4";
+  int chips_per_host = 4;       // google.com/tpu resource request
+};
+
+struct H2OTpuSpec {
+  int nodes = 1;                // hosts (pods); 1 pod slice = 1 cluster
+  std::string version = "latest";
+  std::optional<std::string> custom_image;
+  Resources resources;
+  TpuSpec tpu;
+
+  std::string image() const {
+    return custom_image ? *custom_image
+                        : std::string(kDefaultImage) + ":" + version;
+  }
+
+  static H2OTpuSpec from_json(const Json& spec);  // throws on bad spec
+  Json to_json() const;
+};
+
+// a named+namespaced custom resource as seen on the API server
+struct H2OTpu {
+  std::string name;
+  std::string ns = "default";
+  H2OTpuSpec spec;
+  std::string uid;               // set by the API server
+  std::string resource_version;  // set by the API server
+  bool deleting = false;         // deletionTimestamp present
+  bool has_finalizer = false;
+
+  static H2OTpu from_json(const Json& obj);
+  Json to_json() const;  // apiVersion/kind/metadata/spec (no status)
+};
+
+// the CustomResourceDefinition manifest the operator ensures at startup
+// (reference: operator ensures `h2os.h2o.ai` exists — SURVEY.md §3.2)
+Json crd_manifest();
+
+}  // namespace tpuk

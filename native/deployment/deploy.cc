@@ -1,0 +1,121 @@
+#include "deploy.h"
+
+#include <chrono>
+#include <fstream>
+#include <stdexcept>
+#include <thread>
+
+#include "manifests.h"
+
+namespace tpuk {
+
+std::string services_path(const std::string& ns, const std::string& name) {
+  std::string p = "/api/v1/namespaces/" + ns + "/services";
+  return name.empty() ? p : p + "/" + name;
+}
+
+std::string statefulsets_path(const std::string& ns,
+                              const std::string& name) {
+  std::string p = "/apis/apps/v1/namespaces/" + ns + "/statefulsets";
+  return name.empty() ? p : p + "/" + name;
+}
+
+std::string ingresses_path(const std::string& ns, const std::string& name) {
+  std::string p =
+      "/apis/networking.k8s.io/v1/namespaces/" + ns + "/ingresses";
+  return name.empty() ? p : p + "/" + name;
+}
+
+std::string h2otpus_path(const std::string& ns, const std::string& name) {
+  std::string p = std::string("/apis/") + kGroup + "/" + kVersion +
+                  "/namespaces/" + ns + "/" + kPlural;
+  return name.empty() ? p : p + "/" + name;
+}
+
+std::string crd_path() {
+  return std::string("/apis/apiextensions.k8s.io/v1/"
+                     "customresourcedefinitions/") +
+         kPlural + "." + kGroup;
+}
+
+namespace {
+
+void create_tolerating_conflict(ApiClient& api, const std::string& path,
+                                const Json& manifest,
+                                const std::string& what) {
+  Response r = api.request("POST", path, manifest.dump());
+  if (!r.ok() && !r.conflict())
+    throw std::runtime_error("create " + what + " failed (" +
+                             std::to_string(r.status) + "): " + r.body);
+}
+
+void delete_tolerating_missing(ApiClient& api, const std::string& path,
+                               const std::string& what) {
+  Response r = api.request("DELETE", path);
+  if (!r.ok() && !r.not_found())
+    throw std::runtime_error("delete " + what + " failed (" +
+                             std::to_string(r.status) + "): " + r.body);
+}
+
+}  // namespace
+
+void deploy_cluster(ApiClient& api, const H2OTpu& cr) {
+  create_tolerating_conflict(api, services_path(cr.ns),
+                             headless_service(cr), "service " + cr.name);
+  create_tolerating_conflict(api, statefulsets_path(cr.ns),
+                             stateful_set(cr), "statefulset " + cr.name);
+}
+
+void undeploy_cluster(ApiClient& api, const std::string& name,
+                      const std::string& ns) {
+  delete_tolerating_missing(api, statefulsets_path(ns, name),
+                            "statefulset " + name);
+  delete_tolerating_missing(api, services_path(ns, name), "service " + name);
+  delete_tolerating_missing(api, ingresses_path(ns, name), "ingress " + name);
+}
+
+void create_ingress(ApiClient& api, const H2OTpu& cr,
+                    const std::string& host) {
+  create_tolerating_conflict(api, ingresses_path(cr.ns), ingress(cr, host),
+                             "ingress " + cr.name);
+}
+
+void delete_ingress(ApiClient& api, const std::string& name,
+                    const std::string& ns) {
+  delete_tolerating_missing(api, ingresses_path(ns, name), "ingress " + name);
+}
+
+bool wait_ready(ApiClient& api, const H2OTpu& cr, int timeout_s,
+                int poll_interval_s) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::seconds(timeout_s);
+  while (std::chrono::steady_clock::now() < deadline) {
+    Response r = api.request("GET", statefulsets_path(cr.ns, cr.name));
+    if (r.ok()) {
+      Json sts = r.json();
+      if (const Json* ready = sts.get_path("status.readyReplicas");
+          ready && ready->is_number() &&
+          ready->as_int() >= cr.spec.nodes)
+        return true;
+    }
+    std::this_thread::sleep_for(std::chrono::seconds(poll_interval_s));
+  }
+  return false;
+}
+
+void write_descriptor(const H2OTpu& cr, const std::string& dir) {
+  std::string path = dir + "/" + cr.name + ".tpuk";
+  std::ofstream f(path, std::ios::trunc);
+  if (!f) throw std::runtime_error("cannot write " + path);
+  f << cr.to_json().dump(2);
+}
+
+H2OTpu read_descriptor(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot read " + path);
+  std::string text((std::istreambuf_iterator<char>(f)),
+                   std::istreambuf_iterator<char>());
+  return H2OTpu::from_json(Json::parse(text));
+}
+
+}  // namespace tpuk

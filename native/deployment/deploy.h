@@ -1,0 +1,40 @@
+// Cluster lifecycle: create/delete/wait — the deployment crate's
+// deploy_h2o_cluster / undeploy_h2o_cluster equivalents (SURVEY.md §3.1:
+// create Service → create StatefulSet → poll ready → write descriptor).
+#pragma once
+
+#include <string>
+
+#include "crd.h"
+#include "k8s_client.h"
+
+namespace tpuk {
+
+// API path helpers
+std::string services_path(const std::string& ns, const std::string& name = "");
+std::string statefulsets_path(const std::string& ns,
+                              const std::string& name = "");
+std::string ingresses_path(const std::string& ns,
+                           const std::string& name = "");
+std::string h2otpus_path(const std::string& ns, const std::string& name = "");
+std::string crd_path();
+
+// create headless Service + StatefulSet (idempotent: 409 tolerated)
+void deploy_cluster(ApiClient& api, const H2OTpu& cr);
+// delete StatefulSet + Service (+ Ingress), 404-tolerant
+void undeploy_cluster(ApiClient& api, const std::string& name,
+                      const std::string& ns);
+void create_ingress(ApiClient& api, const H2OTpu& cr,
+                    const std::string& host);
+void delete_ingress(ApiClient& api, const std::string& name,
+                    const std::string& ns);
+// poll StatefulSet status.readyReplicas == spec.nodes
+bool wait_ready(ApiClient& api, const H2OTpu& cr, int timeout_s,
+                int poll_interval_s = 2);
+
+// <name>.tpuk descriptor, written after deploy so undeploy can find the
+// resources later (the reference CLI's <name>.h2ok file — SURVEY §2a R1)
+void write_descriptor(const H2OTpu& cr, const std::string& dir = ".");
+H2OTpu read_descriptor(const std::string& path);
+
+}  // namespace tpuk

@@ -1,0 +1,278 @@
+#include "json.h"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace tpuk {
+
+Json& Json::operator[](const std::string& key) {
+  if (type_ == Type::Null) {
+    type_ = Type::Object;
+    obj_ = std::make_shared<JsonObject>();
+  }
+  check(Type::Object);
+  return (*obj_)[key];
+}
+
+const Json* Json::find(const std::string& key) const {
+  if (type_ != Type::Object) return nullptr;
+  auto it = obj_->find(key);
+  return it == obj_->end() ? nullptr : &it->second;
+}
+
+const Json* Json::get_path(const std::string& dotted) const {
+  const Json* cur = this;
+  size_t start = 0;
+  while (start <= dotted.size()) {
+    size_t dot = dotted.find('.', start);
+    std::string key = dotted.substr(
+        start, dot == std::string::npos ? std::string::npos : dot - start);
+    cur = cur->find(key);
+    if (!cur) return nullptr;
+    if (dot == std::string::npos) break;
+    start = dot + 1;
+  }
+  return cur;
+}
+
+std::string Json::string_or(const std::string& key,
+                            const std::string& fallback) const {
+  const Json* v = find(key);
+  return v && v->is_string() ? v->as_string() : fallback;
+}
+
+int64_t Json::int_or(const std::string& key, int64_t fallback) const {
+  const Json* v = find(key);
+  return v && v->is_number() ? v->as_int() : fallback;
+}
+
+namespace {
+
+void escape_to(const std::string& s, std::string& out) {
+  out += '"';
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  out += '"';
+}
+
+void number_to(double v, std::string& out) {
+  if (std::isfinite(v) && v == std::floor(v) &&
+      std::fabs(v) < 9.0e15) {  // integral — keep manifests int-typed
+    char buf[32];
+    std::snprintf(buf, sizeof buf, "%lld", static_cast<long long>(v));
+    out += buf;
+  } else {
+    char buf[32];
+    std::snprintf(buf, sizeof buf, "%.17g", v);
+    out += buf;
+  }
+}
+
+}  // namespace
+
+void Json::dump_to(std::string& out, int indent, int depth) const {
+  auto pad = [&](int d) {
+    if (indent >= 0) {
+      out += '\n';
+      out.append(static_cast<size_t>(indent) * d, ' ');
+    }
+  };
+  switch (type_) {
+    case Type::Null: out += "null"; break;
+    case Type::Bool: out += bool_ ? "true" : "false"; break;
+    case Type::Number: number_to(num_, out); break;
+    case Type::String: escape_to(str_, out); break;
+    case Type::Array: {
+      if (arr_->empty()) { out += "[]"; break; }
+      out += '[';
+      bool first = true;
+      for (const Json& v : *arr_) {
+        if (!first) out += ',';
+        first = false;
+        pad(depth + 1);
+        v.dump_to(out, indent, depth + 1);
+      }
+      pad(depth);
+      out += ']';
+      break;
+    }
+    case Type::Object: {
+      if (obj_->empty()) { out += "{}"; break; }
+      out += '{';
+      bool first = true;
+      for (const auto& [k, v] : *obj_) {
+        if (!first) out += ',';
+        first = false;
+        pad(depth + 1);
+        escape_to(k, out);
+        out += indent >= 0 ? ": " : ":";
+        v.dump_to(out, indent, depth + 1);
+      }
+      pad(depth);
+      out += '}';
+      break;
+    }
+  }
+}
+
+std::string Json::dump(int indent) const {
+  std::string out;
+  dump_to(out, indent, 0);
+  if (indent >= 0) out += '\n';
+  return out;
+}
+
+namespace {
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  [[noreturn]] void fail(const std::string& why) {
+    throw std::runtime_error("json parse error: " + why);
+  }
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+
+  bool consume(char c) {
+    skip_ws();
+    if (p < end && *p == c) { ++p; return true; }
+    return false;
+  }
+
+  void expect(char c) {
+    if (!consume(c)) fail(std::string("expected '") + c + "'");
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string s;
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\') {
+        if (p >= end) fail("bad escape");
+        char e = *p++;
+        switch (e) {
+          case '"': s += '"'; break;
+          case '\\': s += '\\'; break;
+          case '/': s += '/'; break;
+          case 'b': s += '\b'; break;
+          case 'f': s += '\f'; break;
+          case 'n': s += '\n'; break;
+          case 'r': s += '\r'; break;
+          case 't': s += '\t'; break;
+          case 'u': {
+            if (end - p < 4) fail("bad \\u escape");
+            unsigned cp = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = *p++;
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp += h - '0';
+              else if (h >= 'a' && h <= 'f') cp += h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp += h - 'A' + 10;
+              else fail("bad \\u digit");
+            }
+            // UTF-8 encode (surrogate pairs unsupported; K8s names are
+            // ASCII — fail loudly rather than corrupt)
+            if (cp >= 0xD800 && cp <= 0xDFFF) fail("surrogates unsupported");
+            if (cp < 0x80) s += static_cast<char>(cp);
+            else if (cp < 0x800) {
+              s += static_cast<char>(0xC0 | (cp >> 6));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            } else {
+              s += static_cast<char>(0xE0 | (cp >> 12));
+              s += static_cast<char>(0x80 | ((cp >> 6) & 0x3F));
+              s += static_cast<char>(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: fail("bad escape char");
+        }
+      } else {
+        s += c;
+      }
+    }
+    expect('"');
+    return s;
+  }
+
+  Json parse_value() {
+    skip_ws();
+    if (p >= end) fail("unexpected end");
+    char c = *p;
+    if (c == '{') {
+      ++p;
+      JsonObject obj;
+      skip_ws();
+      if (consume('}')) return Json(std::move(obj));
+      while (true) {
+        std::string key = parse_string();
+        expect(':');
+        obj.emplace(std::move(key), parse_value());
+        if (consume('}')) break;
+        expect(',');
+      }
+      return Json(std::move(obj));
+    }
+    if (c == '[') {
+      ++p;
+      JsonArray arr;
+      skip_ws();
+      if (consume(']')) return Json(std::move(arr));
+      while (true) {
+        arr.push_back(parse_value());
+        if (consume(']')) break;
+        expect(',');
+      }
+      return Json(std::move(arr));
+    }
+    if (c == '"') return Json(parse_string());
+    if (std::strncmp(p, "true", 4) == 0 && end - p >= 4) {
+      p += 4; return Json(true);
+    }
+    if (std::strncmp(p, "false", 5) == 0 && end - p >= 5) {
+      p += 5; return Json(false);
+    }
+    if (std::strncmp(p, "null", 4) == 0 && end - p >= 4) {
+      p += 4; return Json(nullptr);
+    }
+    // number
+    char* num_end = nullptr;
+    double v = std::strtod(p, &num_end);
+    if (num_end == p) fail("bad token");
+    p = num_end;
+    return Json(v);
+  }
+};
+
+}  // namespace
+
+Json Json::parse(const std::string& text) {
+  Parser parser{text.data(), text.data() + text.size()};
+  Json v = parser.parse_value();
+  parser.skip_ws();
+  if (parser.p != parser.end) parser.fail("trailing content");
+  return v;
+}
+
+}  // namespace tpuk

@@ -1,0 +1,92 @@
+// Minimal JSON value: parse + serialize, just enough for Kubernetes
+// manifests and API responses.  The reference deployment stack leans on
+// serde for this (deployment/src/crd.rs [U], SURVEY.md §2a R3); with no
+// JSON library in this toolchain we carry our own ~small implementation
+// instead of vendoring one.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace tpuk {
+
+class Json;
+using JsonArray = std::vector<Json>;
+// std::map keeps keys sorted -> deterministic serialization, which the
+// golden-file tests rely on.
+using JsonObject = std::map<std::string, Json>;
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Json() : type_(Type::Null) {}
+  Json(std::nullptr_t) : type_(Type::Null) {}
+  Json(bool b) : type_(Type::Bool), bool_(b) {}
+  Json(int v) : type_(Type::Number), num_(v) {}
+  Json(int64_t v) : type_(Type::Number), num_(static_cast<double>(v)) {}
+  Json(double v) : type_(Type::Number), num_(v) {}
+  Json(const char* s) : type_(Type::String), str_(s) {}
+  Json(std::string s) : type_(Type::String), str_(std::move(s)) {}
+  Json(JsonArray a)
+      : type_(Type::Array), arr_(std::make_shared<JsonArray>(std::move(a))) {}
+  Json(JsonObject o)
+      : type_(Type::Object),
+        obj_(std::make_shared<JsonObject>(std::move(o))) {}
+
+  static Json object() { return Json(JsonObject{}); }
+  static Json array() { return Json(JsonArray{}); }
+
+  Type type() const { return type_; }
+  bool is_null() const { return type_ == Type::Null; }
+  bool is_object() const { return type_ == Type::Object; }
+  bool is_array() const { return type_ == Type::Array; }
+  bool is_string() const { return type_ == Type::String; }
+  bool is_number() const { return type_ == Type::Number; }
+  bool is_bool() const { return type_ == Type::Bool; }
+
+  bool as_bool() const { check(Type::Bool); return bool_; }
+  double as_number() const { check(Type::Number); return num_; }
+  int64_t as_int() const {
+    check(Type::Number);
+    return static_cast<int64_t>(num_);
+  }
+  const std::string& as_string() const { check(Type::String); return str_; }
+  const JsonArray& as_array() const { check(Type::Array); return *arr_; }
+  JsonArray& as_array() { check(Type::Array); return *arr_; }
+  const JsonObject& as_object() const { check(Type::Object); return *obj_; }
+  JsonObject& as_object() { check(Type::Object); return *obj_; }
+
+  // object field access; operator[] inserts (like nlohmann), get() doesn't
+  Json& operator[](const std::string& key);
+  const Json* find(const std::string& key) const;
+  // dotted-path lookup for tests/reconcile: get_path("spec.nodes")
+  const Json* get_path(const std::string& dotted) const;
+
+  // string "a" or number fallback helpers used by spec parsing
+  std::string string_or(const std::string& key,
+                        const std::string& fallback) const;
+  int64_t int_or(const std::string& key, int64_t fallback) const;
+
+  std::string dump(int indent = -1) const;
+  static Json parse(const std::string& text);  // throws std::runtime_error
+
+ private:
+  void check(Type t) const {
+    if (type_ != t) throw std::runtime_error("json: wrong type access");
+  }
+  void dump_to(std::string& out, int indent, int depth) const;
+
+  Type type_;
+  bool bool_ = false;
+  double num_ = 0;
+  std::string str_;
+  std::shared_ptr<JsonArray> arr_;
+  std::shared_ptr<JsonObject> obj_;
+};
+
+}  // namespace tpuk

@@ -1,0 +1,497 @@
+#include "k8s_client.h"
+
+#include <dlfcn.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <stdexcept>
+#include <vector>
+
+namespace tpuk {
+
+// ---------------------------------------------------------------- yaml
+
+namespace {
+
+struct YamlLine {
+  int indent;
+  std::string content;  // stripped of indent and trailing comment
+};
+
+std::string strip_comment(const std::string& s) {
+  bool in_s = false, in_d = false;
+  for (size_t i = 0; i < s.size(); ++i) {
+    char c = s[i];
+    if (c == '\'' && !in_d) in_s = !in_s;
+    else if (c == '"' && !in_s) in_d = !in_d;
+    else if (c == '#' && !in_s && !in_d &&
+             (i == 0 || s[i - 1] == ' ' || s[i - 1] == '\t'))
+      return s.substr(0, i);
+  }
+  return s;
+}
+
+std::string trim(const std::string& s) {
+  size_t a = s.find_first_not_of(" \t\r");
+  if (a == std::string::npos) return "";
+  size_t b = s.find_last_not_of(" \t\r");
+  return s.substr(a, b - a + 1);
+}
+
+Json scalar(const std::string& raw) {
+  std::string v = trim(raw);
+  if (v.size() >= 2 && ((v.front() == '"' && v.back() == '"') ||
+                        (v.front() == '\'' && v.back() == '\'')))
+    return Json(v.substr(1, v.size() - 2));
+  if (v == "null" || v == "~" || v.empty()) return Json(nullptr);
+  if (v == "true") return Json(true);
+  if (v == "false") return Json(false);
+  char* end = nullptr;
+  double d = std::strtod(v.c_str(), &end);
+  if (end && *end == '\0' && end != v.c_str()) return Json(d);
+  return Json(v);
+}
+
+Json parse_block(const std::vector<YamlLine>& lines, size_t& i, int indent);
+
+Json parse_entry_value(const std::vector<YamlLine>& lines, size_t& i,
+                       int parent_indent, const std::string& inline_val) {
+  std::string v = trim(inline_val);
+  if (!v.empty()) return scalar(v);
+  // value on following deeper-indented lines (map or list); YAML also
+  // allows list items at the PARENT key's indent (the kubectl layout)
+  if (i < lines.size() &&
+      (lines[i].indent > parent_indent ||
+       (lines[i].indent == parent_indent &&
+        (lines[i].content.rfind("- ", 0) == 0 || lines[i].content == "-"))))
+    return parse_block(lines, i, lines[i].indent);
+  return Json(nullptr);
+}
+
+Json parse_block(const std::vector<YamlLine>& lines, size_t& i, int indent) {
+  if (i >= lines.size()) return Json(nullptr);
+  if (lines[i].content.rfind("- ", 0) == 0 || lines[i].content == "-") {
+    JsonArray arr;
+    while (i < lines.size() && lines[i].indent == indent &&
+           (lines[i].content.rfind("- ", 0) == 0 || lines[i].content == "-")) {
+      std::string rest = lines[i].content == "-"
+                             ? ""
+                             : trim(lines[i].content.substr(2));
+      ++i;
+      if (rest.empty()) {
+        arr.push_back(parse_entry_value(lines, i, indent, ""));
+      } else if (rest.find(": ") != std::string::npos ||
+                 rest.back() == ':') {
+        // "- key: val" opens an inline map; fold in subsequent deeper
+        // keys (the kubectl kubeconfig list-of-maps shape)
+        size_t colon = rest.find(':');
+        std::string k = trim(rest.substr(0, colon));
+        std::string v = colon + 1 < rest.size() ? rest.substr(colon + 1) : "";
+        JsonObject obj;
+        obj.emplace(k, parse_entry_value(lines, i, indent, v));
+        while (i < lines.size() && lines[i].indent > indent &&
+               lines[i].content.rfind("- ", 0) != 0) {
+          const std::string& c = lines[i].content;
+          size_t c2 = c.find(':');
+          if (c2 == std::string::npos)
+            throw std::runtime_error("yaml: bad mapping line: " + c);
+          std::string k2 = trim(c.substr(0, c2));
+          std::string v2 = c2 + 1 < c.size() ? c.substr(c2 + 1) : "";
+          int child_indent = lines[i].indent;
+          ++i;
+          obj.emplace(k2, parse_entry_value(lines, i, child_indent, v2));
+        }
+        arr.push_back(Json(std::move(obj)));
+      } else {
+        arr.push_back(scalar(rest));
+      }
+    }
+    return Json(std::move(arr));
+  }
+  JsonObject obj;
+  while (i < lines.size() && lines[i].indent == indent) {
+    const std::string& c = lines[i].content;
+    if (c.rfind("- ", 0) == 0) break;
+    size_t colon = c.find(':');
+    if (colon == std::string::npos)
+      throw std::runtime_error("yaml: bad mapping line: " + c);
+    std::string k = trim(c.substr(0, colon));
+    if (k.size() >= 2 && ((k.front() == '"' && k.back() == '"') ||
+                          (k.front() == '\'' && k.back() == '\'')))
+      k = k.substr(1, k.size() - 2);
+    std::string v = colon + 1 < c.size() ? c.substr(colon + 1) : "";
+    ++i;
+    obj.emplace(k, parse_entry_value(lines, i, indent, v));
+  }
+  return Json(std::move(obj));
+}
+
+}  // namespace
+
+Json yaml_to_json(const std::string& text) {
+  std::vector<YamlLine> lines;
+  std::istringstream in(text);
+  std::string raw;
+  while (std::getline(in, raw)) {
+    std::string s = strip_comment(raw);
+    size_t ind = s.find_first_not_of(' ');
+    if (ind == std::string::npos) continue;
+    std::string content = trim(s.substr(ind));
+    if (content.empty() || content == "---") continue;
+    lines.push_back({static_cast<int>(ind), content});
+  }
+  if (lines.empty()) return Json(nullptr);
+  size_t i = 0;
+  return parse_block(lines, i, lines[0].indent);
+}
+
+// -------------------------------------------------------------- config
+
+namespace {
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw std::runtime_error("cannot read " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+bool file_exists(const std::string& path) {
+  return ::access(path.c_str(), R_OK) == 0;
+}
+
+// base64 decode (kubeconfig *-data fields)
+std::string b64_decode(const std::string& in) {
+  auto val = [](char c) -> int {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+  };
+  std::string out;
+  int buf = 0, bits = 0;
+  for (char c : in) {
+    int v = val(c);
+    if (v < 0) continue;
+    buf = (buf << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out += static_cast<char>((buf >> bits) & 0xFF);
+    }
+  }
+  return out;
+}
+
+// write decoded cert material to a private temp file, return its path
+std::string materialize(const std::string& data, const std::string& tag) {
+  std::string tmpl = "/tmp/tpuk-" + tag + "-XXXXXX";
+  std::vector<char> buf(tmpl.begin(), tmpl.end());
+  buf.push_back('\0');
+  int fd = ::mkstemp(buf.data());
+  if (fd < 0) throw std::runtime_error("mkstemp failed for " + tag);
+  std::string decoded = b64_decode(data);
+  ssize_t n = ::write(fd, decoded.data(), decoded.size());
+  ::close(fd);
+  if (n != static_cast<ssize_t>(decoded.size()))
+    throw std::runtime_error("short write for " + tag);
+  return std::string(buf.data());
+}
+
+const Json* find_named(const Json& list, const std::string& name) {
+  if (!list.is_array()) return nullptr;
+  for (const Json& item : list.as_array())
+    if (const Json* n = item.find("name");
+        n && n->is_string() && n->as_string() == name)
+      return &item;
+  return nullptr;
+}
+
+}  // namespace
+
+K8sConfig K8sConfig::in_cluster() {
+  const char* host = std::getenv("KUBERNETES_SERVICE_HOST");
+  const char* port = std::getenv("KUBERNETES_SERVICE_PORT");
+  if (!host || !port)
+    throw std::runtime_error("not in cluster (no KUBERNETES_SERVICE_HOST)");
+  K8sConfig c;
+  c.server = std::string("https://") + host + ":" + port;
+  const char* base = "/var/run/secrets/kubernetes.io/serviceaccount";
+  c.token = trim(read_file(std::string(base) + "/token"));
+  std::string ca = std::string(base) + "/ca.crt";
+  if (file_exists(ca)) c.ca_cert_path = ca;
+  return c;
+}
+
+K8sConfig K8sConfig::from_kubeconfig(const std::string& path) {
+  std::string text = read_file(path);
+  Json cfg;
+  try {
+    cfg = Json::parse(text);  // kubeconfigs may be JSON outright
+  } catch (const std::exception&) {
+    cfg = yaml_to_json(text);
+  }
+  std::string ctx_name = cfg.string_or("current-context", "");
+  const Json* contexts = cfg.find("contexts");
+  const Json* ctx_entry =
+      contexts && !ctx_name.empty() ? find_named(*contexts, ctx_name)
+      : (contexts && contexts->is_array() && !contexts->as_array().empty()
+             ? &contexts->as_array()[0]
+             : nullptr);
+  if (!ctx_entry) throw std::runtime_error("kubeconfig: no usable context");
+  const Json* ctx = ctx_entry->find("context");
+  if (!ctx) throw std::runtime_error("kubeconfig: context missing body");
+
+  const Json* clusters = cfg.find("clusters");
+  const Json* cluster_entry =
+      clusters ? find_named(*clusters, ctx->string_or("cluster", ""))
+               : nullptr;
+  if (!cluster_entry) throw std::runtime_error("kubeconfig: cluster missing");
+  const Json* cluster = cluster_entry->find("cluster");
+  if (!cluster) throw std::runtime_error("kubeconfig: cluster missing body");
+
+  K8sConfig c;
+  c.server = cluster->string_or("server", "");
+  if (c.server.empty()) throw std::runtime_error("kubeconfig: no server");
+  if (const Json* ca = cluster->find("certificate-authority");
+      ca && ca->is_string())
+    c.ca_cert_path = ca->as_string();
+  else if (const Json* cad = cluster->find("certificate-authority-data");
+           cad && cad->is_string())
+    c.ca_cert_path = materialize(cad->as_string(), "ca");
+  if (const Json* skip = cluster->find("insecure-skip-tls-verify");
+      skip && skip->is_bool())
+    c.insecure_skip_verify = skip->as_bool();
+
+  const Json* users = cfg.find("users");
+  const Json* user_entry =
+      users ? find_named(*users, ctx->string_or("user", "")) : nullptr;
+  if (user_entry) {
+    const Json* user = user_entry->find("user");
+    if (user) {
+      c.token = user->string_or("token", "");
+      if (const Json* cc = user->find("client-certificate");
+          cc && cc->is_string())
+        c.client_cert_path = cc->as_string();
+      else if (const Json* ccd = user->find("client-certificate-data");
+               ccd && ccd->is_string())
+        c.client_cert_path = materialize(ccd->as_string(), "cert");
+      if (const Json* ck = user->find("client-key"); ck && ck->is_string())
+        c.client_key_path = ck->as_string();
+      else if (const Json* ckd = user->find("client-key-data");
+               ckd && ckd->is_string())
+        c.client_key_path = materialize(ckd->as_string(), "key");
+    }
+  }
+  return c;
+}
+
+K8sConfig K8sConfig::resolve(const std::string& explicit_path) {
+  if (!explicit_path.empty()) return from_kubeconfig(explicit_path);
+  if (const char* env = std::getenv("KUBECONFIG"); env && *env)
+    return from_kubeconfig(env);
+  if (const char* home = std::getenv("HOME")) {
+    std::string def = std::string(home) + "/.kube/config";
+    if (file_exists(def)) return from_kubeconfig(def);
+  }
+  return in_cluster();
+}
+
+// ---------------------------------------------------------------- curl
+
+namespace {
+
+// hand-declared slice of the libcurl C ABI (stable since 7.x); the
+// toolchain ships libcurl.so.4 but no headers
+using CURL = void;
+struct curl_slist;
+
+constexpr int CURLOPT_WRITEDATA = 10001;
+constexpr int CURLOPT_URL = 10002;
+constexpr int CURLOPT_POSTFIELDS = 10015;
+constexpr int CURLOPT_HTTPHEADER = 10023;
+constexpr int CURLOPT_CUSTOMREQUEST = 10036;
+constexpr int CURLOPT_POSTFIELDSIZE = 60;
+constexpr int CURLOPT_SSL_VERIFYPEER = 64;
+constexpr int CURLOPT_CAINFO = 10065;
+constexpr int CURLOPT_SSL_VERIFYHOST = 81;
+constexpr int CURLOPT_SSLCERT = 10025;
+constexpr int CURLOPT_SSLKEY = 10087;
+constexpr int CURLOPT_WRITEFUNCTION = 20011;
+constexpr int CURLOPT_TIMEOUT = 13;
+constexpr int CURLOPT_NOSIGNAL = 99;
+constexpr int CURLINFO_RESPONSE_CODE = 0x200000 + 2;
+
+struct CurlApi {
+  CURL* (*easy_init)();
+  int (*easy_setopt)(CURL*, int, ...);
+  int (*easy_perform)(CURL*);
+  void (*easy_cleanup)(CURL*);
+  int (*easy_getinfo)(CURL*, int, ...);
+  curl_slist* (*slist_append)(curl_slist*, const char*);
+  void (*slist_free_all)(curl_slist*);
+  const char* (*easy_strerror)(int);
+
+  static const CurlApi& get() {
+    static CurlApi api = load();
+    return api;
+  }
+
+ private:
+  static CurlApi load() {
+    void* lib = ::dlopen("libcurl.so.4", RTLD_NOW | RTLD_GLOBAL);
+    if (!lib) lib = ::dlopen("libcurl-gnutls.so.4", RTLD_NOW | RTLD_GLOBAL);
+    if (!lib)
+      throw std::runtime_error(std::string("cannot load libcurl: ") +
+                               ::dlerror());
+    CurlApi api;
+    auto sym = [&](const char* name) {
+      void* p = ::dlsym(lib, name);
+      if (!p)
+        throw std::runtime_error(std::string("libcurl missing symbol ") +
+                                 name);
+      return p;
+    };
+    api.easy_init = reinterpret_cast<CURL* (*)()>(sym("curl_easy_init"));
+    api.easy_setopt = reinterpret_cast<int (*)(CURL*, int, ...)>(
+        sym("curl_easy_setopt"));
+    api.easy_perform =
+        reinterpret_cast<int (*)(CURL*)>(sym("curl_easy_perform"));
+    api.easy_cleanup =
+        reinterpret_cast<void (*)(CURL*)>(sym("curl_easy_cleanup"));
+    api.easy_getinfo = reinterpret_cast<int (*)(CURL*, int, ...)>(
+        sym("curl_easy_getinfo"));
+    api.slist_append = reinterpret_cast<curl_slist* (*)(
+        curl_slist*, const char*)>(sym("curl_slist_append"));
+    api.slist_free_all = reinterpret_cast<void (*)(curl_slist*)>(
+        sym("curl_slist_free_all"));
+    api.easy_strerror =
+        reinterpret_cast<const char* (*)(int)>(sym("curl_easy_strerror"));
+    return api;
+  }
+};
+
+size_t collect_body(char* data, size_t size, size_t nmemb, void* userp) {
+  auto* out = static_cast<std::string*>(userp);
+  out->append(data, size * nmemb);
+  return size * nmemb;
+}
+
+struct LineSink {
+  std::string pending;
+  const std::function<void(const std::string&)>* on_line;
+};
+
+size_t collect_lines(char* data, size_t size, size_t nmemb, void* userp) {
+  auto* sink = static_cast<LineSink*>(userp);
+  sink->pending.append(data, size * nmemb);
+  size_t pos;
+  while ((pos = sink->pending.find('\n')) != std::string::npos) {
+    std::string line = sink->pending.substr(0, pos);
+    sink->pending.erase(0, pos + 1);
+    if (!line.empty()) (*sink->on_line)(line);
+  }
+  return size * nmemb;
+}
+
+class CurlClient final : public ApiClient {
+ public:
+  explicit CurlClient(K8sConfig config) : config_(std::move(config)) {}
+
+  Response request(const std::string& method, const std::string& path,
+                   const std::string& body,
+                   const std::string& content_type) override {
+    const CurlApi& api = CurlApi::get();
+    CURL* h = api.easy_init();
+    if (!h) throw std::runtime_error("curl_easy_init failed");
+    Response resp;
+    curl_slist* headers = build_headers(api, content_type);
+    std::string url = config_.server + path;
+    api.easy_setopt(h, CURLOPT_URL, url.c_str());
+    api.easy_setopt(h, CURLOPT_CUSTOMREQUEST, method.c_str());
+    api.easy_setopt(h, CURLOPT_NOSIGNAL, 1L);
+    api.easy_setopt(h, CURLOPT_TIMEOUT, 60L);
+    api.easy_setopt(h, CURLOPT_HTTPHEADER, headers);
+    apply_tls(api, h);
+    if (!body.empty()) {
+      api.easy_setopt(h, CURLOPT_POSTFIELDS, body.c_str());
+      api.easy_setopt(h, CURLOPT_POSTFIELDSIZE,
+                      static_cast<long>(body.size()));
+    }
+    api.easy_setopt(h, CURLOPT_WRITEFUNCTION, &collect_body);
+    api.easy_setopt(h, CURLOPT_WRITEDATA, &resp.body);
+    int rc = api.easy_perform(h);
+    if (rc == 0) api.easy_getinfo(h, CURLINFO_RESPONSE_CODE, &resp.status);
+    api.slist_free_all(headers);
+    api.easy_cleanup(h);
+    if (rc != 0)
+      throw std::runtime_error(std::string("curl: ") +
+                               api.easy_strerror(rc) + " for " + url);
+    return resp;
+  }
+
+  bool watch(const std::string& path,
+             const std::function<void(const std::string&)>& on_line,
+             long timeout_s) override {
+    const CurlApi& api = CurlApi::get();
+    CURL* h = api.easy_init();
+    if (!h) throw std::runtime_error("curl_easy_init failed");
+    curl_slist* headers = build_headers(api, "application/json");
+    std::string url = config_.server + path;
+    LineSink sink{{}, &on_line};
+    api.easy_setopt(h, CURLOPT_URL, url.c_str());
+    api.easy_setopt(h, CURLOPT_NOSIGNAL, 1L);
+    api.easy_setopt(h, CURLOPT_TIMEOUT, timeout_s);
+    api.easy_setopt(h, CURLOPT_HTTPHEADER, headers);
+    apply_tls(api, h);
+    api.easy_setopt(h, CURLOPT_WRITEFUNCTION, &collect_lines);
+    api.easy_setopt(h, CURLOPT_WRITEDATA, &sink);
+    int rc = api.easy_perform(h);
+    api.slist_free_all(headers);
+    api.easy_cleanup(h);
+    // timeout (rc 28) is the normal end of a watch window
+    return rc == 0 || rc == 28;
+  }
+
+ private:
+  curl_slist* build_headers(const CurlApi& api,
+                            const std::string& content_type) {
+    curl_slist* headers = nullptr;
+    headers = api.slist_append(
+        headers, ("Content-Type: " + content_type).c_str());
+    headers = api.slist_append(headers, "Accept: application/json");
+    if (!config_.token.empty())
+      headers = api.slist_append(
+          headers, ("Authorization: Bearer " + config_.token).c_str());
+    return headers;
+  }
+
+  void apply_tls(const CurlApi& api, CURL* h) {
+    if (config_.insecure_skip_verify) {
+      api.easy_setopt(h, CURLOPT_SSL_VERIFYPEER, 0L);
+      api.easy_setopt(h, CURLOPT_SSL_VERIFYHOST, 0L);
+    } else if (!config_.ca_cert_path.empty()) {
+      api.easy_setopt(h, CURLOPT_CAINFO, config_.ca_cert_path.c_str());
+    }
+    if (!config_.client_cert_path.empty())
+      api.easy_setopt(h, CURLOPT_SSLCERT, config_.client_cert_path.c_str());
+    if (!config_.client_key_path.empty())
+      api.easy_setopt(h, CURLOPT_SSLKEY, config_.client_key_path.c_str());
+  }
+
+  K8sConfig config_;
+};
+
+}  // namespace
+
+std::unique_ptr<ApiClient> make_curl_client(const K8sConfig& config) {
+  return std::make_unique<CurlClient>(config);
+}
+
+}  // namespace tpuk

@@ -1,0 +1,71 @@
+// Kubernetes API client — the role kube/k8s-openapi play for the
+// reference (SURVEY.md §2a R3: "client bootstrap from kubeconfig").
+// HTTP rides the system libcurl loaded via dlopen (no dev headers in
+// this toolchain; the curl C ABI is stable).  ApiClient is an
+// interface so the controller/deploy logic tests run against an
+// in-memory fake — the manifests and reconcile decisions are what the
+// golden tests pin down, per VERDICT round 1 ("golden-file tests for
+// the generated manifests (no cluster needed)").
+#pragma once
+
+#include <functional>
+#include <memory>
+#include <string>
+
+#include "json.h"
+
+namespace tpuk {
+
+struct Response {
+  long status = 0;
+  std::string body;
+
+  bool ok() const { return status >= 200 && status < 300; }
+  bool not_found() const { return status == 404; }
+  bool conflict() const { return status == 409; }
+  Json json() const { return Json::parse(body); }
+};
+
+class ApiClient {
+ public:
+  virtual ~ApiClient() = default;
+  // method: GET/POST/PUT/DELETE/PATCH; path: absolute API path;
+  // content_type matters for PATCH (strategic vs merge vs json-patch)
+  virtual Response request(const std::string& method,
+                           const std::string& path,
+                           const std::string& body = "",
+                           const std::string& content_type =
+                               "application/json") = 0;
+  // streaming watch: invokes on_line for every newline-delimited JSON
+  // event until the server closes or timeout_s elapses; returns false
+  // on transport error (caller re-lists and re-watches)
+  virtual bool watch(const std::string& path,
+                     const std::function<void(const std::string&)>& on_line,
+                     long timeout_s) = 0;
+};
+
+struct K8sConfig {
+  std::string server;        // https://host:port
+  std::string token;         // bearer token ("" = none)
+  std::string ca_cert_path;  // "" = system roots
+  std::string client_cert_path;
+  std::string client_key_path;
+  bool insecure_skip_verify = false;
+
+  // in-cluster service account (env + mounted secrets)
+  static K8sConfig in_cluster();
+  // kubeconfig file: native JSON kubeconfigs and the standard
+  // kubectl-generated YAML layout (subset parser; no anchors/flow)
+  static K8sConfig from_kubeconfig(const std::string& path);
+  // resolution order of the reference's client bootstrap: explicit
+  // path > $KUBECONFIG > ~/.kube/config > in-cluster
+  static K8sConfig resolve(const std::string& explicit_path = "");
+};
+
+std::unique_ptr<ApiClient> make_curl_client(const K8sConfig& config);
+
+// minimal YAML(subset)->Json used for kubeconfigs; exposed for tests.
+// Supports nested maps/lists by indentation, scalars, quotes, comments.
+Json yaml_to_json(const std::string& text);
+
+}  // namespace tpuk

@@ -1,0 +1,192 @@
+#include "manifests.h"
+
+namespace tpuk {
+
+namespace {
+
+Json labels_for(const H2OTpu& cr) {
+  Json l = Json::object();
+  l["app"] = cr.name;
+  l["app.kubernetes.io/managed-by"] = "tpuk";
+  return l;
+}
+
+Json env_var(const std::string& name, const std::string& value) {
+  return Json(JsonObject{{"name", Json(name)}, {"value", Json(value)}});
+}
+
+Json env_from_label(const std::string& name, const std::string& label) {
+  Json field = Json::object();
+  field["fieldPath"] = "metadata.labels['" + label + "']";
+  Json source = Json::object();
+  source["fieldRef"] = field;
+  return Json(JsonObject{{"name", Json(name)}, {"valueFrom", source}});
+}
+
+}  // namespace
+
+std::string coordinator_address(const H2OTpu& cr) {
+  // pod-0's stable DNS name through the headless service
+  return cr.name + "-0." + cr.name + "." + cr.ns + ".svc.cluster.local:" +
+         std::to_string(kCoordinatorPort);
+}
+
+Json owner_reference(const H2OTpu& cr) {
+  Json ref = Json::object();
+  ref["apiVersion"] = std::string(kGroup) + "/" + kVersion;
+  ref["kind"] = kKind;
+  ref["name"] = cr.name;
+  if (!cr.uid.empty()) ref["uid"] = cr.uid;
+  ref["controller"] = true;
+  ref["blockOwnerDeletion"] = true;
+  return ref;
+}
+
+Json headless_service(const H2OTpu& cr) {
+  Json svc = Json::object();
+  svc["apiVersion"] = "v1";
+  svc["kind"] = "Service";
+  Json meta = Json::object();
+  meta["name"] = cr.name;
+  meta["namespace"] = cr.ns;
+  meta["labels"] = labels_for(cr);
+  if (!cr.uid.empty())
+    meta["ownerReferences"] = Json(JsonArray{owner_reference(cr)});
+  svc["metadata"] = meta;
+
+  Json spec = Json::object();
+  spec["clusterIP"] = "None";  // headless: per-pod DNS records
+  spec["selector"] = Json(JsonObject{{"app", Json(cr.name)}});
+  // publish addresses before readiness so the coordinator (pod-0) is
+  // resolvable while peers are still starting — the same bootstrapping
+  // need the reference's DNS lookup loop has during cloud formation
+  spec["publishNotReadyAddresses"] = true;
+  Json client_port = Json::object();
+  client_port["name"] = "client";
+  client_port["port"] = kClientPort;
+  client_port["protocol"] = "TCP";
+  Json coord_port = Json::object();
+  coord_port["name"] = "coordinator";
+  coord_port["port"] = kCoordinatorPort;
+  coord_port["protocol"] = "TCP";
+  spec["ports"] = Json(JsonArray{client_port, coord_port});
+  svc["spec"] = spec;
+  return svc;
+}
+
+Json stateful_set(const H2OTpu& cr) {
+  const H2OTpuSpec& s = cr.spec;
+
+  Json container = Json::object();
+  container["name"] = "h2o-tpu";
+  container["image"] = s.image();
+  Json env = Json::array();
+  env.as_array().push_back(
+      env_var("H2O_TPU_COORDINATOR", coordinator_address(cr)));
+  env.as_array().push_back(
+      env_var("H2O_TPU_NUM_PROCESSES", std::to_string(s.nodes)));
+  // the StatefulSet controller stamps every pod with its ordinal in
+  // the apps.kubernetes.io/pod-index label; downward API turns it
+  // into the process id the JAX distributed runtime needs
+  env.as_array().push_back(
+      env_from_label("H2O_TPU_PROCESS_ID", "apps.kubernetes.io/pod-index"));
+  env.as_array().push_back(env_var(
+      "H2O_TPU_MEMORY_PERCENTAGE",
+      std::to_string(s.resources.memory_percentage)));
+  container["env"] = env;
+
+  Json ports = Json::array();
+  ports.as_array().push_back(Json(JsonObject{
+      {"containerPort", Json(kClientPort)}, {"name", Json("client")}}));
+  ports.as_array().push_back(Json(JsonObject{
+      {"containerPort", Json(kCoordinatorPort)},
+      {"name", Json("coordinator")}}));
+  container["ports"] = ports;
+
+  Json requests = Json::object();
+  requests["cpu"] = s.resources.cpu;
+  requests["memory"] = s.resources.memory;
+  requests["google.com/tpu"] = std::to_string(s.tpu.chips_per_host);
+  Json limits = Json::object();
+  limits["memory"] = s.resources.memory;
+  limits["google.com/tpu"] = std::to_string(s.tpu.chips_per_host);
+  container["resources"] = Json(JsonObject{{"requests", requests},
+                                           {"limits", limits}});
+
+  // leader-only readiness, like the reference's /kubernetes/isLeaderNode:
+  // clients routed through the service reach a formed cluster only
+  Json probe = Json::object();
+  probe["httpGet"] = Json(JsonObject{
+      {"path", Json("/3/Cloud")}, {"port", Json(kClientPort)}});
+  probe["initialDelaySeconds"] = 10;
+  probe["periodSeconds"] = 5;
+  container["readinessProbe"] = probe;
+
+  Json pod_spec = Json::object();
+  pod_spec["containers"] = Json(JsonArray{container});
+  Json selector = Json::object();
+  selector["cloud.google.com/gke-tpu-accelerator"] = s.tpu.accelerator;
+  selector["cloud.google.com/gke-tpu-topology"] = s.tpu.topology;
+  pod_spec["nodeSelector"] = selector;
+  // TPU slices are all-or-nothing: never restart a single pod into a
+  // locked cluster (the reference's clouds cannot absorb rejoins either
+  // — SURVEY.md §5.3); the operator recreates the whole set instead
+  pod_spec["restartPolicy"] = "Always";
+
+  Json pod_meta = Json::object();
+  pod_meta["labels"] = labels_for(cr);
+
+  Json tmpl = Json::object();
+  tmpl["metadata"] = pod_meta;
+  tmpl["spec"] = pod_spec;
+
+  Json sts_spec = Json::object();
+  sts_spec["serviceName"] = cr.name;
+  sts_spec["replicas"] = s.nodes;
+  sts_spec["podManagementPolicy"] = "Parallel";  // all hosts boot at once
+  sts_spec["selector"] = Json(JsonObject{
+      {"matchLabels", Json(JsonObject{{"app", Json(cr.name)}})}});
+  sts_spec["template"] = tmpl;
+
+  Json sts = Json::object();
+  sts["apiVersion"] = "apps/v1";
+  sts["kind"] = "StatefulSet";
+  Json meta = Json::object();
+  meta["name"] = cr.name;
+  meta["namespace"] = cr.ns;
+  meta["labels"] = labels_for(cr);
+  if (!cr.uid.empty())
+    meta["ownerReferences"] = Json(JsonArray{owner_reference(cr)});
+  sts["metadata"] = meta;
+  sts["spec"] = sts_spec;
+  return sts;
+}
+
+Json ingress(const H2OTpu& cr, const std::string& host) {
+  Json backend = Json::object();
+  backend["service"] = Json(JsonObject{
+      {"name", Json(cr.name)},
+      {"port", Json(JsonObject{{"number", Json(kClientPort)}})}});
+  Json path = Json::object();
+  path["path"] = "/";
+  path["pathType"] = "Prefix";
+  path["backend"] = backend;
+  Json rule = Json::object();
+  if (!host.empty()) rule["host"] = host;
+  rule["http"] = Json(JsonObject{{"paths", Json(JsonArray{path})}});
+
+  Json ing = Json::object();
+  ing["apiVersion"] = "networking.k8s.io/v1";
+  ing["kind"] = "Ingress";
+  Json meta = Json::object();
+  meta["name"] = cr.name;
+  meta["namespace"] = cr.ns;
+  meta["labels"] = labels_for(cr);
+  if (!cr.uid.empty())
+    meta["ownerReferences"] = Json(JsonArray{owner_reference(cr)});
+  ing["metadata"] = meta;
+  ing["spec"] = Json(JsonObject{{"rules", Json(JsonArray{rule})}});
+  return ing;
+}
+
+}  // namespace tpuk

@@ -1,0 +1,38 @@
+// Kubernetes resource construction for an H2OTpu cluster — the analog
+// of the reference deployment crate's StatefulSet/Service/Ingress
+// builders (deployment/src/lib.rs, ingress.rs [U]; SURVEY.md §2a R3,
+// §3.1).  The reference injects H2O_KUBERNETES_SERVICE_DNS /
+// H2O_NODE_EXPECTED_COUNT / H2O_NODE_LOOKUP_TIMEOUT so H2O-3's k8s
+// module can DNS-discover peers; here the pods form a JAX distributed
+// runtime instead, so the injected contract is H2O_TPU_COORDINATOR
+// (pod-0's stable DNS name via the headless Service),
+// H2O_TPU_NUM_PROCESSES (spec.nodes) and H2O_TPU_PROCESS_ID (the pod's
+// StatefulSet ordinal, read from the apps.kubernetes.io/pod-index
+// label via the downward API).
+#pragma once
+
+#include <string>
+
+#include "crd.h"
+#include "json.h"
+
+namespace tpuk {
+
+// headless Service (clusterIP: None) — stable per-pod DNS, the
+// discovery substrate (same move as the reference's service)
+Json headless_service(const H2OTpu& cr);
+
+// StatefulSet sized to spec.nodes with TPU nodeselectors, resource
+// requests (cpu/memory + google.com/tpu), and the clustering env
+Json stateful_set(const H2OTpu& cr);
+
+// Ingress routing external clients to the leader (pod-0) service port
+Json ingress(const H2OTpu& cr, const std::string& host);
+
+// ownerReference blocks child GC on the parent CR (plus our finalizer
+// mirrors the reference's delete path)
+Json owner_reference(const H2OTpu& cr);
+
+std::string coordinator_address(const H2OTpu& cr);
+
+}  // namespace tpuk

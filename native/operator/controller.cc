@@ -1,0 +1,203 @@
+#include "controller.h"
+
+#include <chrono>
+#include <cstdio>
+#include <thread>
+
+#include "../deployment/deploy.h"
+#include "../deployment/manifests.h"
+
+namespace tpuk {
+
+namespace {
+
+void log_line(const std::string& msg) {
+  std::fprintf(stderr, "[operator] %s\n", msg.c_str());
+}
+
+// add/remove OUR finalizer only: read the live list first so
+// finalizers owned by other controllers survive (merge-patch replaces
+// arrays wholesale)
+void patch_finalizers(ApiClient& api, const H2OTpu& cr, bool present) {
+  Response cur = api.request("GET", h2otpus_path(cr.ns, cr.name));
+  if (cur.not_found()) return;
+  if (!cur.ok())
+    throw std::runtime_error("finalizer read failed (" +
+                             std::to_string(cur.status) + "): " + cur.body);
+  Json body = cur.json();  // keep alive: get_path returns a view into it
+  Json fins = Json::array();
+  bool have_ours = false;
+  if (const Json* live = body.get_path("metadata.finalizers");
+      live && live->is_array())
+    for (const Json& f : live->as_array()) {
+      if (f.is_string() && f.as_string() == kFinalizer) {
+        have_ours = true;
+        if (!present) continue;  // drop ours, keep the rest
+      }
+      fins.as_array().push_back(f);
+    }
+  if (present) {
+    if (have_ours) return;  // already there
+    fins.as_array().push_back(Json(kFinalizer));
+  } else if (!have_ours) {
+    return;
+  }
+  Json patch = Json::object();
+  patch["metadata"] = Json(JsonObject{{"finalizers", fins}});
+  Response r = api.request("PATCH", h2otpus_path(cr.ns, cr.name),
+                           patch.dump(), "application/merge-patch+json");
+  if (!r.ok() && !r.not_found())
+    throw std::runtime_error("finalizer patch failed (" +
+                             std::to_string(r.status) + "): " + r.body);
+}
+
+void patch_status(ApiClient& api, const H2OTpu& cr,
+                  const std::string& phase, int64_t ready) {
+  Json status = Json::object();
+  status["phase"] = phase;
+  status["readyNodes"] = ready;
+  status["coordinator"] = coordinator_address(cr);
+  Json patch = Json::object();
+  patch["status"] = status;
+  // status subresource; merge-patch keeps this a single round trip
+  Response r = api.request("PATCH",
+                           h2otpus_path(cr.ns, cr.name) + "/status",
+                           patch.dump(), "application/merge-patch+json");
+  if (!r.ok() && !r.not_found())
+    log_line("status patch failed (" + std::to_string(r.status) + ") for " +
+             cr.ns + "/" + cr.name);
+}
+
+}  // namespace
+
+bool ensure_crd(ApiClient& api) {
+  Response r = api.request("GET", crd_path());
+  if (r.ok()) return false;
+  if (!r.not_found())
+    throw std::runtime_error("CRD get failed (" + std::to_string(r.status) +
+                             "): " + r.body);
+  Response c = api.request(
+      "POST", "/apis/apiextensions.k8s.io/v1/customresourcedefinitions",
+      crd_manifest().dump());
+  if (!c.ok() && !c.conflict())
+    throw std::runtime_error("CRD create failed (" +
+                             std::to_string(c.status) + "): " + c.body);
+  return c.ok();
+}
+
+std::string reconcile(ApiClient& api, const H2OTpu& cr) {
+  if (cr.deleting) {
+    // teardown, then release the finalizer so K8s GC completes
+    undeploy_cluster(api, cr.name, cr.ns);
+    if (cr.has_finalizer) patch_finalizers(api, cr, false);
+    return "deleted";
+  }
+  std::string action;
+  if (!cr.has_finalizer) {
+    patch_finalizers(api, cr, true);
+    action += "finalizer ";
+  }
+  // ensure service
+  Response svc = api.request("GET", services_path(cr.ns, cr.name));
+  if (svc.not_found()) {
+    Response r = api.request("POST", services_path(cr.ns),
+                             headless_service(cr).dump());
+    if (!r.ok() && !r.conflict())
+      throw std::runtime_error("service create failed (" +
+                               std::to_string(r.status) + "): " + r.body);
+    action += "service ";
+  }
+  // ensure statefulset at the right size
+  Response sts = api.request("GET", statefulsets_path(cr.ns, cr.name));
+  int64_t ready = 0;
+  if (sts.not_found()) {
+    Response r = api.request("POST", statefulsets_path(cr.ns),
+                             stateful_set(cr).dump());
+    if (!r.ok() && !r.conflict())
+      throw std::runtime_error("statefulset create failed (" +
+                               std::to_string(r.status) + "): " + r.body);
+    action += "statefulset ";
+  } else if (sts.ok()) {
+    Json body = sts.json();
+    if (const Json* rd = body.get_path("status.readyReplicas");
+        rd && rd->is_number())
+      ready = rd->as_int();
+    const Json* replicas = body.get_path("spec.replicas");
+    if (replicas && replicas->is_number() &&
+        replicas->as_int() != cr.spec.nodes) {
+      // spec drift: a TPU cluster cannot resize in place (the cloud
+      // locks at formation — SURVEY.md §5.3), so recreate wholesale
+      Json patch = Json::object();
+      patch["spec"] = Json(JsonObject{{"replicas", Json(cr.spec.nodes)}});
+      Response r =
+          api.request("PATCH", statefulsets_path(cr.ns, cr.name),
+                      patch.dump(), "application/merge-patch+json");
+      if (!r.ok())
+        throw std::runtime_error("statefulset scale failed (" +
+                                 std::to_string(r.status) + "): " + r.body);
+      action += "rescale ";
+    }
+  }
+  patch_status(api, cr, ready >= cr.spec.nodes ? "Ready" : "Forming",
+               ready);
+  return action.empty() ? "noop" : action;
+}
+
+void run_operator(ApiClient& api, long watch_timeout_s) {
+  ensure_crd(api);
+  log_line("CRD ensured; entering watch loop");
+  std::string all_path =
+      std::string("/apis/") + kGroup + "/" + kVersion + "/" + kPlural;
+  int backoff_s = 1;
+  while (true) {
+    std::string resource_version;
+    try {
+      Response list = api.request("GET", all_path);
+      if (!list.ok())
+        throw std::runtime_error("list failed (" +
+                                 std::to_string(list.status) + ")");
+      Json body = list.json();
+      if (const Json* rv = body.get_path("metadata.resourceVersion");
+          rv && rv->is_string())
+        resource_version = rv->as_string();
+      if (const Json* items = body.find("items"); items && items->is_array())
+        for (const Json& item : items->as_array()) {
+          H2OTpu cr = H2OTpu::from_json(item);
+          try {
+            log_line(cr.ns + "/" + cr.name + ": " + reconcile(api, cr));
+          } catch (const std::exception& e) {
+            log_line(cr.ns + "/" + cr.name + ": reconcile error: " +
+                     e.what());
+          }
+        }
+      backoff_s = 1;
+    } catch (const std::exception& e) {
+      log_line(std::string("list error: ") + e.what() + "; backoff " +
+               std::to_string(backoff_s) + "s");
+      std::this_thread::sleep_for(std::chrono::seconds(backoff_s));
+      backoff_s = std::min(backoff_s * 2, 60);
+      continue;
+    }
+    std::string watch_path = all_path + "?watch=true&resourceVersion=" +
+                             resource_version;
+    api.watch(watch_path, [&](const std::string& line) {
+      try {
+        Json event = Json::parse(line);
+        const Json* type = event.find("type");
+        const Json* obj = event.find("object");
+        if (!type || !obj) return;
+        if (type->as_string() == "ERROR") {
+          log_line("watch ERROR event: " + line.substr(0, 200));
+          return;
+        }
+        H2OTpu cr = H2OTpu::from_json(*obj);
+        log_line(cr.ns + "/" + cr.name + " [" + type->as_string() + "]: " +
+                 reconcile(api, cr));
+      } catch (const std::exception& e) {
+        log_line(std::string("watch event error: ") + e.what());
+      }
+    }, watch_timeout_s);
+  }
+}
+
+}  // namespace tpuk

@@ -1,0 +1,26 @@
+// Reconcile loop for H2OTpu resources — the reference operator's
+// controller (operator/src/controller.rs [U]; SURVEY.md §3.2):
+// ensure CRD at startup, watch H2O resources, Applied → finalizer +
+// Service/StatefulSet, Deleted → teardown + finalizer removal,
+// idempotent re-reconcile on every event, errors → requeue w/ backoff.
+#pragma once
+
+#include <string>
+
+#include "../deployment/crd.h"
+#include "../deployment/k8s_client.h"
+
+namespace tpuk {
+
+// create the CRD if absent; true if created, false if it existed
+bool ensure_crd(ApiClient& api);
+
+// one idempotent reconcile of a single resource; returns a short
+// human-readable action summary (used by logs and tests)
+std::string reconcile(ApiClient& api, const H2OTpu& cr);
+
+// list+watch loop; runs until the process is stopped. watch_timeout_s
+// bounds each watch window (the loop re-lists after every window).
+void run_operator(ApiClient& api, long watch_timeout_s = 300);
+
+}  // namespace tpuk

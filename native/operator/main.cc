@@ -1,0 +1,55 @@
+// h2o-tpu-operator — long-running controller (the reference's
+// operator/src/main.rs [U]): ensure the CRD exists, then watch H2OTpu
+// resources and reconcile (SURVEY.md §3.2).
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "../deployment/k8s_client.h"
+#include "controller.h"
+
+namespace {
+
+void usage() {
+  std::fprintf(stderr,
+               "usage: h2o-tpu-operator [--kubeconfig PATH]"
+               " [--server URL --token TOKEN [--insecure]]\n"
+               "Defaults to $KUBECONFIG, ~/.kube/config, then in-cluster"
+               " config.\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string kubeconfig, server, token;
+  bool insecure = false;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      if (i + 1 >= argc) { usage(); std::exit(2); }
+      return argv[++i];
+    };
+    if (a == "--kubeconfig") kubeconfig = next();
+    else if (a == "--server") server = next();
+    else if (a == "--token") token = next();
+    else if (a == "--insecure") insecure = true;
+    else if (a == "-h" || a == "--help") { usage(); return 0; }
+    else { usage(); return 2; }
+  }
+  try {
+    tpuk::K8sConfig cfg;
+    if (!server.empty()) {
+      cfg.server = server;
+      cfg.token = token;
+      cfg.insecure_skip_verify = insecure;
+    } else {
+      cfg = tpuk::K8sConfig::resolve(kubeconfig);
+    }
+    auto api = tpuk::make_curl_client(cfg);
+    tpuk::run_operator(*api);
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "h2o-tpu-operator: fatal: %s\n", e.what());
+    return 1;
+  }
+  return 0;
+}

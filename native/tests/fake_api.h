@@ -1,0 +1,125 @@
+// In-memory Kubernetes API double for controller/deploy tests.  The
+// reference tests against a live k3s cluster (SURVEY.md §4a); with no
+// cluster in this environment the reconcile logic is pinned down
+// against this store plus golden manifests instead.  It implements
+// just the verbs/paths the deployment stack uses: GET/POST/DELETE on
+// collection+item paths and merge-PATCH on items (+ /status).
+#pragma once
+
+#include <map>
+#include <string>
+
+#include "../deployment/json.h"
+#include "../deployment/k8s_client.h"
+
+namespace tpuk_test {
+
+class FakeApi final : public tpuk::ApiClient {
+ public:
+  std::map<std::string, tpuk::Json> store;  // item path -> object
+  std::vector<std::string> log;             // "METHOD path"
+
+  tpuk::Response request(const std::string& method, const std::string& path,
+                         const std::string& body,
+                         const std::string& /*content_type*/) override {
+    log.push_back(method + " " + strip_query(path));
+    std::string p = strip_query(path);
+    if (method == "GET") return get(p);
+    if (method == "POST") return post(p, body);
+    if (method == "DELETE") return del(p);
+    if (method == "PATCH") return patch(p, body);
+    return {405, "method not allowed"};
+  }
+
+  bool watch(const std::string&,
+             const std::function<void(const std::string&)>&,
+             long) override {
+    return true;  // tests drive reconcile() directly
+  }
+
+ private:
+  static std::string strip_query(const std::string& path) {
+    size_t q = path.find('?');
+    return q == std::string::npos ? path : path.substr(0, q);
+  }
+
+  tpuk::Response get(const std::string& path) {
+    auto it = store.find(path);
+    if (it != store.end()) return {200, it->second.dump()};
+    if (!is_collection_path(path))
+      return {404, R"({"kind":"Status","code":404})"};
+    tpuk::Json list = tpuk::Json::object();
+    tpuk::JsonArray items;
+    for (const auto& [k, v] : store)
+      if (k.rfind(path + "/", 0) == 0 &&
+          k.find('/', path.size() + 1) == std::string::npos)
+        items.push_back(v);
+    list["items"] = tpuk::Json(std::move(items));
+    list["metadata"] = tpuk::Json(tpuk::JsonObject{
+        {"resourceVersion", tpuk::Json("1")}});
+    return {200, list.dump()};
+  }
+
+  tpuk::Response post(const std::string& path, const std::string& body) {
+    tpuk::Json obj = tpuk::Json::parse(body);
+    const tpuk::Json* name = obj.get_path("metadata.name");
+    if (!name || !name->is_string()) return {422, "no metadata.name"};
+    std::string item = path + "/" + name->as_string();
+    if (store.count(item)) return {409, "exists"};
+    store[item] = obj;
+    return {201, obj.dump()};
+  }
+
+  tpuk::Response del(const std::string& path) {
+    if (!store.count(path)) return {404, "not found"};
+    store.erase(path);
+    return {200, "{}"};
+  }
+
+  tpuk::Response patch(const std::string& path, const std::string& body) {
+    // "/status" patches apply to the parent object's status field
+    std::string target = path;
+    bool status_sub = false;
+    if (target.size() > 7 && target.rfind("/status") == target.size() - 7) {
+      target = target.substr(0, target.size() - 7);
+      status_sub = true;
+    }
+    auto it = store.find(target);
+    if (it == store.end()) return {404, "not found"};
+    tpuk::Json patch_body = tpuk::Json::parse(body);
+    merge(it->second, patch_body);
+    (void)status_sub;
+    return {200, it->second.dump()};
+  }
+
+  // RFC 7386 merge patch
+  static void merge(tpuk::Json& target, const tpuk::Json& patch) {
+    if (!patch.is_object() || !target.is_object()) {
+      target = patch;
+      return;
+    }
+    for (const auto& [k, v] : patch.as_object()) {
+      if (v.is_null()) {
+        target.as_object().erase(k);
+      } else if (v.is_object() && target.find(k) &&
+                 target.find(k)->is_object()) {
+        merge(target[k], v);
+      } else {
+        target[k] = v;
+      }
+    }
+  }
+
+  // collection iff the final path segment is a known resource plural
+  // (item paths end with an object name instead)
+  static bool is_collection_path(const std::string& path) {
+    size_t slash = path.find_last_of('/');
+    std::string last =
+        slash == std::string::npos ? path : path.substr(slash + 1);
+    return last == "services" || last == "statefulsets" ||
+           last == "ingresses" || last == "h2otpus" ||
+           last == "customresourcedefinitions";
+  }
+};
+
+}  // namespace tpuk_test

@@ -1,0 +1,177 @@
+// Reconcile-behavior tests against the in-memory API double: apply →
+// finalizer+children created; idempotency; drift repair; delete →
+// children gone + finalizer released (the reference's operator flows,
+// SURVEY.md §3.2).
+#include "../operator/controller.h"
+
+#include "../deployment/deploy.h"
+#include "fake_api.h"
+#include "test_util.h"
+
+using tpuk::H2OTpu;
+using tpuk::Json;
+using tpuk_test::FakeApi;
+
+namespace {
+
+H2OTpu make_cr(int nodes = 3) {
+  H2OTpu cr;
+  cr.name = "demo";
+  cr.ns = "ml";
+  cr.uid = "u1";
+  cr.spec.nodes = nodes;
+  return cr;
+}
+
+// put the CR itself into the fake store (as the API server would hold it)
+void store_cr(FakeApi& api, const H2OTpu& cr) {
+  api.store[tpuk::h2otpus_path(cr.ns, cr.name)] = cr.to_json();
+}
+
+}  // namespace
+
+TEST(ensure_crd_creates_then_noops) {
+  FakeApi api;
+  CHECK(tpuk::ensure_crd(api));
+  CHECK(api.store.count(
+      "/apis/apiextensions.k8s.io/v1/customresourcedefinitions/"
+      "h2otpus.tpu.h2o.ai"));
+  CHECK(!tpuk::ensure_crd(api));  // second call finds it
+}
+
+TEST(reconcile_creates_children_and_finalizer) {
+  FakeApi api;
+  H2OTpu cr = make_cr();
+  store_cr(api, cr);
+  std::string action = tpuk::reconcile(api, cr);
+  CHECK(action.find("service") != std::string::npos);
+  CHECK(action.find("statefulset") != std::string::npos);
+  CHECK(action.find("finalizer") != std::string::npos);
+  CHECK(api.store.count(tpuk::services_path("ml", "demo")));
+  CHECK(api.store.count(tpuk::statefulsets_path("ml", "demo")));
+  // finalizer patched onto the stored CR
+  const Json& stored = api.store[tpuk::h2otpus_path("ml", "demo")];
+  CHECK(stored.get_path("metadata.finalizers") != nullptr);
+  // status written
+  CHECK_EQ(stored.get_path("status.phase")->as_string(), "Forming");
+}
+
+TEST(reconcile_is_idempotent) {
+  FakeApi api;
+  H2OTpu cr = make_cr();
+  store_cr(api, cr);
+  tpuk::reconcile(api, cr);
+  cr.has_finalizer = true;  // as it would arrive on the next event
+  CHECK_EQ(tpuk::reconcile(api, cr), "noop");
+}
+
+TEST(reconcile_repairs_replica_drift) {
+  FakeApi api;
+  H2OTpu cr = make_cr(3);
+  store_cr(api, cr);
+  tpuk::reconcile(api, cr);
+  cr.has_finalizer = true;
+  // someone scaled the statefulset by hand
+  Json& sts = api.store[tpuk::statefulsets_path("ml", "demo")];
+  sts["spec"]["replicas"] = 7;
+  std::string action = tpuk::reconcile(api, cr);
+  CHECK(action.find("rescale") != std::string::npos);
+  CHECK_EQ(api.store[tpuk::statefulsets_path("ml", "demo")]
+               .get_path("spec.replicas")->as_int(),
+           3);
+}
+
+TEST(reconcile_reports_ready_status) {
+  FakeApi api;
+  H2OTpu cr = make_cr(2);
+  store_cr(api, cr);
+  tpuk::reconcile(api, cr);
+  cr.has_finalizer = true;
+  Json& sts = api.store[tpuk::statefulsets_path("ml", "demo")];
+  sts["status"] = Json(tpuk::JsonObject{{"readyReplicas", Json(2)}});
+  tpuk::reconcile(api, cr);
+  CHECK_EQ(api.store[tpuk::h2otpus_path("ml", "demo")]
+               .get_path("status.phase")->as_string(),
+           "Ready");
+}
+
+TEST(reconcile_delete_tears_down_and_releases_finalizer) {
+  FakeApi api;
+  H2OTpu cr = make_cr();
+  store_cr(api, cr);
+  tpuk::reconcile(api, cr);
+  cr.has_finalizer = true;
+  cr.deleting = true;
+  CHECK_EQ(tpuk::reconcile(api, cr), "deleted");
+  CHECK(!api.store.count(tpuk::services_path("ml", "demo")));
+  CHECK(!api.store.count(tpuk::statefulsets_path("ml", "demo")));
+  const Json& stored = api.store[tpuk::h2otpus_path("ml", "demo")];
+  CHECK(stored.get_path("metadata.finalizers")->as_array().empty());
+}
+
+TEST(reconcile_delete_tolerates_missing_children) {
+  FakeApi api;
+  H2OTpu cr = make_cr();
+  store_cr(api, cr);
+  cr.deleting = true;
+  cr.has_finalizer = true;
+  CHECK_EQ(tpuk::reconcile(api, cr), "deleted");  // nothing existed: fine
+}
+
+TEST(deploy_and_undeploy_cluster) {
+  FakeApi api;
+  H2OTpu cr = make_cr();
+  tpuk::deploy_cluster(api, cr);
+  CHECK(api.store.count(tpuk::services_path("ml", "demo")));
+  CHECK(api.store.count(tpuk::statefulsets_path("ml", "demo")));
+  tpuk::deploy_cluster(api, cr);  // idempotent: 409s tolerated
+  tpuk::undeploy_cluster(api, "demo", "ml");
+  CHECK(!api.store.count(tpuk::services_path("ml", "demo")));
+  CHECK(!api.store.count(tpuk::statefulsets_path("ml", "demo")));
+  tpuk::undeploy_cluster(api, "demo", "ml");  // 404s tolerated
+}
+
+TEST(wait_ready_polls_status) {
+  FakeApi api;
+  H2OTpu cr = make_cr(2);
+  tpuk::deploy_cluster(api, cr);
+  CHECK(!tpuk::wait_ready(api, cr, /*timeout_s=*/0));
+  Json& sts = api.store[tpuk::statefulsets_path("ml", "demo")];
+  sts["status"] = Json(tpuk::JsonObject{{"readyReplicas", Json(2)}});
+  CHECK(tpuk::wait_ready(api, cr, /*timeout_s=*/2, /*poll_interval_s=*/1));
+}
+
+TEST(descriptor_round_trip) {
+  H2OTpu cr = make_cr(5);
+  tpuk::write_descriptor(cr, "/tmp");
+  H2OTpu back = tpuk::read_descriptor("/tmp/demo.tpuk");
+  CHECK_EQ(back.name, "demo");
+  CHECK_EQ(back.ns, "ml");
+  CHECK_EQ(back.spec.nodes, 5);
+  remove("/tmp/demo.tpuk");
+}
+
+
+TEST(finalizer_patch_preserves_foreign_finalizers) {
+  FakeApi api;
+  H2OTpu cr = make_cr();
+  Json obj = cr.to_json();
+  obj["metadata"]["finalizers"] =
+      Json(tpuk::JsonArray{Json("backup.io/finalizer")});
+  api.store[tpuk::h2otpus_path(cr.ns, cr.name)] = obj;
+  tpuk::reconcile(api, cr);  // adds ours
+  const Json* fins = api.store[tpuk::h2otpus_path("ml", "demo")]
+                         .get_path("metadata.finalizers");
+  CHECK_EQ(fins->as_array().size(), 2u);
+  CHECK_EQ(fins->as_array()[0].as_string(), "backup.io/finalizer");
+  // delete: only OUR finalizer is released
+  cr.deleting = true;
+  cr.has_finalizer = true;
+  tpuk::reconcile(api, cr);
+  fins = api.store[tpuk::h2otpus_path("ml", "demo")]
+             .get_path("metadata.finalizers");
+  CHECK_EQ(fins->as_array().size(), 1u);
+  CHECK_EQ(fins->as_array()[0].as_string(), "backup.io/finalizer");
+}
+
+TEST_MAIN()

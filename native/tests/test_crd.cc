@@ -1,0 +1,95 @@
+#include "../deployment/crd.h"
+
+#include "test_util.h"
+
+using tpuk::H2OTpu;
+using tpuk::H2OTpuSpec;
+using tpuk::Json;
+
+TEST(spec_defaults) {
+  H2OTpuSpec s = H2OTpuSpec::from_json(Json::object());
+  CHECK_EQ(s.nodes, 1);
+  CHECK_EQ(s.version, "latest");
+  CHECK(!s.custom_image.has_value());
+  CHECK_EQ(s.resources.memory_percentage, 90);
+  CHECK_EQ(s.tpu.chips_per_host, 4);
+  CHECK_EQ(s.image(), "h2o-kubernetes-tpu:latest");
+}
+
+TEST(spec_full_parse) {
+  Json spec = Json::parse(R"({
+    "nodes": 8, "version": "1.2.3",
+    "resources": {"cpu": "8", "memory": "32Gi", "memoryPercentage": 75},
+    "tpu": {"accelerator": "tpu-v5p-slice", "topology": "4x4",
+            "chipsPerHost": 8}})");
+  H2OTpuSpec s = H2OTpuSpec::from_json(spec);
+  CHECK_EQ(s.nodes, 8);
+  CHECK_EQ(s.image(), "h2o-kubernetes-tpu:1.2.3");
+  CHECK_EQ(s.resources.cpu, "8");
+  CHECK_EQ(s.resources.memory_percentage, 75);
+  CHECK_EQ(s.tpu.topology, "4x4");
+  CHECK_EQ(s.tpu.chips_per_host, 8);
+}
+
+TEST(spec_custom_image_wins) {
+  Json spec = Json::parse(R"({"customImage": "gcr.io/me/img:tag"})");
+  CHECK_EQ(H2OTpuSpec::from_json(spec).image(), "gcr.io/me/img:tag");
+}
+
+TEST(spec_validation) {
+  CHECK_THROWS(H2OTpuSpec::from_json(Json::parse(R"({"nodes": 0})")));
+  CHECK_THROWS(H2OTpuSpec::from_json(
+      Json::parse(R"({"resources": {"memoryPercentage": 0}})")));
+  CHECK_THROWS(H2OTpuSpec::from_json(
+      Json::parse(R"({"resources": {"memoryPercentage": 101}})")));
+  CHECK_THROWS(H2OTpuSpec::from_json(
+      Json::parse(R"({"tpu": {"chipsPerHost": 0}})")));
+}
+
+TEST(cr_round_trip) {
+  Json obj = Json::parse(R"({
+    "apiVersion": "tpu.h2o.ai/v1", "kind": "H2OTpu",
+    "metadata": {"name": "demo", "namespace": "ml", "uid": "u1",
+                 "resourceVersion": "5",
+                 "finalizers": ["tpu.h2o.ai/finalizer"]},
+    "spec": {"nodes": 2}})");
+  H2OTpu cr = H2OTpu::from_json(obj);
+  CHECK_EQ(cr.name, "demo");
+  CHECK_EQ(cr.ns, "ml");
+  CHECK_EQ(cr.uid, "u1");
+  CHECK(cr.has_finalizer);
+  CHECK(!cr.deleting);
+  CHECK_EQ(cr.spec.nodes, 2);
+  Json back = cr.to_json();
+  CHECK_EQ(back.get_path("metadata.name")->as_string(), "demo");
+  CHECK_EQ(back.get_path("spec.nodes")->as_int(), 2);
+  CHECK_EQ(back.get_path("metadata.finalizers")->as_array().size(), 1u);
+}
+
+TEST(cr_deletion_detected) {
+  Json obj = Json::parse(R"({
+    "metadata": {"name": "x", "deletionTimestamp": "2026-01-01T00:00:00Z"},
+    "spec": {}})");
+  CHECK(H2OTpu::from_json(obj).deleting);
+}
+
+TEST(cr_requires_name) {
+  CHECK_THROWS(H2OTpu::from_json(Json::parse(R"({"metadata": {}})")));
+  CHECK_THROWS(H2OTpu::from_json(Json::parse(R"({"spec": {}})")));
+}
+
+TEST(crd_manifest_shape) {
+  Json crd = tpuk::crd_manifest();
+  CHECK_EQ(crd.get_path("metadata.name")->as_string(), "h2otpus.tpu.h2o.ai");
+  CHECK_EQ(crd.get_path("spec.group")->as_string(), "tpu.h2o.ai");
+  CHECK_EQ(crd.get_path("spec.names.kind")->as_string(), "H2OTpu");
+  const Json* versions = crd.get_path("spec.versions");
+  CHECK(versions && versions->as_array().size() == 1);
+  const Json& v0 = versions->as_array()[0];
+  CHECK_EQ(v0.get_path("name")->as_string(), "v1");
+  CHECK(v0.get_path("schema.openAPIV3Schema.properties.spec.properties."
+                    "nodes") != nullptr);
+  CHECK(v0.get_path("subresources.status") != nullptr);
+}
+
+TEST_MAIN()

@@ -1,0 +1,71 @@
+#include "../deployment/json.h"
+
+#include "test_util.h"
+
+using tpuk::Json;
+using tpuk::JsonArray;
+using tpuk::JsonObject;
+
+TEST(parse_scalars) {
+  CHECK(Json::parse("null").is_null());
+  CHECK_EQ(Json::parse("true").as_bool(), true);
+  CHECK_EQ(Json::parse("false").as_bool(), false);
+  CHECK_EQ(Json::parse("42").as_int(), 42);
+  CHECK_EQ(Json::parse("-3.5").as_number(), -3.5);
+  CHECK_EQ(Json::parse("\"hi\"").as_string(), "hi");
+}
+
+TEST(parse_structures) {
+  Json v = Json::parse(R"({"a": [1, 2, {"b": "c"}], "d": {}})");
+  CHECK_EQ(v.as_object().size(), 2u);
+  CHECK_EQ(v["a"].as_array().size(), 3u);
+  CHECK_EQ(v["a"].as_array()[2]["b"].as_string(), "c");
+  CHECK(v["d"].as_object().empty());
+}
+
+TEST(parse_escapes) {
+  Json v = Json::parse(R"("line\n\"quoted\"\t\\u0041:A")");
+  CHECK_EQ(v.as_string(), "line\n\"quoted\"\t\\u0041:A");
+}
+
+TEST(parse_errors) {
+  CHECK_THROWS(Json::parse(""));
+  CHECK_THROWS(Json::parse("{"));
+  CHECK_THROWS(Json::parse("[1,]"));
+  CHECK_THROWS(Json::parse("{\"a\":1} trailing"));
+  CHECK_THROWS(Json::parse("nulll"));
+}
+
+TEST(dump_round_trip) {
+  std::string text =
+      R"({"arr":[1,2.5,"x"],"nested":{"t":true},"z":null})";
+  Json v = Json::parse(text);
+  CHECK_EQ(v.dump(), text);  // std::map ordering == alphabetical input
+  Json again = Json::parse(v.dump(2));
+  CHECK_EQ(again.dump(), text);
+}
+
+TEST(dump_integral_numbers_stay_ints) {
+  Json v = Json::object();
+  v["n"] = 54321;
+  CHECK_EQ(v.dump(), R"({"n":54321})");
+}
+
+TEST(get_path_and_helpers) {
+  Json v = Json::parse(R"({"spec":{"nodes":3,"name":"x"}})");
+  CHECK_EQ(v.get_path("spec.nodes")->as_int(), 3);
+  CHECK(v.get_path("spec.missing") == nullptr);
+  CHECK(v.get_path("no.such") == nullptr);
+  CHECK_EQ(v["spec"].string_or("name", "d"), "x");
+  CHECK_EQ(v["spec"].string_or("nope", "d"), "d");
+  CHECK_EQ(v["spec"].int_or("nodes", 0), 3);
+  CHECK_EQ(v["spec"].int_or("nope", 7), 7);
+}
+
+TEST(wrong_type_access_throws) {
+  Json v = Json::parse("[1]");
+  CHECK_THROWS(v.as_object());
+  CHECK_THROWS(v.as_string());
+}
+
+TEST_MAIN()

@@ -1,0 +1,138 @@
+// Golden-file tests: the generated Service/StatefulSet/Ingress/CRD
+// manifests are the deployment stack's entire observable output (the
+// reference asserts the same shapes in its deployment-crate unit tests,
+// SURVEY.md §4a).  Regenerate with TPUK_UPDATE_GOLDENS=1.
+#include "../deployment/manifests.h"
+
+#include <cstdlib>
+#include <fstream>
+#include <sstream>
+
+#include "test_util.h"
+
+using tpuk::H2OTpu;
+using tpuk::Json;
+
+namespace {
+
+H2OTpu demo_cr() {
+  H2OTpu cr;
+  cr.name = "demo";
+  cr.ns = "ml";
+  cr.uid = "uid-123";
+  cr.spec.nodes = 4;
+  cr.spec.version = "0.2.0";
+  cr.spec.resources.cpu = "8";
+  cr.spec.resources.memory = "32Gi";
+  cr.spec.resources.memory_percentage = 80;
+  cr.spec.tpu.accelerator = "tpu-v5-lite-podslice";
+  cr.spec.tpu.topology = "4x4";
+  cr.spec.tpu.chips_per_host = 4;
+  return cr;
+}
+
+void check_golden(const std::string& name, const Json& manifest) {
+  std::string path = std::string(GOLDEN_DIR) + "/" + name + ".json";
+  std::string got = manifest.dump(2);
+  if (std::getenv("TPUK_UPDATE_GOLDENS")) {
+    std::ofstream out(path, std::ios::trunc);
+    out << got;
+    std::printf("  updated %s\n", path.c_str());
+    return;
+  }
+  std::ifstream in(path);
+  if (!in) {
+    std::fprintf(stderr, "  missing golden %s (set TPUK_UPDATE_GOLDENS=1)\n",
+                 path.c_str());
+    ++::tpuk_test::failures;
+    return;
+  }
+  std::ostringstream want;
+  want << in.rdbuf();
+  if (got != want.str()) {
+    std::fprintf(stderr,
+                 "  golden mismatch for %s\n--- want\n%s\n--- got\n%s\n",
+                 name.c_str(), want.str().c_str(), got.c_str());
+    ++::tpuk_test::failures;
+  }
+}
+
+}  // namespace
+
+TEST(golden_service) { check_golden("service", headless_service(demo_cr())); }
+
+TEST(golden_statefulset) {
+  check_golden("statefulset", stateful_set(demo_cr()));
+}
+
+TEST(golden_ingress) {
+  check_golden("ingress", ingress(demo_cr(), "h2o.example.com"));
+}
+
+TEST(golden_crd) { check_golden("crd", tpuk::crd_manifest()); }
+
+TEST(env_contract_present) {
+  // the coordinator env contract consumed by
+  // h2o_kubernetes_tpu.runtime.mesh.initialize_distributed
+  Json sts = stateful_set(demo_cr());
+  const Json* env =
+      sts.get_path("spec.template.spec.containers")->as_array()[0]
+          .find("env");
+  CHECK(env && env->is_array());
+  bool coord = false, nproc = false, pid = false;
+  for (const Json& e : env->as_array()) {
+    std::string n = e.string_or("name", "");
+    if (n == "H2O_TPU_COORDINATOR") {
+      coord = true;
+      CHECK_EQ(e.string_or("value", ""),
+               "demo-0.demo.ml.svc.cluster.local:8476");
+    }
+    if (n == "H2O_TPU_NUM_PROCESSES") {
+      nproc = true;
+      CHECK_EQ(e.string_or("value", ""), "4");
+    }
+    if (n == "H2O_TPU_PROCESS_ID") {
+      pid = true;
+      CHECK(e.get_path("valueFrom.fieldRef.fieldPath") != nullptr);
+    }
+  }
+  CHECK(coord);
+  CHECK(nproc);
+  CHECK(pid);
+}
+
+TEST(tpu_nodeselector_and_resources) {
+  Json sts = stateful_set(demo_cr());
+  const Json* sel = sts.get_path("spec.template.spec.nodeSelector");
+  CHECK_EQ(sel->string_or("cloud.google.com/gke-tpu-accelerator", ""),
+           "tpu-v5-lite-podslice");
+  CHECK_EQ(sel->string_or("cloud.google.com/gke-tpu-topology", ""), "4x4");
+  const Json& container =
+      sts.get_path("spec.template.spec.containers")->as_array()[0];
+  CHECK_EQ(container.get_path("resources.requests")
+               ->string_or("google.com/tpu", ""),
+           "4");
+  CHECK_EQ(container.get_path("resources.limits")
+               ->string_or("google.com/tpu", ""),
+           "4");
+}
+
+TEST(service_is_headless_with_unready_addresses) {
+  Json svc = headless_service(demo_cr());
+  CHECK_EQ(svc.get_path("spec.clusterIP")->as_string(), "None");
+  CHECK_EQ(svc.get_path("spec.publishNotReadyAddresses")->as_bool(), true);
+}
+
+TEST(owner_reference_set_when_uid_known) {
+  Json svc = headless_service(demo_cr());
+  const Json* refs = svc.get_path("metadata.ownerReferences");
+  CHECK(refs && refs->as_array().size() == 1);
+  CHECK_EQ(refs->as_array()[0].string_or("kind", ""), "H2OTpu");
+  // CLI-created resources (no uid yet) must omit ownerReferences
+  H2OTpu cli_cr = demo_cr();
+  cli_cr.uid.clear();
+  CHECK(headless_service(cli_cr).get_path("metadata.ownerReferences") ==
+        nullptr);
+}
+
+TEST_MAIN()

@@ -1,0 +1,93 @@
+// Micro test harness (the role tests_common plays for the reference's
+// crates — SURVEY.md §2a R4): CHECK macros + a main that runs
+// registered cases and exits nonzero on failure (ctest-friendly).
+#pragma once
+
+#include <cstdio>
+#include <functional>
+#include <string>
+#include <vector>
+
+namespace tpuk_test {
+
+struct Case {
+  std::string name;
+  std::function<void()> fn;
+};
+
+inline std::vector<Case>& cases() {
+  static std::vector<Case> all;
+  return all;
+}
+
+struct Register {
+  Register(const std::string& name, std::function<void()> fn) {
+    cases().push_back({name, std::move(fn)});
+  }
+};
+
+inline int failures = 0;
+
+#define TEST(name)                                              \
+  static void test_##name();                                    \
+  static ::tpuk_test::Register reg_##name(#name, test_##name);  \
+  static void test_##name()
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "  CHECK failed at %s:%d: %s\n", __FILE__,   \
+                   __LINE__, #cond);                                    \
+      ++::tpuk_test::failures;                                          \
+    }                                                                   \
+  } while (0)
+
+#define CHECK_EQ(a, b)                                                   \
+  do {                                                                   \
+    auto va = (a);                                                       \
+    auto vb = (b);                                                       \
+    if (!(va == vb)) {                                                   \
+      std::fprintf(stderr, "  CHECK_EQ failed at %s:%d: %s != %s\n",     \
+                   __FILE__, __LINE__, #a, #b);                          \
+      ++::tpuk_test::failures;                                           \
+    }                                                                    \
+  } while (0)
+
+#define CHECK_THROWS(expr)                                              \
+  do {                                                                  \
+    bool threw = false;                                                 \
+    try {                                                               \
+      (void)(expr);                                                     \
+    } catch (const std::exception&) {                                   \
+      threw = true;                                                     \
+    }                                                                   \
+    if (!threw) {                                                       \
+      std::fprintf(stderr, "  CHECK_THROWS failed at %s:%d: %s\n",      \
+                   __FILE__, __LINE__, #expr);                          \
+      ++::tpuk_test::failures;                                          \
+    }                                                                   \
+  } while (0)
+
+inline int run_all() {
+  int failed_cases = 0;
+  for (const Case& c : cases()) {
+    int before = failures;
+    try {
+      c.fn();
+    } catch (const std::exception& e) {
+      std::fprintf(stderr, "  EXCEPTION in %s: %s\n", c.name.c_str(),
+                   e.what());
+      ++failures;
+    }
+    bool ok = failures == before;
+    std::printf("%s %s\n", ok ? "PASS" : "FAIL", c.name.c_str());
+    if (!ok) ++failed_cases;
+  }
+  std::printf("%zu cases, %d failed\n", cases().size(), failed_cases);
+  return failed_cases == 0 ? 0 : 1;
+}
+
+}  // namespace tpuk_test
+
+#define TEST_MAIN() \
+  int main() { return ::tpuk_test::run_all(); }

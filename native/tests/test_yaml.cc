@@ -1,0 +1,74 @@
+#include "../deployment/k8s_client.h"
+
+#include "test_util.h"
+
+using tpuk::Json;
+using tpuk::yaml_to_json;
+
+namespace {
+
+const char* kKubeconfig = R"(apiVersion: v1
+kind: Config
+current-context: dev
+clusters:
+- cluster:
+    server: https://10.0.0.1:6443
+    certificate-authority: /etc/ca.crt
+  name: devcluster
+contexts:
+- context:
+    cluster: devcluster
+    user: devuser
+  name: dev
+users:
+- name: devuser
+  user:
+    token: sekret  # inline comment
+preferences: {}
+)";
+
+}  // namespace
+
+TEST(yaml_kubeconfig_shape) {
+  Json cfg = yaml_to_json(kKubeconfig);
+  CHECK_EQ(cfg.string_or("current-context", ""), "dev");
+  const Json* clusters = cfg.find("clusters");
+  CHECK(clusters && clusters->is_array());
+  const Json& c0 = clusters->as_array()[0];
+  CHECK_EQ(c0.string_or("name", ""), "devcluster");
+  CHECK_EQ(c0.get_path("cluster.server")->as_string(),
+           "https://10.0.0.1:6443");
+  CHECK_EQ(cfg.get_path("users")->as_array()[0]
+               .get_path("user.token")->as_string(),
+           "sekret");
+}
+
+TEST(yaml_scalars_and_lists) {
+  Json v = yaml_to_json("a: 1\nb: true\nc: 'q'\nlist:\n- x\n- y\n");
+  CHECK_EQ(v["a"].as_int(), 1);
+  CHECK_EQ(v["b"].as_bool(), true);
+  CHECK_EQ(v["c"].as_string(), "q");
+  CHECK_EQ(v["list"].as_array().size(), 2u);
+  CHECK_EQ(v["list"].as_array()[1].as_string(), "y");
+}
+
+TEST(yaml_comments_and_blank_lines) {
+  Json v = yaml_to_json("# header\n\na: x # tail\n");
+  CHECK_EQ(v["a"].as_string(), "x");
+}
+
+TEST(kubeconfig_from_file) {
+  std::string path = "/tmp/tpuk-test-kubeconfig.yaml";
+  {
+    FILE* f = fopen(path.c_str(), "w");
+    fputs(kKubeconfig, f);
+    fclose(f);
+  }
+  tpuk::K8sConfig cfg = tpuk::K8sConfig::from_kubeconfig(path);
+  CHECK_EQ(cfg.server, "https://10.0.0.1:6443");
+  CHECK_EQ(cfg.token, "sekret");
+  CHECK_EQ(cfg.ca_cert_path, "/etc/ca.crt");
+  remove(path.c_str());
+}
+
+TEST_MAIN()
