@@ -59,7 +59,10 @@ def main() -> None:
         return GBM(ntrees=nt, max_depth=5, learn_rate=0.2, seed=1).train(
             y="y", training_frame=fr)
 
-    run(2)  # warm-up: compile binning + tree build + predict
+    # warm-up with the SAME ntrees: the fused boosting loop compiles a
+    # scan whose length is the tree count, so a shorter warm-up would
+    # leave the timed run paying a fresh XLA compile
+    run(ntrees)
     t0 = time.perf_counter()
     run(ntrees)
     dt = time.perf_counter() - t0

@@ -31,7 +31,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..frame import Frame
-from ..runtime.mesh import ROWS, global_mesh
+from ..runtime.mesh import COLS, ROWS, global_mesh
 from .base import Model, TrainData, resolve_xy
 from .datainfo import DataInfo, build_datainfo
 
@@ -92,16 +92,36 @@ def _irls_weights(family, eta, mu, y):
 
 @functools.partial(jax.jit, static_argnums=(4,))
 def _gram_task(Xe, wk, z, w, mesh):
-    """Per-shard Gram accumulate + psum: G=XᵀWX [P,P], b=XᵀWz [P]."""
+    """Distributed Gram accumulate: G=XᵀWX [P,P], b=XᵀWz [P].
+
+    Rows shard over ROWS (the MRTask reduce, psum on ICI) and the
+    EXPANDED FEATURE axis shards over COLS — the wide-feature TP analog
+    (SURVEY.md §5.7): GLM's categorical expansion can reach 10⁴–10⁶
+    features, at which point the [P,P] Gram dominates.  Each COLS shard
+    computes only its [P/c, P] row-block of G with a fused matmul, so
+    Gram FLOPs and result memory split c ways; G comes back
+    feature-sharded over COLS (out_specs P(COLS)), the psum over ROWS
+    acting as a reduce-scatter across the mesh as a whole.  c == 1
+    degenerates to the plain row-sharded Gram.
+    """
+    c = mesh.shape[COLS]
+    Pn = Xe.shape[1]
+    blk = -(-Pn // c)
+    pad = blk * c - Pn
+    Xp = jnp.pad(Xe, ((0, 0), (0, pad))) if pad else Xe
 
     def body(xs, wks, zs, ws):
+        ci = lax.axis_index(COLS)
         ww = (wks * ws)[:, None]
-        G = xs.T @ (ww * xs)
-        b = xs.T @ (ww[:, 0] * zs)
+        xb = lax.dynamic_slice_in_dim(xs, ci * blk, blk, axis=1)
+        G = xb.T @ (ww * xs)                    # [blk, P] block of G
+        b = xb.T @ (ww[:, 0] * zs)              # [blk] block of b
         return lax.psum(G, ROWS), lax.psum(b, ROWS)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=P(ROWS),
-                         out_specs=P())(Xe, wk, z, w)
+    G, b = jax.shard_map(body, mesh=mesh,
+                         in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS)),
+                         out_specs=(P(COLS, None), P(COLS)))(Xp, wk, z, w)
+    return G[:Pn, :Pn], b[:Pn]
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))

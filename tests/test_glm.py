@@ -150,3 +150,29 @@ def test_glm_enum_na_scoring_mode_imputed(mesh8):
     pred = m.predict_raw(sf)
     np.testing.assert_allclose(pred[0], pred[2], atol=0.05)  # zz ≈ b
     assert abs(pred[0] - pred[1]) > 0.5                      # zz != a
+
+
+def test_glm_cols_axis_mesh_parity(mesh8):
+    """Gram sharded over the COLS (wide-feature TP) axis must reproduce
+    the row-only result: 4x2 mesh vs the default 8x1 mesh."""
+    from h2o_kubernetes_tpu.runtime import make_mesh, use_mesh
+
+    rng = np.random.default_rng(21)
+    n = 512
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    cat = np.array(["a", "b", "c"])[rng.integers(0, 3, size=n)]
+    logit = x[:, 0] - 0.5 * x[:, 1] + (cat == "b") * 0.8
+    fr = Frame.from_arrays({
+        **{f"x{i}": x[:, i] for i in range(5)},
+        "c": cat,
+        "y": np.where(logit + rng.normal(scale=0.3, size=n) > 0,
+                      "yes", "no")})
+    m1 = GLM(family="binomial", lambda_=0.01, alpha=0.5,
+             max_iterations=20, seed=0).train(y="y", training_frame=fr)
+    with use_mesh(make_mesh(n_rows=4, n_cols=2)):
+        m2 = GLM(family="binomial", lambda_=0.01, alpha=0.5,
+                 max_iterations=20, seed=0).train(y="y", training_frame=fr)
+    np.testing.assert_allclose(np.asarray(m1.beta), np.asarray(m2.beta),
+                               rtol=2e-4, atol=2e-5)
+    # odd expanded-feature count exercises the padding path on 4x2
+    assert m1.dinfo.n_expanded % 2 == 1
