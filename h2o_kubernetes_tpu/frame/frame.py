@@ -222,6 +222,129 @@ class Vec:
         out = np.where(a >= 0, lut[np.maximum(a, 0)], np.nan)
         return Vec.from_numpy(out.astype(np.float32), self.name)
 
+    # -- elementwise algebra (the Rapids expression surface) -----------------
+    # Reference: H2O's Rapids AST ops (water/rapids/ast/prims/math,
+    # operators [U3]) exposed through h2o-py Frame/Vec operators. Here an
+    # expression is just jnp math on the padded sharded column — XLA fuses
+    # chains of these into one kernel; NA (NaN) propagates; pads stay NaN
+    # so downstream filters/rollups ignore them.
+
+    def _operand(self, other, op: str = "arithmetic") -> jax.Array | float:
+        if isinstance(other, Vec):
+            if other.nrows != self.nrows:
+                raise ValueError("Vec length mismatch "
+                                 f"({other.nrows} vs {self.nrows})")
+            if other.is_enum():
+                raise TypeError(
+                    f"{op} is not applicable to enum column "
+                    f"'{other.name}' (use asnumeric() first)")
+            return other.as_float()
+        if isinstance(other, (bool, int, float, np.floating, np.integer)):
+            return float(other)
+        raise TypeError(f"cannot combine Vec with {type(other).__name__}")
+
+    def _arith(self, other, fn, name="") -> "Vec":
+        if self.is_enum():
+            # h2o-py raises for math on factors; as_float() would expose
+            # the CODES and silently compute nonsense
+            raise TypeError(f"arithmetic is not applicable to enum column "
+                            f"'{self.name}' (use asnumeric() first)")
+        out = fn(self.as_float(), self._operand(other))
+        return Vec(out.astype(jnp.float32), self.nrows, name=name or
+                   self.name)
+
+    def __add__(self, o): return self._arith(o, jnp.add)
+    def __radd__(self, o): return self._arith(o, lambda a, b: b + a)
+    def __sub__(self, o): return self._arith(o, jnp.subtract)
+    def __rsub__(self, o): return self._arith(o, lambda a, b: b - a)
+    def __mul__(self, o): return self._arith(o, jnp.multiply)
+    def __rmul__(self, o): return self._arith(o, lambda a, b: b * a)
+    def __truediv__(self, o): return self._arith(o, jnp.divide)
+    def __rtruediv__(self, o): return self._arith(o, lambda a, b: b / a)
+    def __pow__(self, o): return self._arith(o, jnp.power)
+    def __mod__(self, o): return self._arith(o, jnp.mod)
+    def __floordiv__(self, o): return self._arith(o, jnp.floor_divide)
+    def __neg__(self): return self._arith(0.0, lambda a, _: -a)
+
+    def _cmp(self, other, fn) -> "Vec":
+        if isinstance(other, str):
+            # enum == "label": compare codes against the domain index
+            # (h2o-py `fr["c"] == "cat"`); unknown label matches nothing
+            if not self.is_enum():
+                raise TypeError(
+                    f"'{self.name}': string comparison needs an enum column")
+            code = (self.domain or []).index(other) \
+                if other in (self.domain or []) else -2
+            a = self.data.astype(jnp.float32)
+            a = jnp.where(self.data == NA_ENUM, jnp.nan, a)
+            b = float(code)
+        else:
+            if self.is_enum():
+                raise TypeError(
+                    f"numeric comparison is not applicable to enum column "
+                    f"'{self.name}' (compare against a level string)")
+            a, b = self.as_float(), self._operand(other, "comparison")
+        res = fn(a, b).astype(jnp.float32)
+        bad = jnp.isnan(a) | jnp.isnan(jnp.asarray(b, dtype=jnp.float32))
+        out = jnp.where(bad, jnp.nan, res)   # NA compares to NA (h2o)
+        return Vec(out, self.nrows, name=self.name)
+
+    def __lt__(self, o): return self._cmp(o, jnp.less)
+    def __le__(self, o): return self._cmp(o, jnp.less_equal)
+    def __gt__(self, o): return self._cmp(o, jnp.greater)
+    def __ge__(self, o): return self._cmp(o, jnp.greater_equal)
+    def __eq__(self, o): return self._cmp(o, jnp.equal)       # noqa: E731
+    def __ne__(self, o): return self._cmp(o, jnp.not_equal)   # noqa: E731
+    __hash__ = None  # mirrors h2o-py: Vecs are expressions, not dict keys
+
+    def _bool(self) -> jax.Array:
+        """Truth mask with NA→False (filter semantics)."""
+        a = self.as_float()
+        return jnp.where(jnp.isnan(a), 0.0, a) != 0.0
+
+    def __and__(self, o):
+        if not isinstance(o, Vec):
+            raise TypeError("& needs two Vecs")
+        out = (self._bool() & o._bool()).astype(jnp.float32)
+        return Vec(out, self.nrows, name=self.name)
+
+    def __or__(self, o):
+        if not isinstance(o, Vec):
+            raise TypeError("| needs two Vecs")
+        out = (self._bool() | o._bool()).astype(jnp.float32)
+        return Vec(out, self.nrows, name=self.name)
+
+    def __invert__(self):
+        return Vec((~self._bool()).astype(jnp.float32), self.nrows,
+                   name=self.name)
+
+    def _math(self, fn) -> "Vec":
+        if self.is_enum():
+            raise TypeError(f"math is not applicable to enum column "
+                            f"'{self.name}' (use asnumeric() first)")
+        return Vec(fn(self.as_float()).astype(jnp.float32), self.nrows,
+                   name=self.name)
+
+    def log(self): return self._math(jnp.log)
+    def log1p(self): return self._math(jnp.log1p)
+    def exp(self): return self._math(jnp.exp)
+    def sqrt(self): return self._math(jnp.sqrt)
+    def abs(self): return self._math(jnp.abs)
+    def floor(self): return self._math(jnp.floor)
+    def ceil(self): return self._math(jnp.ceil)
+    def sign(self): return self._math(jnp.sign)
+
+    def isna(self) -> "Vec":
+        """1.0 where the value is NA (h2o isna — NA itself maps to 1)."""
+        if self.kind == "enum":
+            out = (self.data == NA_ENUM).astype(jnp.float32)
+        else:
+            out = jnp.isnan(self.data).astype(jnp.float32)
+        # re-mark pad rows as NaN so they never count as real NA rows
+        idx = jnp.arange(self.padded_len)
+        out = jnp.where(idx < self.nrows, out, jnp.nan)
+        return Vec(out, self.nrows, name=self.name)
+
 
 def _num_str(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else str(v)
@@ -289,6 +412,12 @@ class Frame:
     def __getitem__(self, key):
         if isinstance(key, str):
             return self._vecs[key]
+        if isinstance(key, Vec):
+            # boolean row filter: fr[fr["x"] > 0] — NA mask rows drop
+            # (h2o-py Rapids row-slice semantics)
+            if key.nrows != self.nrows:
+                raise ValueError("filter mask length != nrows")
+            return self.select_rows(np.asarray(key._bool())[: self.nrows])
         if isinstance(key, (list, tuple)):
             return Frame({k: self._vecs[k] for k in key})
         raise TypeError(f"bad key {key!r}")
@@ -396,6 +525,31 @@ class Frame:
                 cat = np.concatenate([a.to_numpy(), b.to_numpy()])
                 out[n] = Vec.from_numpy(cat, n, domain=a.domain, kind=a.kind)
         return Frame(out)
+
+    def group_by(self, by) -> "Any":
+        """h2o-py GroupBy builder: fr.group_by("c").sum("x").get_frame()."""
+        from .munge import GroupBy
+        return GroupBy(self, by)
+
+    def merge(self, other: "Frame", by=None, all_x: bool = False) -> "Frame":
+        """Join on key columns (h2o merge: inner, or left when all_x)."""
+        from .munge import merge as _merge
+        return _merge(self, other, by=by, all_x=all_x)
+
+    def sort(self, by, ascending: bool = True) -> "Frame":
+        """Rows ordered by the given column(s) (h2o sort; stable,
+        NA rows last either direction)."""
+        keys = [by] if isinstance(by, str) else list(by)
+        cols = []
+        for k in reversed(keys):   # lexsort: last key is primary
+            v = self._vecs[k]
+            a = v.to_numpy().astype(np.float64)
+            na = (a < 0) if v.is_enum() else np.isnan(a)
+            # descending: negate the key rather than reversing the
+            # permutation — keeps the sort stable and NA rows last
+            key = a if ascending else -a
+            cols.append(np.where(na, np.inf, key))
+        return self.select_rows(np.lexsort(cols))
 
     def cbind(self, other: "Frame") -> "Frame":
         """Adjoin columns of an equal-length frame (suffix dups like h2o)."""

@@ -241,12 +241,94 @@ def parse_setup(path: str | Sequence[str], sep: str | None = None,
             "names": names, "types": types, "na_strings": nas}
 
 
+_PARQUET_MAGIC = b"PAR1"
+_ORC_MAGIC = b"ORC"
+
+
+def _binary_format(path: str) -> str | None:
+    """Sniff columnar binary formats by magic bytes (the reference's
+    parser provider detection, water/parser GuessParserSetup [U3])."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(4)
+    except (OSError, IsADirectoryError):
+        return None
+    if head == _PARQUET_MAGIC:
+        return "parquet"
+    if head[:3] == _ORC_MAGIC:
+        return "orc"
+    return None
+
+
+def _import_arrow(files: list[str], fmt: str,
+                  col_types: Mapping[str, str] | None,
+                  skipped: set[str]) -> Frame:
+    """Parquet/ORC ingest via pyarrow (h2o-parsers/h2o-parquet-parser
+    analog): host-side columnar read → typed numpy → sharded device
+    columns. Arrow dictionary columns keep their vocab as the enum
+    domain; timestamps become time Vecs (epoch ms)."""
+    import pyarrow as pa
+
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+        tables = [pq.read_table(f) for f in files]
+    else:
+        from pyarrow import orc
+        tables = [orc.ORCFile(f).read() for f in files]
+    table = tables[0] if len(tables) == 1 else pa.concat_tables(
+        tables, promote_options="default")
+
+    overrides = dict(col_types or {}) if isinstance(col_types, Mapping) \
+        else {}
+    cols: dict[str, Vec] = {}
+    for name in table.column_names:
+        if name in skipped:
+            continue
+        col = table.column(name).combine_chunks()
+        t = col.type
+        want = _norm_type(overrides[name]) if name in overrides else None
+        if pa.types.is_dictionary(t):
+            codes = col.indices.to_numpy(zero_copy_only=False).astype(
+                np.float64)          # nulls → NaN before int cast
+            null = np.asarray(col.is_null())
+            codes = np.where(null, -1, np.nan_to_num(codes, nan=-1))
+            dom = [str(v) for v in col.dictionary.to_pylist()]
+            v = Vec.from_numpy(codes.astype(np.int32), name, domain=dom)
+        elif pa.types.is_timestamp(t) or pa.types.is_date(t):
+            ms = col.cast(pa.timestamp("ms")).to_numpy(
+                zero_copy_only=False)
+            v = Vec.from_numpy(ms, name)   # datetime64 → time kind
+        elif pa.types.is_string(t) or pa.types.is_large_string(t) or \
+                pa.types.is_binary(t):
+            arr = np.asarray(col.to_pylist(), dtype=object)
+            from .frame import _factorize
+            codes, dom = _factorize(arr)
+            v = Vec.from_numpy(codes, name, domain=dom)
+        else:
+            a = col.to_numpy(zero_copy_only=False).astype(np.float64)
+            v = Vec.from_numpy(a.astype(np.float32), name)
+        if want == "enum" and not v.is_enum():
+            v = v.asfactor()
+        elif want == "numeric" and v.is_enum():
+            v = v.asnumeric()
+        cols[name] = v
+    return Frame(cols)
+
+
 def import_file(path: str | Sequence[str], sep: str | None = None,
                 header: int = -1, col_names: Sequence[str] | None = None,
                 col_types: Mapping[str, str] | Sequence[str] | None = None,
                 na_strings: Sequence[str] | None = None,
                 skipped_columns: Sequence[str] | None = None) -> Frame:
-    """h2o.import_file analog: parse CSV file(s) into a sharded Frame."""
+    """h2o.import_file analog: parse CSV/Parquet/ORC file(s) into a
+    sharded Frame (format sniffed per file set, like the reference's
+    parser-provider guess)."""
+    files = _expand_paths(path)
+    fmt = _binary_format(files[0])
+    if fmt is not None:
+        return _import_arrow(files, fmt,
+                             col_types if isinstance(col_types, Mapping)
+                             else None, set(skipped_columns or []))
     setup = parse_setup(path, sep=sep, header=header, na_strings=na_strings)
     # copy: uniquification below must not leak into setup["names"], which
     # later files' first records are compared against verbatim

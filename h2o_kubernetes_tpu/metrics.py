@@ -9,43 +9,89 @@ reductions — cheap next to training).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 
-def roc_auc(y_true, score) -> float:
-    """Exact AUC with average-rank tie handling (Mann-Whitney U)."""
+def roc_auc(y_true, score, w=None) -> float:
+    """Exact AUC with average-rank tie handling (Mann-Whitney U).
+
+    Optionally weighted; rows with w == 0 (e.g. shard padding) are
+    excluded entirely, so callers can pass padded device arrays without
+    a host-side mask round trip.
+    """
     y = jnp.asarray(y_true).astype(jnp.float32).ravel()
     s = jnp.asarray(score).astype(jnp.float32).ravel()
-    ss = jnp.sort(s)
-    lo = jnp.searchsorted(ss, s, side="left")
-    hi = jnp.searchsorted(ss, s, side="right")
-    rank = (lo + hi + 1).astype(jnp.float32) / 2.0  # 1-based average rank
-    npos = jnp.sum(y)
-    nneg = y.shape[0] - npos
-    auc = (jnp.sum(rank * y) - npos * (npos + 1) / 2.0) / (npos * nneg)
-    return float(auc)
+    wt = jnp.ones_like(y) if w is None else \
+        jnp.asarray(w).astype(jnp.float32).ravel()
+    return float(_auc_impl(y, s, wt))
 
 
-def logloss(y_true, p, eps: float = 1e-7) -> float:
+@jax.jit
+def _auc_impl(y, s, wt):
+    # one compiled program: eagerly this is ~15 dispatches, which costs
+    # seconds per first call when the chip sits behind a network tunnel
+    live = wt > 0
+    # NaN on a LIVE row (diverged model, NA leak) must surface as NaN
+    # AUC, not be silently ranked at score 0
+    bad = jnp.any(live & (jnp.isnan(y) | jnp.isnan(s)))
+    wt = jnp.where(live, wt, 0.0)
+    y = jnp.where(live, jnp.nan_to_num(y), 0.0)
+    s = jnp.where(live, jnp.nan_to_num(s), jnp.inf)  # dead rows sort last
+    order = jnp.argsort(s)
+    ss, ys, ws = s[order], y[order], wt[order]
+    negw = ws * (1.0 - ys)
+    posw = ws * ys
+    cneg = jnp.cumsum(negw)                          # inclusive
+    lo = jnp.searchsorted(ss, ss, side="left")
+    hi = jnp.searchsorted(ss, ss, side="right")
+    below = jnp.where(lo > 0, cneg[jnp.maximum(lo - 1, 0)], 0.0)
+    tied = cneg[hi - 1] - below
+    auc = jnp.sum(posw * (below + 0.5 * tied)) / \
+        (jnp.sum(posw) * jnp.sum(negw))
+    return jnp.where(bad, jnp.nan, auc)
+
+
+def logloss(y_true, p, eps: float = 1e-7, w=None) -> float:
     # eps must stay f32-representable: with 1e-15, 1-eps rounds to 1.0 and
     # the (1-y)*log1p(-1) term produces 0*inf = NaN
     y = jnp.asarray(y_true).astype(jnp.float32).ravel()
     p = jnp.clip(jnp.asarray(p).astype(jnp.float32).ravel(), eps, 1 - eps)
-    return float(-jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log1p(-p)))
+    if w is None:
+        return float(-jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log1p(-p)))
+    wt = jnp.asarray(w).astype(jnp.float32).ravel()
+    bad = jnp.any((wt > 0) & jnp.isnan(y))     # NaN on live rows surfaces
+    y = jnp.where(wt > 0, jnp.nan_to_num(y), 0.0)
+    ll = y * jnp.log(p) + (1 - y) * jnp.log1p(-p)
+    out = -jnp.sum(wt * jnp.where(wt > 0, ll, 0.0)) / jnp.sum(wt)
+    return float(jnp.where(bad, jnp.nan, out))
 
 
-def multinomial_logloss(y_true, probs, eps: float = 1e-7) -> float:
+def multinomial_logloss(y_true, probs, eps: float = 1e-7, w=None) -> float:
     """y_true: int class ids [n]; probs: [n, K]."""
-    y = jnp.asarray(y_true).astype(jnp.int32).ravel()
+    yraw = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    y = jnp.nan_to_num(yraw).astype(jnp.int32)
     p = jnp.clip(jnp.asarray(probs), eps, 1.0)
-    return float(-jnp.mean(jnp.log(p[jnp.arange(y.shape[0]), y])))
+    ll = jnp.log(p[jnp.arange(y.shape[0]), y])
+    if w is None:
+        return float(-jnp.mean(ll))
+    wt = jnp.asarray(w).astype(jnp.float32).ravel()
+    bad = jnp.any((wt > 0) & jnp.isnan(yraw))
+    out = -jnp.sum(wt * jnp.where(wt > 0, ll, 0.0)) / jnp.sum(wt)
+    return float(jnp.where(bad, jnp.nan, out))
 
 
-def rmse(y_true, pred) -> float:
+def rmse(y_true, pred, w=None) -> float:
     y = jnp.asarray(y_true).astype(jnp.float32).ravel()
     p = jnp.asarray(pred).astype(jnp.float32).ravel()
-    return float(jnp.sqrt(jnp.mean((y - p) ** 2)))
+    if w is None:
+        return float(jnp.sqrt(jnp.mean((y - p) ** 2)))
+    wt = jnp.asarray(w).astype(jnp.float32).ravel()
+    bad = jnp.any((wt > 0) & jnp.isnan(y - p))
+    se = jnp.where(wt > 0, jnp.nan_to_num(y - p) ** 2, 0.0)
+    out = jnp.sqrt(jnp.sum(wt * se) / jnp.sum(wt))
+    return float(jnp.where(bad, jnp.nan, out))
 
 
 def mae(y_true, pred) -> float:

@@ -59,21 +59,24 @@ class GBMParams:
 
 
 def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
-    """Training metrics from the CURRENT boosting margin (no re-predict)."""
+    """Training metrics from the CURRENT boosting margin (no re-predict).
+
+    Fully device-side with w-masking (pads/holdouts carry w=0): the
+    round-1 version round-tripped the 1M-row margin through the host,
+    which cost multiple seconds per call when the chip sits behind a
+    network tunnel."""
     from .. import metrics as M
 
-    ok = np.asarray(w) > 0
-    yv = np.asarray(y)[ok]
     if dist == "bernoulli":
-        p1 = np.asarray(jax.nn.sigmoid(margin))[ok]
-        return {"train_logloss": M.logloss(yv, p1),
-                "train_auc": M.roc_auc(yv, p1)}
+        p1 = jax.nn.sigmoid(margin)
+        return {"train_logloss": M.logloss(y, p1, w=w),
+                "train_auc": M.roc_auc(y, p1, w=w)}
     if dist == "multinomial":
-        pr = np.asarray(jax.nn.softmax(margin, axis=1))[ok]
-        return {"train_logloss": M.multinomial_logloss(yv, pr)}
+        pr = jax.nn.softmax(margin, axis=1)
+        return {"train_logloss": M.multinomial_logloss(y, pr, w=w)}
     if dist == "poisson":
-        return {"train_rmse": M.rmse(yv, np.exp(np.asarray(margin))[ok])}
-    return {"train_rmse": M.rmse(yv, np.asarray(margin)[ok])}
+        return {"train_rmse": M.rmse(y, jnp.exp(margin), w=w)}
+    return {"train_rmse": M.rmse(y, margin, w=w)}
 
 
 def _tree_sampling(p: "GBMParams", key_t, w, F: int):
@@ -223,7 +226,7 @@ class GBM:
             bin_spec = ckpt.bin_spec     # same binning → trees compose
         else:
             bin_spec = fit_bins(training_frame, data.feature_names,
-                                n_bins=p.nbins, seed=p.seed)
+                                n_bins=p.nbins)
         edges = jnp.asarray(bin_spec.edges_matrix())
         enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
         binned = jax.jit(apply_bins, static_argnums=3)(

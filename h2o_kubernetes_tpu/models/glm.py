@@ -12,17 +12,23 @@ DataInfo analog: numeric features are mean-imputed + standardized;
 categorical features expand to one-hot (with optional NA level and
 drop-first when unpenalized), all device-side.
 
-Families: gaussian (identity), binomial (logit), poisson (log).
-Solvers: IRLSM (+ ADMM proximal loop for elastic-net L1), L_BFGS
-(optax.lbfgs on the penalized deviance). lambda_search fits a warm-
-started descending λ path.
+Families (hex/glm/GLMModel.GLMParameters.Family [U3]): gaussian
+(identity), binomial (logit), poisson (log), gamma (inverse|log),
+tweedie (log, variance power in (1,2)), negativebinomial (log, theta),
+multinomial (softmax, L-BFGS path). Solvers: IRLSM (+ ADMM proximal
+loop for elastic-net L1), L_BFGS (optax.lbfgs on the penalized
+deviance), COORDINATE_DESCENT (glmnet-style cyclic CD on the weighted
+Gram inside the IRLS loop). lambda_search fits a warm-started
+descending λ path. compute_p_values adds std errors / z / p per
+coefficient from the inverse information matrix (λ=0, IRLSM only —
+the reference's restriction).
 """
 
 from __future__ import annotations
 
 import functools
 from dataclasses import dataclass, field
-from typing import Any, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -36,10 +42,19 @@ from .base import Model, TrainData, resolve_xy
 from .datainfo import DataInfo, build_datainfo
 
 
+_FAMILIES = ("gaussian", "binomial", "poisson", "gamma", "tweedie",
+             "negativebinomial", "multinomial")
+_SOLVERS = ("IRLSM", "L_BFGS", "COORDINATE_DESCENT")
+_DEFAULT_LINK = {"gaussian": "identity", "binomial": "logit",
+                 "poisson": "log", "gamma": "inverse", "tweedie": "log",
+                 "negativebinomial": "log", "multinomial": "multinomial"}
+
+
 @dataclass
 class GLMParams:
-    family: str = "gaussian"          # gaussian | binomial | poisson
-    solver: str = "IRLSM"             # IRLSM | L_BFGS
+    family: str = "gaussian"          # see _FAMILIES
+    solver: str = "IRLSM"             # see _SOLVERS
+    link: str | None = None           # None → family default
     alpha: float = 0.5                # elastic-net mixing (1 = lasso)
     lambda_: float | None = None      # None → 0 unless lambda_search
     lambda_search: bool = False
@@ -50,42 +65,118 @@ class GLMParams:
     max_iterations: int = 50
     objective_epsilon: float = 1e-6
     beta_epsilon: float = 1e-4
+    tweedie_variance_power: float = 1.5   # p in (1,2)
+    theta: float = 1.0                    # negativebinomial dispersion
+    compute_p_values: bool = False
     seed: int = 0
 
 
 # -- link/family math --------------------------------------------------------
 
-def _linkinv(family, eta):
-    if family == "binomial":
+class FamSpec(NamedTuple):
+    """Hashable (family, link, extras) bundle — a jit static argument."""
+
+    family: str
+    link: str
+    tvp: float = 1.5      # tweedie variance power
+    theta: float = 1.0    # negativebinomial dispersion
+
+
+def _linkinv(fam, eta):
+    if fam.link == "logit":
         return jax.nn.sigmoid(eta)
-    if family == "poisson":
+    if fam.link == "log":
         return jnp.exp(jnp.clip(eta, -30, 30))
+    if fam.link == "inverse":
+        # keep eta away from 0 preserving sign (reference GLM link inverse)
+        e = jnp.where(jnp.abs(eta) < 1e-6,
+                      jnp.where(eta < 0, -1e-6, 1e-6), eta)
+        return 1.0 / e
     return eta
 
 
-def _family_deviance(family, y, mu, w):
-    if family == "binomial":
+def _linkfun(fam, mu):
+    if fam.link == "logit":
+        return jnp.log(mu / (1.0 - mu))
+    if fam.link == "log":
+        return jnp.log(mu)
+    if fam.link == "inverse":
+        return 1.0 / mu
+    return mu
+
+
+def _dmu_deta(fam, eta, mu):
+    if fam.link == "logit":
+        return mu * (1.0 - mu)
+    if fam.link == "log":
+        return mu
+    if fam.link == "inverse":
+        return -(mu * mu)
+    return jnp.ones_like(eta)
+
+
+def _variance_fn(fam, mu):
+    f = fam.family
+    if f == "binomial":
+        return mu * (1.0 - mu)
+    if f == "poisson":
+        return mu
+    if f == "gamma":
+        return mu * mu
+    if f == "tweedie":
+        return jnp.power(jnp.clip(mu, 1e-10, None), fam.tvp)
+    if f == "negativebinomial":
+        return mu + fam.theta * mu * mu
+    return jnp.ones_like(mu)
+
+
+def _family_deviance(fam, y, mu, w):
+    f = fam.family
+    if f == "binomial":
         mu = jnp.clip(mu, 1e-7, 1 - 1e-7)
         ll = y * jnp.log(mu) + (1 - y) * jnp.log1p(-mu)
         return -2.0 * jnp.sum(w * ll)
-    if family == "poisson":
+    if f == "poisson":
         mu = jnp.clip(mu, 1e-10, None)
         t = jnp.where(y > 0, y * jnp.log(y / mu), 0.0)
         return 2.0 * jnp.sum(w * (t - (y - mu)))
+    if f == "gamma":
+        mu = jnp.clip(mu, 1e-10, None)
+        ys = jnp.clip(y, 1e-10, None)
+        return 2.0 * jnp.sum(w * ((y - mu) / mu - jnp.log(ys / mu)))
+    if f == "tweedie":
+        p_ = fam.tvp
+        mu = jnp.clip(mu, 1e-10, None)
+        ys = jnp.clip(y, 0.0, None)
+        t1 = jnp.where(ys > 0,
+                       jnp.power(jnp.clip(ys, 1e-10, None), 2 - p_) /
+                       ((1 - p_) * (2 - p_)), 0.0)
+        return 2.0 * jnp.sum(w * (
+            t1 - ys * jnp.power(mu, 1 - p_) / (1 - p_)
+            + jnp.power(mu, 2 - p_) / (2 - p_)))
+    if f == "negativebinomial":
+        th = fam.theta
+        mu = jnp.clip(mu, 1e-10, None)
+        t1 = jnp.where(y > 0, y * jnp.log(jnp.clip(y, 1e-10, None) / mu),
+                       0.0)
+        t2 = (y + 1.0 / th) * jnp.log((1 + th * y) / (1 + th * mu))
+        return 2.0 * jnp.sum(w * (t1 - t2))
     return jnp.sum(w * (y - mu) ** 2)
 
 
-def _irls_weights(family, eta, mu, y):
-    """(working weight, working response z) for one IRLS step."""
-    if family == "binomial":
-        wk = jnp.clip(mu * (1 - mu), 1e-10, None)
-        z = eta + (y - mu) / wk
-        return wk, z
-    if family == "poisson":
-        wk = jnp.clip(mu, 1e-10, None)
-        z = eta + (y - mu) / wk
-        return wk, z
-    return jnp.ones_like(eta), y
+def _irls_weights(fam, eta, mu, y):
+    """(working weight, working response z) for one IRLS step:
+    wk = (dμ/dη)²/V(μ), z = η + (y-μ)/(dμ/dη) — the standard Fisher
+    scoring construction, matching GLMIterationTask's per-row math."""
+    if fam.family == "gaussian" and fam.link == "identity":
+        return jnp.ones_like(eta), y
+    d = _dmu_deta(fam, eta, mu)
+    V = _variance_fn(fam, mu)
+    safe_d = jnp.where(jnp.abs(d) < 1e-10,
+                       jnp.where(d < 0, -1e-10, 1e-10), d)
+    wk = jnp.clip(d * d / jnp.clip(V, 1e-10, None), 1e-10, None)
+    z = eta + (y - mu) / safe_d
+    return wk, z
 
 
 # -- distributed accumulations (the GLMIterationTask analogs) ---------------
@@ -125,7 +216,7 @@ def _gram_task(Xe, wk, z, w, mesh):
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
-def _eta_dev_task(Xe, beta, yw, family, mesh):
+def _eta_dev_task(Xe, beta, yw, fam, mesh):
     """Per-shard eta + deviance psum → (dev, eta). yw: [R,2] (y, w).
 
     Returning eta (row-sharded) lets the IRLS loop reuse this matmul for
@@ -134,8 +225,8 @@ def _eta_dev_task(Xe, beta, yw, family, mesh):
 
     def body(xs, yws, b):
         eta = xs @ b
-        mu = _linkinv(family, eta)
-        dev = _family_deviance(family, yws[:, 0], mu, yws[:, 1])
+        mu = _linkinv(fam, eta)
+        dev = _family_deviance(fam, yws[:, 0], mu, yws[:, 1])
         return lax.psum(dev, ROWS), eta
 
     return jax.shard_map(body, mesh=mesh,
@@ -179,6 +270,35 @@ def _chol_solve(G, b, lam_l2):
     return jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(A), b)
 
 
+@functools.partial(jax.jit, static_argnums=(5,))
+def _cd_solve(G, b, beta0, lam_l1, lam_l2, n_sweeps: int = 50):
+    """Cyclic coordinate descent on ½βᵀGβ - bᵀβ + λ₁|β|₁ + ½λ₂|β|²
+    (glmnet covariance updates — the reference's COORDINATE_DESCENT
+    solver, hex/glm GLM.Solver.COORDINATE_DESCENT [U3]). Operates on
+    the same normalized Gram as the Cholesky/ADMM paths; the intercept
+    (last coordinate) is unpenalized."""
+    Pn = G.shape[0]
+    pen = jnp.ones(Pn).at[Pn - 1].set(0.0)
+    diag = jnp.diagonal(G)
+
+    def coord(j, beta):
+        gj = b[j] - G[j] @ beta + diag[j] * beta[j]
+        bj = _soft(gj, lam_l1 * pen[j]) / \
+            (diag[j] + lam_l2 * pen[j] + 1e-10)
+        return beta.at[j].set(bj)
+
+    def sweep(beta, _):
+        return lax.fori_loop(0, Pn, coord, beta), None
+
+    beta, _ = lax.scan(sweep, beta0, None, length=n_sweeps)
+    return beta
+
+
+def _famspec(p: GLMParams) -> FamSpec:
+    return FamSpec(p.family, p.link or _DEFAULT_LINK[p.family],
+                   p.tweedie_variance_power, p.theta)
+
+
 # -- model ------------------------------------------------------------------
 
 class GLMModel(Model):
@@ -197,10 +317,24 @@ class GLMModel(Model):
         self.residual_deviance = residual_deviance
         self.n_iterations = n_iterations
 
-    def coef(self) -> dict[str, float]:
-        """De-standardized coefficients in original units."""
+    def coef(self) -> dict:
+        """De-standardized coefficients in original units.
+
+        Multinomial: {class_label: {coef_name: value}} (h2o-py returns
+        a per-class table; a dict-of-dicts is the Python-first shape).
+        """
         b = np.asarray(self.beta, dtype=np.float64)
         names = self.dinfo.coef_names
+        if b.ndim == 2:
+            out = {}
+            doms = self.response_domain or [str(k)
+                                            for k in range(b.shape[1])]
+            for k, lbl in enumerate(doms):
+                sub = GLMModel.__new__(GLMModel)
+                sub.beta = self.beta[:, k]
+                sub.dinfo = self.dinfo
+                out[lbl] = GLMModel.coef(sub)
+            return out
         out = dict(zip(names, b))
         icpt = out["Intercept"]
         nnum = len(self.dinfo.numeric_idx)
@@ -219,10 +353,69 @@ class GLMModel(Model):
     def _score_matrix(self, X: jax.Array) -> jax.Array:
         Xe = self.dinfo.expand(X)
         eta = Xe @ self.beta
-        mu = _linkinv(self.params.family, eta)
+        if self.params.family == "multinomial":
+            return jax.nn.softmax(eta, axis=1)
+        mu = _linkinv(_famspec(self.params), eta)
         if self.params.family == "binomial":
             return jnp.stack([1 - mu, mu], axis=1)
         return mu
+
+    # -- inference statistics (compute_p_values) ----------------------------
+
+    def _fit_inference(self, Xe, data, fam, mesh) -> None:
+        """Std errors / z / p from the inverse Fisher information
+        XᵀWX⁻¹·φ at the fitted β (hex/glm computePValues [U3]),
+        de-standardized through the same affine map as coef()."""
+        eta = Xe @ self.beta
+        mu = _linkinv(fam, eta)
+        wk, _ = _irls_weights(fam, eta, mu, data.y)
+        G, _ = _gram_task(Xe, wk, jnp.zeros_like(eta), data.w, mesh)
+        n = float(jnp.sum(data.w))
+        Pn = G.shape[0]
+        k = Pn  # parameters incl. intercept
+        if fam.family in ("gaussian", "gamma", "tweedie"):
+            # moment estimate of the dispersion φ (Pearson X²/(n-k))
+            V = _variance_fn(fam, mu)
+            pearson = float(jnp.sum(
+                data.w * (data.y - mu) ** 2 / jnp.clip(V, 1e-10, None)))
+            phi = pearson / max(n - k, 1.0)
+        else:
+            phi = 1.0
+        cov = np.linalg.inv(np.asarray(G, dtype=np.float64)
+                            + 1e-10 * np.eye(Pn)) * phi
+        # de-standardization is linear: coef_orig = A @ coef_std
+        A = np.eye(Pn)
+        nnum = len(self.dinfo.numeric_idx)
+        for j in range(nnum):
+            A[j, j] = 1.0 / self.dinfo.stds[j]
+            A[Pn - 1, j] = -self.dinfo.means[j] / self.dinfo.stds[j]
+        cov_o = A @ cov @ A.T
+        se = np.sqrt(np.clip(np.diag(cov_o), 0, None))
+        names = self.dinfo.coef_names
+        coefs = self.coef()
+        b = np.array([coefs[nm] for nm in names])
+        with np.errstate(invalid="ignore", divide="ignore"):
+            z = b / se
+        from scipy.stats import norm
+        pv = 2.0 * norm.sf(np.abs(z))
+        self._std_errs = dict(zip(names, se))
+        self._z_values = dict(zip(names, z))
+        self._p_values = dict(zip(names, pv))
+
+    def std_errs(self) -> dict[str, float]:
+        return self._require_inference("_std_errs")
+
+    def zvalues(self) -> dict[str, float]:
+        return self._require_inference("_z_values")
+
+    def pvalues(self) -> dict[str, float]:
+        return self._require_inference("_p_values")
+
+    def _require_inference(self, attr):
+        if not hasattr(self, attr):
+            raise ValueError(
+                "train with compute_p_values=True to get inference stats")
+        return getattr(self, attr)
 
 
 class GLM:
@@ -236,30 +429,34 @@ class GLM:
 
     def _fit_beta(self, Xe, data, dinfo, lam, beta0, mesh):
         p = self.params
+        fam = _famspec(p)
         Pn = dinfo.n_expanded
         lam_l1 = lam * p.alpha
         lam_l2 = lam * (1 - p.alpha)
         n_obs = float(jnp.sum(data.w))
         beta = beta0
         yw = jnp.stack([data.y, data.w], axis=1)
-        dev0, eta = _eta_dev_task(Xe, beta, yw, p.family, mesh)
+        dev0, eta = _eta_dev_task(Xe, beta, yw, fam, mesh)
         dev_prev = float(dev0)
         it = 0
         for it in range(1, p.max_iterations + 1):
-            mu = _linkinv(p.family, eta)       # eta reused from last solve
-            wk, z = _irls_weights(p.family, eta, mu, data.y)
+            mu = _linkinv(fam, eta)            # eta reused from last solve
+            wk, z = _irls_weights(fam, eta, mu, data.y)
             G, b = _gram_task(Xe, wk, z, data.w, mesh)
             G = G / n_obs
             b = b / n_obs
-            if lam_l1 > 0:
+            if p.solver == "COORDINATE_DESCENT":
+                beta_new = _cd_solve(G, b, beta, lam_l1, lam_l2)
+            elif lam_l1 > 0:
                 beta_new = _admm_solve(G, b, lam_l1, lam_l2)
             else:
                 beta_new = _chol_solve(G, b, lam_l2)
-            dev_new, eta = _eta_dev_task(Xe, beta_new, yw, p.family, mesh)
+            dev_new, eta = _eta_dev_task(Xe, beta_new, yw, fam, mesh)
             dev = float(dev_new)
             db = float(jnp.max(jnp.abs(beta_new - beta)))
             beta = beta_new
-            if p.family == "gaussian" and lam_l1 == 0:
+            if fam.family == "gaussian" and fam.link == "identity" \
+                    and lam_l1 == 0 and p.solver == "IRLSM":
                 break                      # exact one-shot solve
             if abs(dev_prev - dev) < p.objective_epsilon * \
                     (abs(dev_prev) + 1e-10) or db < p.beta_epsilon:
@@ -277,46 +474,76 @@ class GLM:
         if self.cv_args.fold_column:
             ignored_columns = list(ignored_columns or []) + \
                 [self.cv_args.fold_column]
-        if p.family not in ("gaussian", "binomial", "poisson"):
+        if p.family not in _FAMILIES:
             raise ValueError(f"unknown family '{p.family}' (supported: "
-                             "gaussian, binomial, poisson)")
-        if p.solver not in ("IRLSM", "L_BFGS"):
+                             f"{', '.join(_FAMILIES)})")
+        if p.solver not in _SOLVERS:
             raise ValueError(f"unknown solver '{p.solver}' (supported: "
-                             "IRLSM, L_BFGS)")
+                             f"{', '.join(_SOLVERS)})")
+        fam = _famspec(p)
+        if p.family == "tweedie" and not 1.0 < p.tweedie_variance_power < 2.0:
+            raise ValueError("tweedie_variance_power must be in (1, 2)")
+        if p.compute_p_values:
+            # reference restriction (GLM.java): p-values need the exact
+            # information matrix — IRLSM, no regularization
+            if p.solver != "IRLSM":
+                raise ValueError("compute_p_values requires solver='IRLSM'")
+            if p.lambda_search or (p.lambda_ or 0.0) > 0:
+                raise ValueError("compute_p_values requires lambda=0")
+            if p.family == "multinomial":
+                raise ValueError(
+                    "compute_p_values is not supported for multinomial")
         mesh = global_mesh()
-        fam_dist = {"binomial": "bernoulli"}.get(p.family, p.family)
+        fam_dist = {"binomial": "bernoulli", "gamma": "gaussian",
+                    "tweedie": "gaussian", "negativebinomial": "poisson",
+                    }.get(p.family, p.family)
         data = resolve_xy(training_frame, y, x, ignored_columns,
                           weights_column, fam_dist)
         if p.family == "binomial" and data.nclasses != 2:
             raise ValueError("binomial family needs a 2-class response")
-        if p.family != "binomial" and data.nclasses > 1:
+        if p.family == "multinomial" and data.nclasses < 2:
+            raise ValueError(
+                "multinomial family needs a categorical response")
+        if p.family not in ("binomial", "multinomial") and data.nclasses > 1:
             raise ValueError(
                 f"family='{p.family}' needs a numeric response; "
                 f"'{y}' is categorical")
+        ymin = float(jnp.nanmin(data.y)) if p.family in (
+            "gamma", "tweedie", "poisson", "negativebinomial") else 0.0
+        if p.family == "gamma" and ymin <= 0:
+            raise ValueError("gamma family needs a strictly positive "
+                             "response")
+        if p.family in ("tweedie", "poisson", "negativebinomial") \
+                and ymin < 0:
+            raise ValueError(f"{p.family} family needs a non-negative "
+                             "response")
         dinfo = build_datainfo(data, training_frame, p.standardize,
                                drop_first=not p.use_all_factor_levels)
         Xe = jax.jit(dinfo.expand)(data.X)
         Pn = dinfo.n_expanded
         n_obs = float(jnp.sum(data.w))
+
+        if p.family == "multinomial":
+            return self._train_multinomial(
+                y, training_frame, x, ignored_columns, weights_column,
+                validation_frame, data, dinfo, Xe, mesh)
         yw = jnp.stack([data.y, data.w], axis=1)
 
-        # null deviance (intercept-only model)
+        # null deviance (intercept-only model: intercept = link(ȳ))
         ybar = float(jnp.sum(data.y * data.w)) / n_obs
         if p.family == "binomial":
             ybar = min(max(ybar, 1e-7), 1 - 1e-7)
-            b0 = np.log(ybar / (1 - ybar))
-        elif p.family == "poisson":
-            b0 = np.log(max(ybar, 1e-10))
-        else:
-            b0 = ybar
+        elif fam.link in ("log", "inverse"):
+            ybar = max(ybar, 1e-10)
+        b0 = float(_linkfun(fam, jnp.float32(ybar)))
         beta_null = jnp.zeros(Pn).at[Pn - 1].set(b0)
-        null_dev = float(_eta_dev_task(Xe, beta_null, yw, p.family,
+        null_dev = float(_eta_dev_task(Xe, beta_null, yw, fam,
                                          mesh)[0])
 
         if p.lambda_search:
             # λ_max: smallest λ zeroing all coefs (from null-model gradient)
             eta0 = Xe @ beta_null
-            mu0 = _linkinv(p.family, eta0)
+            mu0 = _linkinv(fam, eta0)
             grad = np.asarray(jnp.abs(
                 Xe.T @ ((mu0 - data.y) * data.w))) / n_obs
             lam_max = float(grad[:-1].max()) / max(p.alpha, 1e-3)
@@ -342,6 +569,81 @@ class GLM:
 
         model = GLMModel(data, p, dinfo, beta, lam_used, null_dev, dev,
                          iters)
+        if p.compute_p_values:
+            model._fit_inference(Xe, data, fam, mesh)
+        from .cv import finalize_train
+
+        return finalize_train(
+            self, model, y, training_frame,
+            {"x": x, "ignored_columns": ignored_columns,
+             "weights_column": weights_column},
+            validation_frame)
+
+    def _train_multinomial(self, y, training_frame, x, ignored_columns,
+                           weights_column, validation_frame, data, dinfo,
+                           Xe, mesh):
+        """Softmax regression: β is [P, K]; the deviance is the
+        multinomial -2·loglik psum'd over row shards; solved with
+        L-BFGS regardless of `solver` (the reference also routes
+        multinomial to its gradient solvers for K>2)."""
+        import optax
+
+        p = self.params
+        K = data.nclasses
+        Pn = dinfo.n_expanded
+        n_obs = float(jnp.sum(data.w))
+        pen_mask = jnp.ones(Pn).at[Pn - 1].set(0.0)[:, None]
+        lam = p.lambda_ if p.lambda_ is not None else 0.0
+        lam_l2 = lam * (1 - p.alpha)
+        lam_l1 = lam * p.alpha
+        yw = jnp.stack([data.y, data.w], axis=1)
+
+        def dev_fn(B):
+            def body(xs, yws, b):
+                eta = xs @ b                       # [r, K]
+                logp = jax.nn.log_softmax(eta, axis=1)
+                yk = yws[:, 0].astype(jnp.int32)
+                ll = jnp.take_along_axis(logp, yk[:, None], axis=1)[:, 0]
+                return lax.psum(-2.0 * jnp.sum(yws[:, 1] * ll), ROWS)
+
+            return jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P(ROWS), P(ROWS), P()),
+                                 out_specs=P())(Xe, yw, B)
+
+        def obj(B):
+            penal = 0.5 * lam_l2 * jnp.sum((pen_mask * B) ** 2) + \
+                lam_l1 * jnp.sum(jnp.abs(pen_mask * B))
+            return 0.5 * dev_fn(B) / n_obs + penal
+
+        # class priors as the intercept init (the null model)
+        pri = np.zeros(K, dtype=np.float32)
+        for k in range(K):
+            pri[k] = float(jnp.sum((data.y == k) * data.w)) / n_obs
+        B = jnp.zeros((Pn, K)).at[Pn - 1].set(
+            jnp.log(jnp.clip(jnp.asarray(pri), 1e-8, None)))
+        null_dev = float(dev_fn(B))
+
+        opt = optax.lbfgs()
+        state = opt.init(B)
+        value_and_grad = jax.value_and_grad(obj)
+
+        @jax.jit
+        def step(B, state):
+            value, grad = value_and_grad(B)
+            updates, state = opt.update(grad, state, B, value=value,
+                                        grad=grad, value_fn=obj)
+            return optax.apply_updates(B, updates), state, value
+
+        prev, it = np.inf, 0
+        for it in range(1, p.max_iterations + 1):
+            B, state, value = step(B, state)
+            v = float(value)
+            if abs(prev - v) < p.objective_epsilon * (abs(prev) + 1e-10):
+                break
+            prev = v
+        dev = float(dev_fn(B))
+
+        model = GLMModel(data, p, dinfo, B, lam, null_dev, dev, it)
         from .cv import finalize_train
 
         return finalize_train(
@@ -354,6 +656,7 @@ class GLM:
         import optax
 
         p = self.params
+        fam = _famspec(p)
         n_obs = float(jnp.sum(data.w))
         lam_l2 = lam * (1 - p.alpha)
         lam_l1 = lam * p.alpha
@@ -364,9 +667,9 @@ class GLM:
         def obj(beta):
             def body(xs, yws, b):
                 eta = xs @ b
-                mu = _linkinv(p.family, eta)
+                mu = _linkinv(fam, eta)
                 return lax.psum(
-                    _family_deviance(p.family, yws[:, 0], mu, yws[:, 1]),
+                    _family_deviance(fam, yws[:, 0], mu, yws[:, 1]),
                     ROWS)
 
             dev = jax.shard_map(body, mesh=mesh,
@@ -397,5 +700,5 @@ class GLM:
             if abs(prev - v) < p.objective_epsilon * (abs(prev) + 1e-10):
                 break
             prev = v
-        dev = float(_eta_dev_task(Xe, beta, yw, p.family, mesh)[0])
+        dev = float(_eta_dev_task(Xe, beta, yw, fam, mesh)[0])
         return beta, dev, it

@@ -49,15 +49,31 @@ class BinSpec:
         return m
 
 
-def fit_bins(frame, feature_names: list[str], n_bins: int = 256,
-             sample: int = 200_000, seed: int = 0) -> BinSpec:
-    """Compute quantile edges per numeric feature (host-side, sampled)."""
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _device_quantiles(Xn: jax.Array, n_q: int) -> jax.Array:
+    """Per-column quantile edges on device: [n, Fn] → [Fn, n_q].
+
+    Full-data nanquantile (one sort per column on the accelerator)
+    replaces round-1's host-side sampled np.quantile — for a 1M-row
+    frame the host path cost seconds of transfer + permutation sampling
+    per train() call."""
+    qs = jnp.linspace(0.0, 1.0, n_q + 2)[1:-1]
+    return jax.vmap(lambda c: jnp.nanquantile(c, qs))(Xn.T)
+
+
+def fit_bins(frame, feature_names: list[str],
+             n_bins: int = 256) -> BinSpec:
+    """Compute quantile edges per numeric feature (device-side)."""
     if not 4 <= n_bins <= 256:
         raise ValueError(f"n_bins must be in [4, 256] (uint8 bin codes), "
                          f"got {n_bins}")
-    rng = np.random.default_rng(seed)
-    edges: list[np.ndarray] = []
+    edges: list[np.ndarray | None] = []
     is_enum: list[bool] = []
+    num_idx: list[int] = []
+    num_cols = []
     for name in feature_names:
         v = frame.vec(name)
         if v.is_enum():
@@ -69,18 +85,16 @@ def fit_bins(frame, feature_names: list[str], n_bins: int = 256,
             edges.append(np.arange(1, card, dtype=np.float32) - 0.5)
             is_enum.append(True)
             continue
-        x = v.to_numpy()
-        x = x[~np.isnan(x)]
-        if len(x) > sample:
-            x = rng.choice(x, size=sample, replace=False)
-        if len(x) == 0:
-            edges.append(np.empty(0, dtype=np.float32))
-            is_enum.append(False)
-            continue
-        qs = np.quantile(x, np.linspace(0, 1, n_bins - 1)[1:-1])
-        e = np.unique(qs.astype(np.float32))
-        edges.append(e)
+        num_idx.append(len(edges))
+        num_cols.append(v.as_float())
+        edges.append(None)
         is_enum.append(False)
+    if num_cols:
+        Q = np.asarray(_device_quantiles(jnp.stack(num_cols, axis=1),
+                                         n_bins - 3))
+        for j, i in enumerate(num_idx):
+            q = Q[j][~np.isnan(Q[j])]
+            edges[i] = np.unique(q.astype(np.float32))
     return BinSpec(names=list(feature_names), edges=edges, is_enum=is_enum,
                    n_bins=n_bins)
 

@@ -142,12 +142,34 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
 
     rel = jnp.zeros(binned.shape[0], dtype=jnp.int32)   # relative node @ lvl
 
+    hist_prev = None        # parent histograms for sibling subtraction
+    can_prev = None
     for d in range(p.max_depth + 1):
         n_nodes = 2 ** d
         off = n_nodes - 1
-        hist = _build_histogram_op(binned, rel, g, h, w, n_nodes,
-                                   p.n_bins, impl=p.hist_impl)
-        hist = lax.psum(hist, ROWS)                     # MRTask reduce
+        if d == 0:
+            hist = _build_histogram_op(binned, rel, g, h, w, 1,
+                                       p.n_bins, impl=p.hist_impl)
+            hist = lax.psum(hist, ROWS)                 # MRTask reduce
+        else:
+            # sibling subtraction (the XGBoost/LightGBM trick): histogram
+            # only LEFT children, derive right = parent - left. Halves
+            # the hot-loop FLOPs and the psum payload at every level.
+            # Valid because every live row of a split parent lands in
+            # exactly one child; children of non-split parents are
+            # zeroed so _find_splits can't fabricate splits from the
+            # stale parent mass.
+            left_rel = jnp.where((rel >= 0) & (rel % 2 == 0), rel // 2, -1)
+            hist_l = _build_histogram_op(binned, left_rel, g, h, w,
+                                         n_nodes // 2, p.n_bins,
+                                         impl=p.hist_impl)
+            hist_l = lax.psum(hist_l, ROWS)
+            parent = jnp.where(can_prev[:, None, None, None], hist_prev,
+                               0.0)
+            hist_l = jnp.where(can_prev[:, None, None, None], hist_l, 0.0)
+            hist_r = parent - hist_l
+            hist = jnp.stack([hist_l, hist_r], axis=1).reshape(
+                n_nodes, F, p.n_bins, 3)
         feat_ok = jnp.broadcast_to(col_mask[None, :], (n_nodes, F))
         if p.mtries > 0 and p.mtries < F:
             # DRF: exactly mtries features per node (reference: DTree
@@ -168,6 +190,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         gain = gain.at[idx].set(jnp.where(can, g_best, 0.0))
         if d == p.max_depth:
             break
+        hist_prev, can_prev = hist, can
         # descend rows: dead rows stay dead; rows in non-split nodes die
         live = rel >= 0
         safe_rel = jnp.where(live, rel, 0)

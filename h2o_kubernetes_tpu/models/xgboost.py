@@ -260,8 +260,7 @@ class XGBoost(GBM):
         gfull[frame.nrows:] = top + np.arange(padded - frame.nrows)
         layout = _GroupLayout(gfull, padded)
 
-        bin_spec = fit_bins(frame, data.feature_names, n_bins=p.nbins,
-                            seed=p.seed)
+        bin_spec = fit_bins(frame, data.feature_names, n_bins=p.nbins)
         edges = jnp.asarray(bin_spec.edges_matrix())
         enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
         binned = jax.jit(apply_bins, static_argnums=3)(

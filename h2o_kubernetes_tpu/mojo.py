@@ -7,7 +7,9 @@ cluster. Here the artifact is a zip of npz arrays + JSON metadata, and
 artifact runs on any serving host.
 
 Supported: GBM / DRF / XGBoost (trees + bin edges), GLM (beta + design
-layout), KMeans (centers).
+layout, all families/links incl. multinomial), KMeans (centers),
+DeepLearning (layer weights; MLP, softmax and autoencoder modes),
+NaiveBayes (priors + likelihood tables), PCA (eigenvectors).
 """
 
 from __future__ import annotations
@@ -53,7 +55,10 @@ def export_mojo(model, path: str) -> str:
                   "value"):
             arrays[f"tree_{f}"] = _np(getattr(model.trees, f))
     elif algo == "glm":
+        from .models.glm import _famspec
+
         meta["family"] = model.params.family
+        meta["link"] = _famspec(model.params).link
         arrays["beta"] = _np(model.beta)
         d = model.dinfo
         meta["numeric_idx"] = list(d.numeric_idx)
@@ -61,6 +66,38 @@ def export_mojo(model, path: str) -> str:
         meta["drop_first"] = d.drop_first
         arrays["means"] = _np(d.means)
         arrays["stds"] = _np(d.stds)
+    elif algo == "deeplearning":
+        meta["activation"] = model.params.activation
+        meta["loss_kind"] = model.loss_kind
+        meta["autoencoder"] = bool(model.params.autoencoder)
+        meta["n_layers"] = len(model.net)
+        d = model.dinfo
+        meta["numeric_idx"] = list(d.numeric_idx)
+        meta["enum_specs"] = [list(s) for s in d.enum_specs]
+        meta["drop_first"] = d.drop_first
+        arrays["means"] = _np(d.means)
+        arrays["stds"] = _np(d.stds)
+        for i, lyr in enumerate(model.net):
+            arrays[f"net_{i}_w"] = _np(lyr["w"])
+            arrays[f"net_{i}_b"] = _np(lyr["b"])
+    elif algo == "naivebayes":
+        meta["num_cols"] = list(model.num_cols)
+        meta["enum_cols"] = list(model.enum_cols)
+        meta["n_enum_tables"] = len(model.enum_tables)
+        arrays["priors"] = _np(model.priors)
+        arrays["num_mean"] = _np(model.num_mean)
+        arrays["num_sd"] = _np(model.num_sd)
+        for i, tab in enumerate(model.enum_tables):
+            arrays[f"nbtab_{i}"] = _np(tab)
+    elif algo == "pca":
+        d = model.dinfo
+        meta["numeric_idx"] = list(d.numeric_idx)
+        meta["enum_specs"] = [list(s) for s in d.enum_specs]
+        meta["drop_first"] = d.drop_first
+        arrays["means"] = _np(d.means)
+        arrays["stds"] = _np(d.stds)
+        arrays["eigenvectors"] = _np(model.eigenvectors)
+        arrays["eigenvalues"] = _np(model.eigenvalues)
     elif algo == "kmeans":
         arrays["centers"] = _np(model.centers_std)
         d = model.dinfo
@@ -127,6 +164,12 @@ class MojoModel:
             return self._predict_glm(X)
         if self.algo == "kmeans":
             return self._predict_kmeans(X)
+        if self.algo == "deeplearning":
+            return self._predict_deeplearning(X)
+        if self.algo == "naivebayes":
+            return self._predict_naivebayes(X)
+        if self.algo == "pca":
+            return self._predict_pca(X)
         raise ValueError(self.algo)
 
     # -- scorers -------------------------------------------------------------
@@ -223,12 +266,66 @@ class MojoModel:
         Xe = self._expand(X)
         eta = Xe @ self.arrays["beta"]
         fam = self.meta["family"]
-        if fam == "binomial":
+        if fam == "multinomial":
+            z = np.exp(eta - eta.max(axis=1, keepdims=True))
+            return z / z.sum(axis=1, keepdims=True)
+        link = self.meta.get("link", "identity")
+        if link == "logit":
             mu = 1.0 / (1.0 + np.exp(-eta))
+        elif link == "log":
+            mu = np.exp(np.clip(eta, -30, 30))
+        elif link == "inverse":
+            e = np.where(np.abs(eta) < 1e-6,
+                         np.where(eta < 0, -1e-6, 1e-6), eta)
+            mu = 1.0 / e
+        else:
+            mu = eta
+        if fam == "binomial":
             return np.stack([1 - mu, mu], axis=1)
-        if fam == "poisson":
-            return np.exp(np.clip(eta, -30, 30))
-        return eta
+        return mu
+
+    def _predict_deeplearning(self, X):
+        m = self.meta
+        h = self._expand(X)[:, :-1]          # bias lives in the layers
+        act = np.tanh if m["activation"] == "tanh" else \
+            (lambda v: np.maximum(v, 0.0))
+        L = m["n_layers"]
+        for i in range(L - 1):
+            h = act(h @ self.arrays[f"net_{i}_w"] +
+                    self.arrays[f"net_{i}_b"])
+        out = h @ self.arrays[f"net_{L-1}_w"] + self.arrays[f"net_{L-1}_b"]
+        if m["loss_kind"] == "ce":
+            z = np.exp(out - out.max(axis=1, keepdims=True))
+            return z / z.sum(axis=1, keepdims=True)
+        if m["autoencoder"]:
+            return out
+        return out[:, 0]
+
+    def _predict_naivebayes(self, X):
+        m = self.meta
+        K = m["nclasses"]
+        ll = np.broadcast_to(np.log(self.arrays["priors"])[None, :],
+                             (X.shape[0], K)).copy()
+        if m["num_cols"]:
+            Xn = X[:, np.asarray(m["num_cols"])]
+            mu, sd = self.arrays["num_mean"], self.arrays["num_sd"]
+            z = (Xn[:, None, :] - mu[None]) / sd[None]
+            lp = -0.5 * z * z - np.log(sd)[None]
+            lp = np.where(np.isnan(Xn)[:, None, :], 0.0, lp)
+            ll += lp.sum(axis=2)
+        for i, ci in enumerate(m["enum_cols"]):
+            tab = self.arrays[f"nbtab_{i}"]
+            c = X[:, ci]
+            code = np.clip(np.where(np.isnan(c), 0, c).astype(np.int64),
+                           0, tab.shape[1] - 1)
+            lp = np.log(tab.T)[code]
+            ll += np.where(np.isnan(c)[:, None], 0.0, lp)
+        mx = ll.max(axis=1, keepdims=True)
+        p = np.exp(ll - mx)
+        return p / p.sum(axis=1, keepdims=True)
+
+    def _predict_pca(self, X):
+        return self._expand(X)[:, :-1] @ self.arrays["eigenvectors"]
 
     def _predict_kmeans(self, X):
         Xe = self._expand(X)[:, :-1]

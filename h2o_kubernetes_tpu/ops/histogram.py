@@ -31,7 +31,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-ROW_TILE = 512
+# HIGHEST: the MXU's default bf16 multiply loses ~0.4% on the gradient
+# sums; the 3-pass f32 emulation keeps parity with the segment path and
+# is FREE here — the [3, T] LHS fills 3/128 of the systolic array, so
+# the kernel is bound by array occupancy, not by pass count (measured
+# 125ms either way on v5e for the 1M-row level-5 build).
+_PREC = jax.lax.Precision.HIGHEST
+ROW_TILE = 1024  # 1-D s32 operands carry XLA layout T(1024): the row
+#                  block must match it or Mosaic rejects the layouts
 
 
 def _hist_segment(binned, rel, vals, n_nodes: int, n_bins: int):
@@ -65,16 +72,20 @@ def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bins = binned_ref[0, :].astype(jnp.int32)        # [T]
-    rel = rel_ref[:, 0]                              # [T]
-    seg = rel * n_bins + bins                        # dead rows: negative
+    bins = binned_ref[:]                             # [T]
+    rel = rel_ref[:]                                 # [T]
+    seg = rel * n_bins + bins
     base = nb * nbt
     iota = lax.broadcasted_iota(jnp.int32, (bins.shape[0], nbt), 1)
-    onehot = ((seg[:, None] - base) == iota) & (rel >= 0)[:, None]
+    # dead rows (rel=-1) give seg in [-n_bins, -1], which can never equal
+    # a non-negative iota slot — no explicit liveness mask needed (a bool
+    # [:, None] broadcast is also unsupported by Mosaic for non-32-bit)
+    onehot = (seg[:, None] - base) == iota
     vals_t = vals_ref[:].T                           # [3, T]
     out_ref[0] += lax.dot_general(
         vals_t, onehot.astype(jnp.float32),
         dimension_numbers=(((1,), (0,)), ((), ())),
+        precision=_PREC,
         preferred_element_type=jnp.float32)          # [3, NBT] on the MXU
 
 
@@ -82,16 +93,25 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
     r, F = binned.shape
     nB = n_nodes * n_bins
     nbt = _bin_block(n_nodes, n_bins)
+    if nbt % 128 and nbt != nB:
+        # un-tileable bin block (non-power-of-2 n_bins hitting the lane
+        # cap mid-range) — Mosaic requires the last block dim be a
+        # multiple of 128 or the whole array; fall back off the MXU path
+        return _hist_segment(binned, rel, vals, n_nodes, n_bins)
     pad = (-r) % ROW_TILE
     if pad:
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
         rel = jnp.pad(rel, (0, pad), constant_values=-1)
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
     rp = r + pad
-    binned_t = binned.T.astype(jnp.int32)            # [F, rp]
-    rel2 = rel[:, None]                              # [rp, 1]
+    # feature-major flat row stream: 1-D blocks of ROW_TILE satisfy the
+    # TPU lane tiling where a (1, ROW_TILE) 2-D block cannot (its
+    # sublane dim 1 is neither 8-divisible nor the full axis)
+    binned_flat = binned.T.astype(jnp.int32).reshape(F * rp)
+    rel32 = rel.astype(jnp.int32)
+    rblocks = rp // ROW_TILE
 
-    grid = (F, nB // nbt, rp // ROW_TILE)
+    grid = (F, nB // nbt, rblocks)
     # under shard_map the output varies per shard: propagate the input's
     # varying-mesh-axes set or jax's vma check rejects the call
     vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
@@ -100,13 +120,14 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
         out_shape=jax.ShapeDtypeStruct((F, 3, nB), jnp.float32, vma=vma),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, ROW_TILE), lambda f, nb, rt: (f, rt)),
-            pl.BlockSpec((ROW_TILE, 1), lambda f, nb, rt: (rt, 0)),
+            pl.BlockSpec((ROW_TILE,),
+                         lambda f, nb, rt, rb=rblocks: (f * rb + rt,)),
+            pl.BlockSpec((ROW_TILE,), lambda f, nb, rt: (rt,)),
             pl.BlockSpec((ROW_TILE, 3), lambda f, nb, rt: (rt, 0)),
         ],
         out_specs=pl.BlockSpec((1, 3, nbt), lambda f, nb, rt: (f, 0, nb)),
         interpret=jax.default_backend() != "tpu",
-    )(binned_t, rel2, vals)
+    )(binned_flat, rel32, vals)
     # [F, 3, n*B] -> [n, F, B, 3]
     return out.reshape(F, 3, n_nodes, n_bins).transpose(2, 0, 3, 1)
 

@@ -26,9 +26,41 @@ __all__ = ["save_model", "load_model", "export_file", "save_frame",
 
 _MAGIC = b"H2OTPU1\n"
 
-# scheme -> (reader: path->bytes, writer: path,bytes->None); the local
-# backend is the only built-in (PersistManager analog)
+# scheme -> (reader: path->bytes, writer: path,bytes->None) — the
+# PersistManager registry (water/persist/PersistManager [U3]). Built-ins:
+# bare paths (local FS), mem:// (in-process object store — the DKV-style
+# scratch space), http(s):// (read-only remote fetch, the analog of the
+# reference's PersistHTTP importFiles path). S3/GCS/HDFS register here
+# the same way when their client libraries are present.
 PERSIST_SCHEMES: dict[str, tuple[Callable, Callable]] = {}
+
+_MEM_STORE: dict[str, bytes] = {}
+
+
+def _mem_read(path: str) -> bytes:
+    if path not in _MEM_STORE:
+        raise FileNotFoundError(path)
+    return _MEM_STORE[path]
+
+
+def _mem_write(path: str, data: bytes) -> None:
+    _MEM_STORE[path] = data
+
+
+def _http_read(path: str) -> bytes:
+    import urllib.request
+
+    with urllib.request.urlopen(path, timeout=60) as r:  # noqa: S310
+        return r.read()
+
+
+def _http_write(path: str, data: bytes) -> None:
+    raise ValueError("http(s):// is a read-only persist backend")
+
+
+PERSIST_SCHEMES["mem"] = (_mem_read, _mem_write)
+PERSIST_SCHEMES["http"] = (_http_read, _http_write)
+PERSIST_SCHEMES["https"] = (_http_read, _http_write)
 
 
 def _write_bytes(path: str, data: bytes) -> None:

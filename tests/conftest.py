@@ -10,8 +10,21 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"  # env ships JAX_PLATFORMS=axon (TPU)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+    # Root cause of round-1's roaming full-suite SIGABRT: XLA:CPU's
+    # collective rendezvous TERMINATES the process ("Termination timeout
+    # for ... exceeded. Exiting to ensure a consistent program state")
+    # when the 8 shard threads of a psum don't all arrive in time — on a
+    # 1-core box under load, thread starvation trips it nondeterministically
+    # ~2h of cumulative scheduling into a run. Raise the deadline far past
+    # any real scheduling delay; a true deadlock still fails via the
+    # suite-level timeout instead of a silent abort.
+    flags = (flags +
+             " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+             " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+             " --xla_cpu_collective_timeout_seconds=7200").strip()
+os.environ["XLA_FLAGS"] = flags
 
 # sitecustomize may import jax at interpreter start (latching
 # jax_platforms=axon from the env); backends are still uninitialized at
@@ -21,6 +34,24 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches():
+    """Free compiled executables after every test module.
+
+    Root cause of round-1's roaming full-suite SIGABRT: each train()
+    call jit-compiles fresh executables whose memory mappings are never
+    released (~600-1500 maps/test), and the process walks into the
+    kernel's vm.max_map_count (65530) around test ~120 — mmap then
+    fails inside eager dispatch and XLA aborts without a message.
+    Clearing per module caps the accumulation at single-module scale.
+    """
+    yield
+    import gc
+
+    jax.clear_caches()
+    gc.collect()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,56 @@
+"""Worker process for the 2-process DCN test (run by test_distributed.py).
+
+Forms a 2-process JAX distributed cluster over localhost (the DCN path
+of SURVEY.md §5.8 — the operator-injected H2O_TPU_* contract), builds a
+GLOBAL 8-device mesh (2 hosts x 4 local CPU devices), and runs one
+MRTask doall whose psum crosses the process boundary.
+"""
+
+import os
+import re
+import sys
+
+
+def main() -> None:
+    port, pid = sys.argv[1], int(sys.argv[2])
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from h2o_kubernetes_tpu.runtime import (initialize_distributed,
+                                            make_mesh, set_global_mesh)
+    from h2o_kubernetes_tpu.runtime.mrtask import doall
+
+    initialize_distributed(coordinator=f"localhost:{port}",
+                           num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()      # global view
+    assert len(jax.local_devices()) == 4
+
+    mesh = make_mesh()                                 # 8-way ROWS
+    set_global_mesh(mesh)
+    n = 64
+    data = np.arange(n, dtype=np.float32)
+    sharding = NamedSharding(mesh, P("rows"))
+    arr = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: data[idx])
+
+    res = doall(lambda x: {"s": jnp.sum(x), "mx": jnp.max(x)},
+                arr, reduce={"s": "sum", "mx": "max"}, mesh=mesh)
+    s, mx = float(res["s"]), float(res["mx"])
+    assert s == float(data.sum()), (s, data.sum())
+    assert mx == float(n - 1), mx
+    print(f"DCN_OK pid={pid} sum={s}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

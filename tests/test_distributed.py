@@ -1,0 +1,47 @@
+"""Multi-host (DCN) proof: 2 real processes, one global mesh, one psum.
+
+The reference scales across hosts with one JVM per pod gossiping over
+TCP (SURVEY.md §2d multi-host row, §5.8); the TPU-native equivalent is
+`jax.distributed.initialize` + collectives that ride DCN. This test is
+the localhost-scale version of that claim — the same trick the
+reference's own multi-JVM localhost tests use (§4b): no mocks, a real
+2-process cluster.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "dcn_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_psum():  # bounded by communicate(timeout=)
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(_WORKER)) + \
+        os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(port), str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"DCN workers hung; partial output: {outs}")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out}"
+        assert "DCN_OK" in out, f"worker {i} output:\n{out}"

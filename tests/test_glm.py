@@ -159,11 +159,11 @@ def test_glm_cols_axis_mesh_parity(mesh8):
 
     rng = np.random.default_rng(21)
     n = 512
-    x = rng.normal(size=(n, 5)).astype(np.float32)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
     cat = np.array(["a", "b", "c"])[rng.integers(0, 3, size=n)]
     logit = x[:, 0] - 0.5 * x[:, 1] + (cat == "b") * 0.8
     fr = Frame.from_arrays({
-        **{f"x{i}": x[:, i] for i in range(5)},
+        **{f"x{i}": x[:, i] for i in range(6)},
         "c": cat,
         "y": np.where(logit + rng.normal(scale=0.3, size=n) > 0,
                       "yes", "no")})
@@ -176,3 +176,169 @@ def test_glm_cols_axis_mesh_parity(mesh8):
                                rtol=2e-4, atol=2e-5)
     # odd expanded-feature count exercises the padding path on 4x2
     assert m1.dinfo.n_expanded % 2 == 1
+
+
+# -- round-2 family/solver breadth (VERDICT #8) ------------------------------
+
+def test_glm_gamma_log_link_matches_sklearn(mesh8):
+    rng = np.random.default_rng(5)
+    n = 4000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    mu = np.exp(0.6 * x1 - 0.4 * x2 + 1.0)
+    y = rng.gamma(shape=4.0, scale=mu / 4.0)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y})
+    m = GLM(family="gamma", link="log", lambda_=0.0).train(
+        y="y", training_frame=fr)
+    from sklearn.linear_model import GammaRegressor
+
+    sk = GammaRegressor(alpha=0.0, tol=1e-8, max_iter=1000).fit(
+        np.stack([x1, x2], 1), y)
+    coef = m.coef()
+    np.testing.assert_allclose(coef["x1"], sk.coef_[0], rtol=2e-2)
+    np.testing.assert_allclose(coef["x2"], sk.coef_[1], rtol=2e-2)
+    np.testing.assert_allclose(coef["Intercept"], sk.intercept_, rtol=2e-2)
+    assert m.null_deviance > m.residual_deviance
+
+
+def test_glm_gamma_inverse_link_default(mesh8):
+    rng = np.random.default_rng(6)
+    n = 3000
+    x1 = rng.uniform(0.5, 1.5, size=n)
+    mu = 1.0 / (0.8 * x1 + 1.2)
+    y = rng.gamma(shape=5.0, scale=mu / 5.0)
+    fr = Frame.from_arrays({"x1": x1, "y": y})
+    m = GLM(family="gamma", lambda_=0.0, standardize=False).train(
+        y="y", training_frame=fr)
+    coef = m.coef()   # default link is inverse (reference default)
+    np.testing.assert_allclose(coef["x1"], 0.8, rtol=0.15)
+    np.testing.assert_allclose(coef["Intercept"], 1.2, rtol=0.15)
+
+
+def test_glm_tweedie_matches_sklearn(mesh8):
+    rng = np.random.default_rng(7)
+    n = 5000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    mu = np.exp(0.5 * x1 + 0.25 * x2)
+    # compound poisson-gamma sample (exact zeros + positive mass)
+    npois = rng.poisson(mu)
+    y = np.array([rng.gamma(sh, 1.0) if sh > 0 else 0.0 for sh in npois])
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y})
+    m = GLM(family="tweedie", tweedie_variance_power=1.5,
+            lambda_=0.0).train(y="y", training_frame=fr)
+    from sklearn.linear_model import TweedieRegressor
+
+    sk = TweedieRegressor(power=1.5, alpha=0.0, link="log", tol=1e-8,
+                          max_iter=2000).fit(np.stack([x1, x2], 1), y)
+    coef = m.coef()
+    np.testing.assert_allclose(coef["x1"], sk.coef_[0], rtol=5e-2)
+    np.testing.assert_allclose(coef["x2"], sk.coef_[1], rtol=5e-2)
+
+
+def test_glm_negativebinomial(mesh8):
+    rng = np.random.default_rng(8)
+    n = 5000
+    x1 = rng.normal(size=n)
+    mu = np.exp(0.7 * x1 + 0.5)
+    theta = 0.5   # var = mu + theta*mu^2
+    y = rng.negative_binomial(1.0 / theta, 1.0 / (1.0 + theta * mu))
+    fr = Frame.from_arrays({"x1": x1, "y": y.astype(np.float64)})
+    m = GLM(family="negativebinomial", theta=0.5, lambda_=0.0).train(
+        y="y", training_frame=fr)
+    coef = m.coef()
+    np.testing.assert_allclose(coef["x1"], 0.7, rtol=0.1)
+    np.testing.assert_allclose(coef["Intercept"], 0.5, atol=0.1)
+    assert m.null_deviance > m.residual_deviance
+
+
+def test_glm_multinomial_matches_sklearn(mesh8):
+    rng = np.random.default_rng(9)
+    n = 6000
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    logits = np.stack([0.0 * x1, 1.2 * x1 - 0.4 * x2,
+                       -0.8 * x1 + 0.9 * x2], axis=1)
+    pr = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    yk = np.array([rng.choice(3, p=p) for p in pr])
+    fr = Frame.from_arrays({"x1": x1, "x2": x2,
+                            "y": np.array(["a", "b", "c"])[yk]})
+    m = GLM(family="multinomial", lambda_=0.0, max_iterations=200).train(
+        y="y", training_frame=fr)
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.metrics import accuracy_score
+
+    sk = LogisticRegression(C=np.inf, tol=1e-8, max_iter=2000).fit(
+        np.stack([x1, x2], 1), yk)
+    pred = m.predict(fr)
+    acc = float(np.mean(pred["predict"].to_numpy() == yk))
+    sk_acc = accuracy_score(yk, sk.predict(np.stack([x1, x2], 1)))
+    assert acc > sk_acc - 0.01
+    # softmax coefs are identified up to a per-feature shift: compare
+    # class contrasts (b - a), which are shift-invariant
+    coef = m.coef()
+    contrast = coef["b"]["x1"] - coef["a"]["x1"]
+    sk_contrast = sk.coef_[1][0] - sk.coef_[0][0]
+    np.testing.assert_allclose(contrast, sk_contrast, rtol=5e-2)
+
+
+def test_glm_coordinate_descent_matches_cholesky(mesh8):
+    fr, x1, x2, g, y = _gaussian_data()
+    m_cd = GLM(solver="COORDINATE_DESCENT", lambda_=0.0,
+               max_iterations=100).train(y="y", training_frame=fr)
+    m_ch = GLM(solver="IRLSM", lambda_=0.0).train(y="y", training_frame=fr)
+    c1, c2 = m_cd.coef(), m_ch.coef()
+    for k in c1:
+        np.testing.assert_allclose(c1[k], c2[k], rtol=1e-3, atol=1e-4)
+
+
+def test_glm_coordinate_descent_lasso_sparsity(mesh8):
+    rng = np.random.default_rng(11)
+    n = 2000
+    X = rng.normal(size=(n, 6))
+    y = 3.0 * X[:, 0] + rng.normal(scale=0.1, size=n)  # only x0 matters
+    fr = Frame.from_arrays({f"x{i}": X[:, i] for i in range(6)} | {"y": y})
+    m = GLM(solver="COORDINATE_DESCENT", alpha=1.0, lambda_=0.1).train(
+        y="y", training_frame=fr)
+    coef = m.coef_norm()
+    zeros = sum(1 for k, v in coef.items()
+                if k not in ("x0", "Intercept") and abs(v) < 1e-6)
+    assert zeros >= 4          # noise coefs hard-zeroed by the L1 path
+    assert abs(coef["x0"]) > 1.0
+
+
+def test_glm_p_values_ols_oracle(mesh8):
+    rng = np.random.default_rng(12)
+    n = 500
+    x1, x2 = rng.normal(size=n), rng.normal(size=n)
+    y = 1.5 * x1 + 0.0 * x2 + 2.0 + rng.normal(scale=1.0, size=n)
+    fr = Frame.from_arrays({"x1": x1, "x2": x2, "y": y})
+    m = GLM(family="gaussian", lambda_=0.0, compute_p_values=True).train(
+        y="y", training_frame=fr)
+    # closed-form OLS standard errors as the oracle
+    X = np.stack([x1, x2, np.ones(n)], axis=1)
+    b = np.linalg.lstsq(X, y, rcond=None)[0]
+    resid = y - X @ b
+    s2 = resid @ resid / (n - 3)
+    se = np.sqrt(np.diag(np.linalg.inv(X.T @ X)) * s2)
+    got = m.std_errs()
+    np.testing.assert_allclose(got["x1"], se[0], rtol=2e-2)
+    np.testing.assert_allclose(got["x2"], se[1], rtol=2e-2)
+    np.testing.assert_allclose(got["Intercept"], se[2], rtol=2e-2)
+    assert m.pvalues()["x1"] < 1e-6       # real effect
+    assert m.pvalues()["x2"] > 0.05       # null effect
+    assert m.zvalues()["x1"] > 10
+
+
+def test_glm_p_values_requires_irlsm_lambda0(mesh8):
+    fr, *_ = _gaussian_data(n=200)
+    with pytest.raises(ValueError):
+        GLM(compute_p_values=True, lambda_=0.5).train(
+            y="y", training_frame=fr)
+    with pytest.raises(ValueError):
+        GLM(compute_p_values=True, solver="L_BFGS").train(
+            y="y", training_frame=fr)
+
+
+def test_glm_gamma_rejects_nonpositive_response(mesh8):
+    fr = Frame.from_arrays({"x": np.arange(10.0),
+                            "y": np.arange(10.0) - 5.0})
+    with pytest.raises(ValueError):
+        GLM(family="gamma").train(y="y", training_frame=fr)

@@ -1,0 +1,176 @@
+"""Frame munging: filters, arithmetic, group_by, merge, sort.
+
+Reference behaviors: h2o-py Frame operators and the Rapids ASTs they
+compile to (water/rapids/ast/prims/mungers+operators+math [U3]) —
+boolean row slices, elementwise Vec algebra, AstGroup aggregates,
+AstMerge inner/left joins. Pandas is the numerical oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+
+
+@pytest.fixture
+def fr(mesh8):
+    rng = np.random.default_rng(7)
+    n = 101
+    return Frame.from_arrays({
+        "g": np.array(["a", "b", "c"])[rng.integers(0, 3, n)],
+        "x": rng.normal(size=n).astype(np.float32),
+        "y": rng.normal(size=n).astype(np.float32),
+    }), n
+
+
+def test_filter_and_arithmetic(fr):
+    fr, n = fr
+    x = fr["x"].to_numpy()
+    sub = fr[fr["x"] > 0]
+    assert sub.nrows == int((x > 0).sum())
+    assert np.all(sub["x"].to_numpy() > 0)
+
+    z = fr["x"] * 2.0 + fr["y"]
+    np.testing.assert_allclose(
+        z.to_numpy(), 2 * x + fr["y"].to_numpy(), rtol=1e-6)
+    r = (1.0 - fr["x"]) / 2.0
+    np.testing.assert_allclose(r.to_numpy(), (1 - x) / 2, rtol=1e-6)
+    np.testing.assert_allclose(fr["x"].abs().to_numpy(), np.abs(x),
+                               rtol=1e-6)
+
+
+def test_filter_na_rows_drop(mesh8):
+    fr = Frame.from_arrays({"x": np.array([1.0, np.nan, -1.0, 2.0])})
+    out = fr[fr["x"] > 0]
+    np.testing.assert_array_equal(out["x"].to_numpy(), [1.0, 2.0])
+    out2 = fr[fr["x"].isna()]
+    assert out2.nrows == 1
+
+
+def test_enum_equality_filter(fr):
+    fr, n = fr
+    sub = fr[fr["g"] == "b"]
+    codes = fr["g"].to_numpy()
+    b = fr["g"].domain.index("b")
+    assert sub.nrows == int((codes == b).sum())
+    assert all(sub["g"].to_numpy() == sub["g"].domain.index("b"))
+
+    both = fr[(fr["g"] == "a") | (fr["g"] == "c")]
+    assert both.nrows == n - fr[fr["g"] == "b"].nrows
+
+
+def test_compound_filter(fr):
+    fr, n = fr
+    x, y = fr["x"].to_numpy(), fr["y"].to_numpy()
+    sub = fr[(fr["x"] > 0) & (fr["y"] < 0.5)]
+    assert sub.nrows == int(((x > 0) & (y < 0.5)).sum())
+
+
+def test_group_by_against_pandas(fr):
+    fr, n = fr
+    out = fr.group_by("g").sum("x").mean("y").count().get_frame()
+    pdf = fr.to_pandas()
+    exp = pdf.groupby("g").agg(sum_x=("x", "sum"), mean_y=("y", "mean"),
+                               nrow=("x", "size")).reset_index()
+    got = out.to_pandas().sort_values("g").reset_index(drop=True)
+    exp = exp.sort_values("g").reset_index(drop=True)
+    np.testing.assert_array_equal(got["g"], exp["g"])
+    np.testing.assert_allclose(got["sum_x"], exp["sum_x"], rtol=1e-4)
+    np.testing.assert_allclose(got["mean_y"], exp["mean_y"], rtol=1e-4)
+    np.testing.assert_array_equal(got["nrow"], exp["nrow"])
+
+
+def test_group_by_min_max_sd(fr):
+    fr, n = fr
+    out = fr.group_by(["g"]).min("x").max("x").sd("x").get_frame()
+    pdf = fr.to_pandas()
+    exp = pdf.groupby("g")["x"].agg(["min", "max", "std"]).reset_index()
+    got = out.to_pandas().sort_values("g").reset_index(drop=True)
+    np.testing.assert_allclose(got["min_x"], exp["min"], rtol=1e-5)
+    np.testing.assert_allclose(got["max_x"], exp["max"], rtol=1e-5)
+    np.testing.assert_allclose(got["sd_x"], exp["std"], rtol=1e-4)
+
+
+def test_group_by_na_group(mesh8):
+    fr = Frame.from_arrays({
+        "g": np.array(["a", None, "a", None], dtype=object),
+        "x": np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)})
+    out = fr.group_by("g").sum("x").get_frame()
+    assert out.nrows == 2                 # "a" and the NA group
+    pdf = out.to_pandas()
+    a_sum = float(pdf.loc[pdf["g"] == "a", "sum_x"].iloc[0])
+    na_sum = float(pdf.loc[pdf["g"].isna(), "sum_x"].iloc[0])
+    assert a_sum == 4.0 and na_sum == 6.0
+
+
+def test_merge_inner(mesh8):
+    left = Frame.from_arrays({
+        "k": np.array(["a", "b", "c", "b"]),
+        "x": np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)})
+    right = Frame.from_arrays({
+        "k": np.array(["b", "d", "b"]),
+        "y": np.array([10.0, 20.0, 30.0], dtype=np.float32)})
+    out = left.merge(right)
+    # rows with k=="b" match twice each -> 2*2 rows
+    assert out.nrows == 4
+    pdf = out.to_pandas()
+    assert set(pdf["k"]) == {"b"}
+    assert sorted(pdf["y"]) == [10.0, 10.0, 30.0, 30.0]
+
+
+def test_merge_left(mesh8):
+    left = Frame.from_arrays({
+        "k": np.array([1, 2, 3], dtype=np.float32),
+        "x": np.array([1.0, 2.0, 3.0], dtype=np.float32)})
+    right = Frame.from_arrays({
+        "k": np.array([2], dtype=np.float32),
+        "y": np.array([9.0], dtype=np.float32)})
+    out = left.merge(right, all_x=True)
+    assert out.nrows == 3
+    pdf = out.to_pandas().sort_values("k")
+    np.testing.assert_array_equal(np.isnan(pdf["y"]), [True, False, True])
+
+
+def test_sort(fr):
+    fr, n = fr
+    out = fr.sort("x")
+    assert np.all(np.diff(out["x"].to_numpy()) >= 0)
+    out2 = fr.sort("x", ascending=False)
+    assert np.all(np.diff(out2["x"].to_numpy()) <= 0)
+
+
+def test_derived_column_assignment(fr):
+    fr, n = fr
+    fr["z"] = fr["x"] * fr["x"]
+    assert "z" in fr.names
+    np.testing.assert_allclose(fr["z"].to_numpy(),
+                               fr["x"].to_numpy() ** 2, rtol=1e-6)
+
+
+def test_enum_arithmetic_rejected(fr):
+    fr, n = fr
+    with pytest.raises(TypeError):
+        fr["g"] * 2
+    with pytest.raises(TypeError):
+        fr["g"] > 1
+    with pytest.raises(TypeError):
+        fr["x"] + fr["g"]
+    with pytest.raises(TypeError):
+        fr["g"].log()
+
+
+def test_sort_descending_stable_na_last(mesh8):
+    fr = Frame.from_arrays({"x": np.array([1.0, np.nan, 3.0, 2.0])})
+    out = fr.sort("x", ascending=False)["x"].to_numpy()
+    np.testing.assert_array_equal(out[:3], [3.0, 2.0, 1.0])
+    assert np.isnan(out[3])
+
+
+def test_group_by_numeric_key_stays_numeric(mesh8):
+    fr = Frame.from_arrays({"k": np.array([2.0, 10.0, 2.0]),
+                            "x": np.array([1.0, 2.0, 3.0])})
+    out = fr.group_by("k").sum("x").get_frame()
+    assert not out["k"].is_enum()
+    got = dict(zip(out["k"].to_numpy(), out["sum_x"].to_numpy()))
+    assert got[2.0] == 4.0 and got[10.0] == 2.0

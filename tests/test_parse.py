@@ -193,3 +193,87 @@ def test_multifile_duplicate_repeated_headers_dropped(tmp_path, mesh8):
     assert fr.names == ["a", "a2", "b"]
     assert sorted(fr["a"].to_numpy().tolist()) == [1.0, 3.0]
     assert sorted(fr["b"].domain) == ["x", "y"]
+
+
+# -- parquet / ORC ingest (VERDICT #9, reference h2o-parsers [U3]) -----------
+
+def test_parquet_roundtrip(tmp_path, mesh8):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = 200
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=n).astype(np.float32)
+    cats = np.array(["lo", "hi", "mid"])[rng.integers(0, 3, n)]
+    ints = rng.integers(0, 100, n)
+    table = pa.table({
+        "x": pa.array(xs, type=pa.float32()),
+        "g": pa.array(cats.tolist()),
+        "k": pa.array(ints, type=pa.int64()),
+        "d": pa.array(cats.tolist()).dictionary_encode(),
+        "ts": pa.array(
+            np.arange(n) * 86_400_000 + 1_600_000_000_000,
+            type=pa.timestamp("ms")),
+    })
+    path = tmp_path / "t.parquet"
+    pq.write_table(table, path)
+    fr = import_file(str(path))
+    assert fr.shape == (n, 5)
+    np.testing.assert_allclose(fr["x"].to_numpy(), xs, rtol=1e-6)
+    np.testing.assert_array_equal(fr["k"].to_numpy(), ints)
+    assert fr["g"].is_enum() and sorted(fr["g"].domain) == ["hi", "lo", "mid"]
+    assert fr["d"].is_enum()
+    got_g = [fr["g"].domain[c] for c in fr["g"].to_numpy()]
+    got_d = [fr["d"].domain[c] for c in fr["d"].to_numpy()]
+    assert got_g == cats.tolist() == got_d
+    assert fr["ts"].kind == "time"
+    np.testing.assert_allclose(
+        fr["ts"].to_numpy(),
+        np.arange(n) * 86_400_000 + 1_600_000_000_000)
+
+
+def test_parquet_nulls_and_multifile(tmp_path, mesh8):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    t1 = pa.table({"x": pa.array([1.0, None, 3.0]),
+                   "s": pa.array(["a", None, "b"])})
+    t2 = pa.table({"x": pa.array([4.0]), "s": pa.array(["a"])})
+    pq.write_table(t1, tmp_path / "p1.parquet")
+    pq.write_table(t2, tmp_path / "p2.parquet")
+    fr = import_file(str(tmp_path / "p*.parquet"))
+    assert fr.nrows == 4
+    x = fr["x"].to_numpy()
+    assert np.isnan(x[1]) and x[3] == 4.0
+    s = fr["s"].to_numpy()
+    assert s[1] == -1                        # NA enum code
+    assert fr["s"].domain == ["a", "b"]
+
+
+def test_parquet_col_type_override(tmp_path, mesh8):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    pq.write_table(pa.table({"k": pa.array([1, 2, 1, 2])}),
+                   tmp_path / "o.parquet")
+    fr = import_file(str(tmp_path / "o.parquet"),
+                     col_types={"k": "enum"})
+    assert fr["k"].is_enum()
+    assert fr["k"].domain == ["1", "2"]
+
+
+def test_orc_ingest(tmp_path, mesh8):
+    import pyarrow as pa
+
+    try:
+        from pyarrow import orc
+    except ImportError:
+        import pytest
+        pytest.skip("pyarrow.orc unavailable")
+    table = pa.table({"a": pa.array([1.5, 2.5, 3.5]),
+                      "b": pa.array(["x", "y", "x"])})
+    path = tmp_path / "t.orc"
+    orc.write_table(table, str(path))
+    fr = import_file(str(path))
+    np.testing.assert_allclose(fr["a"].to_numpy(), [1.5, 2.5, 3.5])
+    assert fr["b"].is_enum()

@@ -160,3 +160,109 @@ def test_export_quotes_roundtrip(tmp_path, mesh8):
     fr2 = h2o.import_file(p)
     assert sorted(fr2["s"].domain) == sorted(set(vals.astype(str)))
     assert fr2.nrows == 3
+
+
+# -- remote persist schemes (VERDICT #9, water/persist registry [U3]) --------
+
+class TestPersistSchemes:
+    def test_mem_scheme_roundtrip(self, mesh8):
+        fr = h2o.Frame.from_arrays({"x": np.arange(8.0),
+                                "g": np.array(list("aabbccdd"))})
+        h2o.save_frame(fr, "mem://bucket/f1")
+        back = h2o.load_frame("mem://bucket/f1")
+        np.testing.assert_array_equal(back["x"].to_numpy(),
+                                      fr["x"].to_numpy())
+        assert back["g"].domain == fr["g"].domain
+
+    def test_mem_scheme_model(self, mesh8):
+        fr = _frame()
+        m = GBM(ntrees=3, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        h2o.save_model(m, "mem://models/gbm1.model")
+        back = h2o.load_model("mem://models/gbm1.model")
+        np.testing.assert_allclose(back.predict_raw(fr),
+                                   m.predict_raw(fr), rtol=1e-6)
+
+    def test_http_scheme_read(self, tmp_path, mesh8):
+        import functools
+        import http.server
+        import threading
+
+        fr = h2o.Frame.from_arrays({"x": np.arange(5.0)})
+        h2o.save_frame(fr, str(tmp_path / "fr.bin"))
+        handler = functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=str(tmp_path))
+        srv = http.server.ThreadingHTTPServer(("localhost", 0), handler)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = srv.server_address[1]
+            back = h2o.load_frame(f"http://localhost:{port}/fr.bin")
+            np.testing.assert_array_equal(back["x"].to_numpy(),
+                                          np.arange(5.0))
+        finally:
+            srv.shutdown()
+
+    def test_http_scheme_write_rejected(self, mesh8):
+        fr = h2o.Frame.from_arrays({"x": np.arange(3.0)})
+        with pytest.raises(ValueError):
+            h2o.save_frame(fr, "http://example.invalid/f")
+
+    def test_unknown_scheme_rejected(self, mesh8):
+        fr = h2o.Frame.from_arrays({"x": np.arange(3.0)})
+        with pytest.raises(ValueError):
+            h2o.save_frame(fr, "s3q://nope/f")
+
+
+# -- round-2 MOJO exports: DL / NB / PCA (VERDICT #10) -----------------------
+
+class TestMojoRound2:
+    def test_deeplearning_mojo_matches(self, tmp_path, mesh8):
+        from h2o_kubernetes_tpu.models import DeepLearning
+
+        fr = _frame()
+        m = DeepLearning(hidden=[8, 8], epochs=3, seed=2).train(
+            y="y", training_frame=fr)
+        p = str(tmp_path / "dl.zip")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        data = {n: fr[n].to_numpy() if not fr[n].is_enum() else
+                np.array([fr[n].domain[c] if c >= 0 else None
+                          for c in fr[n].to_numpy()], dtype=object)
+                for n in m.feature_names}
+        got = mj.predict(data)
+        want = m.predict_raw(fr)
+        np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_naivebayes_mojo_matches(self, tmp_path, mesh8):
+        from h2o_kubernetes_tpu.models import NaiveBayes
+
+        fr = _frame()
+        m = NaiveBayes().train(y="y", training_frame=fr)
+        p = str(tmp_path / "nb.zip")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        data = {n: fr[n].to_numpy() if not fr[n].is_enum() else
+                np.array([fr[n].domain[c] if c >= 0 else None
+                          for c in fr[n].to_numpy()], dtype=object)
+                for n in m.feature_names}
+        np.testing.assert_allclose(mj.predict(data),
+                                   np.asarray(m.predict_raw(fr)),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_pca_mojo_matches(self, tmp_path, mesh8):
+        from h2o_kubernetes_tpu.models import PCA
+
+        fr = _frame()
+        m = PCA(k=2).train(training_frame=fr.drop("y"))
+        p = str(tmp_path / "pca.zip")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        data = {n: fr[n].to_numpy() if not fr[n].is_enum() else
+                np.array([fr[n].domain[c] if c >= 0 else None
+                          for c in fr[n].to_numpy()], dtype=object)
+                for n in m.feature_names}
+        np.testing.assert_allclose(mj.predict(data),
+                                   np.asarray(m.predict_raw(fr.drop("y"))),
+                                   rtol=2e-4, atol=2e-4)
