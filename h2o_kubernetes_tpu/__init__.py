@@ -14,10 +14,12 @@ from .frame import Frame, Vec, import_file, parse_setup
 from .mojo import MojoModel, export_mojo, import_mojo
 from .persist import (export_file, load_frame, load_model, save_frame,
                       save_model)
-from .runtime import (global_mesh, initialize_distributed, make_mesh,
-                      set_global_mesh, use_mesh)
+from .runtime import (ClusterHealthError, global_mesh, health_status,
+                      heartbeat, initialize_distributed, make_mesh,
+                      set_global_mesh, start_heartbeat, stop_heartbeat,
+                      use_mesh)
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def init(coordinator: str | None = None, **kw) -> None:
@@ -36,8 +38,11 @@ def cluster_status() -> dict:
     import jax
 
     mesh = global_mesh()
+    from .runtime.health import health_status as _hs
+
     return {
         "version": __version__,
+        "cloud_healthy": bool(_hs()["healthy"]),
         "cloud_size": len(mesh.devices.flat),
         "mesh_shape": dict(mesh.shape),
         "process_count": jax.process_count(),

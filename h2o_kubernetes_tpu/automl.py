@@ -204,10 +204,18 @@ class AutoML:
                  exclude_algos: Sequence[str] | None = None,
                  sort_metric: str = "auto",
                  project_name: str = "automl",
+                 checkpoint_dir: str | None = None,
                  verbosity: str | None = "info"):
+        """checkpoint_dir: mid-run resume manifest (a SUPERSET of the
+        reference — H2O AutoML has none, SURVEY.md §5.4): each finished
+        base model is saved there with its metrics; a rerun with the
+        same dir skips completed steps and reloads their models, so a
+        killed run (preempted slice, failed heartbeat) continues where
+        it stopped instead of starting over."""
         if include_algos and exclude_algos:
             raise ValueError("include_algos and exclude_algos are "
                              "mutually exclusive")
+        self.checkpoint_dir = checkpoint_dir
         self.max_models = max_models
         self.max_runtime_secs = max_runtime_secs
         self.nfolds = nfolds
@@ -269,21 +277,28 @@ class AutoML:
                 return True
             return deadline is not None and time.monotonic() > deadline
 
+        completed = self._load_manifest()
+
         def run_one(fam: str, name: str, params: dict) -> bool:
             """Train one model; returns False when the step is skipped."""
             if fam == "glm":
-                if nclasses > 2:      # GLM has no multinomial family yet
-                    self._log(f"{name} skipped: GLM has no multinomial "
-                              "family")
-                    return False
                 params = {**params,
                           "family": "binomial" if nclasses == 2
+                          else "multinomial" if nclasses > 2
                           else "gaussian"}
+            model_id = f"{name}_AutoML_{self.project_name}"
+            if model_id in completed:       # resume: step already done
+                model, metrics = self._load_step(model_id,
+                                                 completed[model_id])
+                self.leaderboard.add(model_id, model, metrics)
+                self._models_by_family.setdefault(fam, []).append(
+                    (model_id, model))
+                self._log(f"{model_id}: resumed from checkpoint")
+                return True
             est = _EST[fam](
                 **params, seed=self.seed,
                 nfolds=self.nfolds, fold_assignment="modulo",
                 keep_cross_validation_predictions=True)
-            model_id = f"{name}_AutoML_{self.project_name}"
             t = time.monotonic()
             model = est.train(y=y, training_frame=training_frame, x=x)
             if leaderboard_frame is not None:
@@ -297,9 +312,12 @@ class AutoML:
             self.leaderboard.add(model_id, model, metrics)
             self._models_by_family.setdefault(fam, []).append(
                 (model_id, model))
+            self._save_step(model_id, fam, model, metrics)
             self._log(f"{model_id}: {metric}="
                       f"{metrics.get(metric, float('nan')):.5f}")
             return True
+
+        from .runtime.health import ClusterHealthError
 
         for fam, name, params in plan:
             if out_of_budget():
@@ -309,6 +327,12 @@ class AutoML:
                 # does (so persistent failures can't loop forever)
                 if not run_one(fam, name, params):
                     continue
+            except ClusterHealthError as e:
+                # dead cloud: every later step would fail too — fail the
+                # job cleanly instead of grinding through the plan
+                # (reference fail-fast semantics, SURVEY.md §5.3)
+                self.job.failed(repr(e))
+                raise
             except Exception as e:       # a failed step never kills the run
                 self._log(f"{name} failed: {e}")
             n_done += 1
@@ -326,21 +350,72 @@ class AutoML:
             grid_idx += 1
             try:
                 run_one(fam, f"{fam.upper()}_grid_{grid_idx}", params)
+            except ClusterHealthError as e:
+                self.job.failed(repr(e))
+                raise
             except Exception as e:
                 self._log(f"grid {fam} failed: {e}")
             n_done += 1
             self.job.update(min(0.9, n_done / max(budget or 20, 1)))
 
-        if "stackedensemble" in self.algos and \
-                leaderboard_frame is None and \
-                len(self.leaderboard.models) >= 2 and self.nfolds >= 2:
-            self._build_ensembles(y, training_frame, metric, asc)
+        try:
+            if "stackedensemble" in self.algos and \
+                    leaderboard_frame is None and \
+                    len(self.leaderboard.models) >= 2 and self.nfolds >= 2:
+                self._build_ensembles(y, training_frame, metric, asc)
+        except Exception as e:           # surface fatal errors on the Job
+            self.job.failed(repr(e))
+            raise
 
         self.job.done()
         self._log(f"done in {time.monotonic() - t0:.1f}s — leader: "
                   f"{self.leaderboard.rows[0]['model_id']}"
                   if self.leaderboard.rows else "done (no models)")
         return self
+
+    # -- resume manifest (checkpoint_dir) -----------------------------------
+
+    def _manifest_path(self):
+        import os
+
+        return os.path.join(self.checkpoint_dir, "automl_manifest.json")
+
+    def _load_manifest(self) -> dict:
+        """{model_id: {file, fam, metrics}} of completed steps."""
+        if not self.checkpoint_dir:
+            return {}
+        import json
+        import os
+
+        try:
+            with open(self._manifest_path()) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            return {}
+
+    def _save_step(self, model_id, fam, model, metrics) -> None:
+        if not self.checkpoint_dir:
+            return
+        import json
+        import os
+
+        from .persist import save_model
+
+        path = os.path.join(self.checkpoint_dir, f"{model_id}.model")
+        save_model(model, path)
+        manifest = self._load_manifest()
+        manifest[model_id] = {"file": path, "fam": fam,
+                              "metrics": metrics}
+        tmp = self._manifest_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, self._manifest_path())   # crash-atomic
+
+    def _load_step(self, model_id, entry):
+        from .persist import load_model
+
+        return load_model(entry["file"]), entry["metrics"]
 
     def _build_ensembles(self, y, frame, metric, asc):
         """BestOfFamily + AllModels ensembles (reference StackedEnsembleStep).

@@ -10,9 +10,9 @@ names:
 """
 
 from .automl import AutoML as H2OAutoML
-from .models import (DRF, GBM, GLM, PCA, DeepLearning, IsolationForest,
-                     KMeans, NaiveBayes, StackedEnsemble, Word2Vec,
-                     XGBoost)
+from .models import (DRF, GBM, GLM, GLRM, PCA, Aggregator, CoxPH,
+                     DeepLearning, IsolationForest, KMeans, NaiveBayes,
+                     StackedEnsemble, Word2Vec, XGBoost)
 
 H2OGradientBoostingEstimator = GBM
 H2ORandomForestEstimator = DRF
@@ -25,6 +25,9 @@ H2OKMeansEstimator = KMeans
 H2OPrincipalComponentAnalysisEstimator = PCA
 H2ONaiveBayesEstimator = NaiveBayes
 H2OIsolationForestEstimator = IsolationForest
+H2OGeneralizedLowRankEstimator = GLRM
+H2OCoxProportionalHazardsEstimator = CoxPH
+H2OAggregatorEstimator = Aggregator
 
 __all__ = [
     "H2OAutoML", "H2OGradientBoostingEstimator",
@@ -33,4 +36,6 @@ __all__ = [
     "H2OWord2vecEstimator", "H2OStackedEnsembleEstimator",
     "H2OKMeansEstimator", "H2OPrincipalComponentAnalysisEstimator",
     "H2ONaiveBayesEstimator", "H2OIsolationForestEstimator",
+    "H2OGeneralizedLowRankEstimator",
+    "H2OCoxProportionalHazardsEstimator", "H2OAggregatorEstimator",
 ]

@@ -239,12 +239,16 @@ def merge(left: Frame, right: Frame, by=None, all_x: bool = False) -> Frame:
     if all_x:
         cnt = np.maximum(cnt, 1)             # unmatched left rows survive
     li = np.repeat(np.arange(left.nrows), cnt)
-    # right row index per output row; -1 marks an unmatched left join row
-    ri = np.full(int(cnt.sum()), -1, dtype=np.int64)
-    pos = np.cumsum(cnt) - cnt
-    matched = hi > lo
-    for i in np.flatnonzero(matched):
-        ri[pos[i]: pos[i] + (hi[i] - lo[i])] = order[lo[i]: hi[i]]
+    # right row index per output row; -1 marks an unmatched left join
+    # row. Vectorized expansion: out row j of left row i maps to sorted
+    # right position lo[i] + (j - start[i]) — no per-row Python loop
+    total = int(cnt.sum())
+    pos = np.cumsum(cnt) - cnt                    # output start per row
+    offset = np.arange(total) - np.repeat(pos, cnt)
+    src = np.repeat(lo, cnt) + offset
+    matched_row = np.repeat(hi > lo, cnt)
+    ri = np.where(matched_row, order[np.minimum(src, len(order) - 1)
+                                     ] if len(order) else -1, -1)
 
     out = left.select_rows(li)
     for name in right.names:

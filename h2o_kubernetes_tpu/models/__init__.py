@@ -1,7 +1,10 @@
+from .aggregator import Aggregator, AggregatorModel
+from .coxph import CoxPH, CoxPHModel
 from .drf import DRF, DRFModel
 from .gbm import GBM, GBMModel, GBMParams
 from .deeplearning import DeepLearning, DeepLearningModel
 from .glm import GLM, GLMModel, GLMParams
+from .glrm import GLRM, GLRMModel
 from .isolationforest import IsolationForest, IsolationForestModel
 from .kmeans import KMeans, KMeansModel
 from .naivebayes import NaiveBayes, NaiveBayesModel
@@ -10,7 +13,8 @@ from .stackedensemble import StackedEnsemble, StackedEnsembleModel
 from .word2vec import Word2Vec, Word2VecModel
 from .xgboost import XGBoost, XGBoostModel
 
-__all__ = ["DRF", "DRFModel", "DeepLearning", "DeepLearningModel",
+__all__ = ["Aggregator", "AggregatorModel", "CoxPH", "CoxPHModel",
+           "GLRM", "GLRMModel", "DRF", "DRFModel", "DeepLearning", "DeepLearningModel",
            "GBM", "GBMModel", "GBMParams", "GLM", "GLMModel", "GLMParams",
            "IsolationForest", "IsolationForestModel",
            "KMeans", "KMeansModel", "NaiveBayes", "NaiveBayesModel",

@@ -1,0 +1,167 @@
+"""Aggregator — exemplar-based dataset reduction.
+
+Reference: hex/aggregator/Aggregator.java (SURVEY.md §2b C17): reduce a
+frame to ~target_num_exemplars representative rows by single-pass
+radius clustering — each row joins the first exemplar within a radius
+(scaled per dimension) or becomes a new exemplar; exemplars carry
+member counts. The output is the exemplar frame plus a `counts` column.
+
+TPU design: distance evaluation is the hot op and runs on device — the
+candidate batch × exemplar matrix distances are one [b,F]x[F,m] matmul
+(MXU). Exemplar admission is inherently sequential, so the driver loop
+is host-side over batches (like the reference's chunk loop), with the
+radius adapted by bisection to land near the target exemplar count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame import Frame, Vec
+from .base import Model, resolve_x
+from .datainfo import build_datainfo
+
+
+@dataclass
+class AggregatorParams:
+    target_num_exemplars: int = 100
+    rel_tol_num_exemplars: float = 0.5
+    transform: str = "STANDARDIZE"
+    seed: int = 0
+
+
+@jax.jit
+def _dist2(B, E):
+    """Squared distances [b, cap] between batch rows and exemplars."""
+    return ((B * B).sum(1)[:, None] - 2.0 * B @ E.T
+            + (E * E).sum(1)[None, :])
+
+
+def _pad_exemplars(E: np.ndarray, m: int) -> np.ndarray:
+    """Pad the exemplar matrix to a power-of-two capacity so the jitted
+    distance matmul sees a handful of shapes, not one per admission
+    (padding rows sit at +inf → never the nearest exemplar)."""
+    cap = 1
+    while cap < m:
+        cap *= 2
+    if E.shape[0] == cap:
+        return E
+    pad = np.full((cap - E.shape[0], E.shape[1]), np.inf,
+                  dtype=E.dtype)
+    return np.concatenate([E, pad], axis=0)
+
+
+def _aggregate(Xs: np.ndarray, radius2: float,
+               batch: int = 4096) -> tuple[np.ndarray, np.ndarray]:
+    """Single pass: returns (exemplar_row_indices, member_counts)."""
+    n = Xs.shape[0]
+    ex_idx: list[int] = [0]
+    counts: list[int] = [1]
+    E = Xs[0:1]
+    i = 1
+    while i < n:
+        B = Xs[i: i + batch]
+        Ep = _pad_exemplars(E, len(ex_idx))
+        d2 = np.asarray(_dist2(jnp.asarray(B), jnp.asarray(Ep)))
+        d2 = d2[:, : len(ex_idx)]
+        near = d2.min(axis=1) <= radius2
+        assign = d2.argmin(axis=1)
+        # rows inside the radius of an existing exemplar join it; the
+        # FIRST row outside becomes a new exemplar, then the batch is
+        # re-examined against the grown set (sequential admission,
+        # batched distance math)
+        out = np.flatnonzero(~near)
+        upto = out[0] if len(out) else len(B)
+        for j, a in zip(range(upto), assign[:upto]):
+            counts[a] += 1
+        if len(out):
+            new = i + out[0]
+            ex_idx.append(new)
+            counts.append(1)
+            E = np.concatenate([E, Xs[new: new + 1]], axis=0)
+            i = new + 1
+        else:
+            i += len(B)
+    return np.asarray(ex_idx), np.asarray(counts)
+
+
+class AggregatorModel(Model):
+    algo = "aggregator"
+
+    def __init__(self, data, params, frame, ex_idx, counts):
+        super().__init__(data)
+        self.params = params
+        self._frame = frame
+        self._ex_idx = ex_idx
+        self._counts = counts
+        self.nclasses = 1
+
+    @property
+    def aggregated_frame(self) -> Frame:
+        out = self._frame.select_rows(self._ex_idx)
+        out["counts"] = Vec.from_numpy(
+            self._counts.astype(np.float32), "counts")
+        return out
+
+    def num_exemplars(self) -> int:
+        return len(self._ex_idx)
+
+    def _score_matrix(self, X):
+        raise NotImplementedError("Aggregator has no predict; use "
+                                  "aggregated_frame")
+
+
+class Aggregator:
+    """H2OAggregatorEstimator analog."""
+
+    def __init__(self, **kw):
+        from .cv import CVArgs
+
+        CVArgs.pop(kw)
+        self.params = AggregatorParams(**kw)
+
+    def train(self, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              y: str | None = None) -> AggregatorModel:
+        p = self.params
+        if p.target_num_exemplars < 1:
+            raise ValueError("target_num_exemplars must be >= 1")
+        ignored = list(ignored_columns or [])
+        if y is not None:
+            ignored.append(y)
+        data = resolve_x(training_frame, x, ignored)
+        dinfo = build_datainfo(data, training_frame,
+                               standardize=p.transform == "STANDARDIZE",
+                               drop_first=False)
+        Xe = np.asarray(jax.jit(dinfo.expand)(data.X))[
+            : training_frame.nrows, :-1]
+        n, F = Xe.shape
+        target = min(p.target_num_exemplars, n)
+        lo_ok = max(1, int(target * (1 - p.rel_tol_num_exemplars)))
+        hi_ok = int(np.ceil(target * (1 + p.rel_tol_num_exemplars)))
+
+        # bisect the radius until the exemplar count lands in tolerance
+        # (the reference adapts its radius_scale the same way)
+        lo, hi = 0.0, float(4.0 * F)
+        best, best_gap = None, np.inf
+        for _ in range(20):
+            mid = (lo + hi) / 2
+            ex_idx, counts = _aggregate(Xe, mid)
+            m = len(ex_idx)
+            gap = abs(m - target)
+            if gap < best_gap:          # keep the CLOSEST attempt, not
+                best, best_gap = (ex_idx, counts), gap   # the last one
+            if lo_ok <= m <= hi_ok:
+                break
+            if m > hi_ok:      # too many exemplars → widen the radius
+                lo = mid
+            else:
+                hi = mid
+        ex_idx, counts = best
+        return AggregatorModel(data, p, training_frame, ex_idx, counts)

@@ -53,6 +53,9 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
                ignored: Sequence[str] | None = None,
                weights_column: str | None = None,
                distribution: str = "auto") -> TrainData:
+    from ..runtime.health import require_healthy
+
+    require_healthy()   # fail fast before training on a broken cloud
     if y not in frame:
         raise ValueError(f"response column '{y}' not in frame")
     ignored = set(ignored or [])
@@ -100,6 +103,9 @@ def resolve_x(frame: Frame, x: Sequence[str] | None = None,
     Returned TrainData has y=0, nclasses=1 — usable with build_datainfo
     for one-hot expansion/standardization (KMeans/PCA do the same via
     DataInfo in the reference, hex/kmeans & hex/pca)."""
+    from ..runtime.health import require_healthy
+
+    require_healthy()   # same fail-fast gate as the supervised path
     ignored = set(ignored or [])
     names = _feature_names(frame, x, ignored)
     X = frame.to_matrix(names)

@@ -1,0 +1,222 @@
+"""CoxPH — Cox proportional hazards with Efron tie handling.
+
+Reference: hex/coxph/CoxPH.java (SURVEY.md §2b C17): Newton-Raphson on
+the partial log-likelihood, accumulating per-iteration sufficient
+statistics (risk-set sums of w·exp(η), x·w·exp(η), xxᵀ·w·exp(η)) in an
+MRTask over the chunks, Efron or Breslow approximation at tied event
+times.
+
+TPU design: rows are sorted by stop time ONCE on the host (the
+reference keeps a time-ordered index too); the per-iteration risk-set
+sums then become reverse cumulative sums over the time axis — one
+jitted program per Newton step (cumsum + segment reductions on device),
+with the [P,P] Hessian solved on device. The host loop is Newton (few
+iterations), matching the reference's driver."""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..frame import Frame
+from .base import Model, resolve_x
+
+
+@dataclass
+class CoxPHParams:
+    stop_column: str = ""              # event/censoring time
+    event_column: str = ""             # 1 = event, 0 = censored
+    ties: str = "efron"                # efron | breslow
+    max_iterations: int = 20
+    tolerance: float = 1e-8
+    seed: int = 0
+
+
+@functools.partial(jax.jit, static_argnums=(3, 5))
+def _cox_step(X, ev, grp, ngrp, beta, ties: str):
+    """One Newton step's (loglik, gradient, Hessian).
+
+    X: [n, P] time-DESCENDING covariates; ev: [n] event flag;
+    grp: [n] tie-group id in the same order (0 = latest time).
+    Risk set of group g = all rows with group id <= g's position, i.e.
+    a plain prefix sum in the descending ordering.
+    """
+    eta = X @ beta
+    mx = jnp.max(eta)
+    r = jnp.exp(eta - mx)   # stabilized; ratios cancel it, the ll gets
+    #                         the constant added back below
+    # prefix sums over time-descending order = risk-set sums
+    S0 = jnp.cumsum(r)
+    S1 = jnp.cumsum(r[:, None] * X, axis=0)
+    # event-only sums per tie group
+    re = r * ev
+    d_g = jax.ops.segment_sum(ev, grp, ngrp)            # events per group
+    s0e_g = jax.ops.segment_sum(re, grp, ngrp)
+    s1e_g = jax.ops.segment_sum(re[:, None] * X, grp, ngrp)
+    xe_g = jax.ops.segment_sum(ev[:, None] * X, grp, ngrp)
+    eta_e_g = jax.ops.segment_sum(ev * eta, grp, ngrp)
+    # risk-set sums at each group's last row (prefix max index per group)
+    last = jax.ops.segment_max(jnp.arange(X.shape[0]), grp, ngrp)
+    S0_g = S0[last]
+    S1_g = S1[last]
+
+    # Efron's correction loops l = 0..d-1 over tied events; d is data-
+    # dependent, so the scan runs to a static cap (train() validates)
+    L_CAP = 32
+
+    # S2 (the [P,P] risk-set second moment) — [n,P,P] cumsum; CoxPH's P
+    # is small (the reference's use case too), so this stays modest
+    P_ = X.shape[1]
+    S2 = jnp.cumsum(r[:, None, None] * X[:, :, None] * X[:, None, :],
+                    axis=0)
+    S2_g = S2[last]
+    s2e_g = jax.ops.segment_sum(
+        re[:, None, None] * X[:, :, None] * X[:, None, :], grp, ngrp)
+
+    def body2(carry, l_idx):
+        ll_acc, g_acc, h_acc = carry
+        d = d_g
+        is_efron = 1.0 if ties == "efron" else 0.0
+        frac = is_efron * jnp.where(d > 0, l_idx / jnp.maximum(d, 1.0),
+                                    0.0)
+        active = (l_idx < d) if ties == "efron" else \
+            (l_idx < jnp.minimum(d, 1.0))
+        # Breslow: one denominator per group, weighted by d events
+        weight = jnp.where(active, 1.0, 0.0) if ties == "efron" else \
+            jnp.where(active, d, 0.0)
+        # inactive slots can drive phi0 to the clamp floor → inf terms;
+        # weight 0 × inf = NaN, so mask BEFORE weighting
+        phi0 = jnp.maximum(S0_g - frac * s0e_g, 1e-30)
+        phi1 = S1_g - frac[:, None] * s1e_g
+        phi2 = S2_g - frac[:, None, None] * s2e_g
+        ll_acc += jnp.where(active, weight * -jnp.log(phi0), 0.0).sum()
+        mean = jnp.where(active[:, None], phi1 / phi0[:, None], 0.0)
+        g_acc += (weight[:, None] * -mean).sum(axis=0)
+        h_term = jnp.where(active[:, None, None],
+                           phi2 / phi0[:, None, None], 0.0) - \
+            mean[:, :, None] * mean[:, None, :]
+        h_acc += (weight[:, None, None] * h_term).sum(axis=0)
+        return (ll_acc, g_acc, h_acc), None
+
+    init = (jnp.float32(0.0), jnp.zeros(P_), jnp.zeros((P_, P_)))
+    (ll_den, g_den, H), _ = jax.lax.scan(body2, init,
+                                         jnp.arange(L_CAP, dtype=jnp.float32))
+    # each of the Σd denominator terms carries a -mx from the scaling
+    ll = eta_e_g.sum() + ll_den - mx * d_g.sum()
+    grad = xe_g.sum(axis=0) + g_den
+    return ll, grad, H
+
+
+class CoxPHModel(Model):
+    algo = "coxph"
+
+    def __init__(self, data, params, beta, names, loglik, loglik_null,
+                 n_events):
+        super().__init__(data)
+        self.params = params
+        self.beta = beta
+        self._names = names
+        self.loglik = loglik
+        self.loglik_null = loglik_null
+        self.n_events = n_events
+        self.nclasses = 1
+
+    def coef(self) -> dict[str, float]:
+        return dict(zip(self._names, np.asarray(self.beta,
+                                                dtype=np.float64)))
+
+    def hazard_ratios(self) -> dict[str, float]:
+        return {k: float(np.exp(v)) for k, v in self.coef().items()}
+
+    def _score_matrix(self, X):
+        """Linear predictor (log partial hazard), the h2o predict."""
+        return X @ self.beta
+
+    def concordance(self, frame: Frame) -> float:
+        """Harrell's c-index on (stop, event) vs the risk score."""
+        p = self.params
+        risk = np.asarray(self.predict_raw(frame))[: frame.nrows]
+        t = frame.vec(p.stop_column).to_numpy()
+        e = frame.vec(p.event_column).to_numpy()
+        conc = disc = 0
+        ev_idx = np.flatnonzero(e > 0)
+        for i in ev_idx:
+            later = t > t[i]
+            conc += int(np.sum(risk[i] > risk[later]))
+            disc += int(np.sum(risk[i] < risk[later]))
+        return conc / max(conc + disc, 1)
+
+
+class CoxPH:
+    """H2OCoxProportionalHazardsEstimator analog."""
+
+    def __init__(self, **kw):
+        from .cv import CVArgs
+
+        CVArgs.pop(kw)
+        self.params = CoxPHParams(**kw)
+
+    def train(self, training_frame: Frame,
+              x: Sequence[str] | None = None,
+              ignored_columns: Sequence[str] | None = None,
+              y: str | None = None) -> CoxPHModel:
+        p = self.params
+        if not p.stop_column or not p.event_column:
+            raise ValueError("CoxPH needs stop_column and event_column")
+        if p.ties not in ("efron", "breslow"):
+            raise ValueError(f"unknown ties '{p.ties}'")
+        ignored = list(ignored_columns or []) + [p.stop_column,
+                                                p.event_column]
+        data = resolve_x(training_frame, x, ignored)
+        t = training_frame.vec(p.stop_column).to_numpy().astype(np.float64)
+        e = training_frame.vec(p.event_column).to_numpy().astype(np.float64)
+        n = training_frame.nrows
+        X = np.asarray(data.X)[:n].astype(np.float64)
+        ok = ~(np.isnan(t) | np.isnan(e) | np.isnan(X).any(axis=1))
+        t, e, X = t[ok], e[ok], X[ok]
+        # standardize for conditioning; de-standardize beta at the end
+        mu, sd = X.mean(axis=0), X.std(axis=0) + 1e-12
+        Xs = (X - mu) / sd
+        order = np.argsort(-t, kind="stable")     # time-descending
+        Xs, e_o, t_o = Xs[order], e[order], t[order]
+        # tie groups on identical stop times (descending)
+        grp = np.zeros(len(t_o), dtype=np.int32)
+        if len(t_o) > 1:
+            grp[1:] = np.cumsum(t_o[1:] != t_o[:-1])
+        ngrp = int(grp.max()) + 1 if len(grp) else 1
+        if e_o.sum() == 0:
+            raise ValueError("no events in the training frame")
+        d_max = int(np.bincount(grp[e_o > 0]).max()) if e_o.sum() else 1
+        if d_max > 32 and p.ties == "efron":
+            raise ValueError(
+                f"{d_max} tied events exceed the Efron cap (32); use "
+                "ties='breslow'")
+
+        Xj = jnp.asarray(Xs, dtype=jnp.float32)
+        ej = jnp.asarray(e_o, dtype=jnp.float32)
+        gj = jnp.asarray(grp)
+        P_ = Xj.shape[1]
+        beta = jnp.zeros(P_)
+        ll_prev = -np.inf
+        ll0 = None
+        for _ in range(p.max_iterations):
+            ll, g, H = _cox_step(Xj, ej, gj, ngrp, beta, p.ties)
+            if ll0 is None:
+                ll0 = float(ll)   # beta starts at 0 → this IS the null
+            delta = jnp.linalg.solve(H + 1e-8 * jnp.eye(P_), g)
+            beta = beta + delta
+            llf = float(ll)
+            if abs(llf - ll_prev) < p.tolerance * (abs(llf) + 1e-10):
+                break
+            ll_prev = llf
+        ll_final = float(_cox_step(Xj, ej, gj, ngrp, beta, p.ties)[0])
+        beta_orig = np.asarray(beta, dtype=np.float64) / sd
+        return CoxPHModel(data, p, jnp.asarray(beta_orig,
+                                               dtype=jnp.float32),
+                          list(data.feature_names), ll_final, ll0,
+                          int(e.sum()))

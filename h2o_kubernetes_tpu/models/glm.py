@@ -396,8 +396,10 @@ class GLMModel(Model):
         b = np.array([coefs[nm] for nm in names])
         with np.errstate(invalid="ignore", divide="ignore"):
             z = b / se
-        from scipy.stats import norm
-        pv = 2.0 * norm.sf(np.abs(z))
+        from math import erfc
+        # normal two-sided tail via erfc — no scipy dependency
+        pv = np.array([erfc(abs(zz) / np.sqrt(2.0)) if zz == zz else
+                       np.nan for zz in z])
         self._std_errs = dict(zip(names, se))
         self._z_values = dict(zip(names, z))
         self._p_values = dict(zip(names, pv))

@@ -1,0 +1,141 @@
+"""Failure detection — the heartbeat analog (SURVEY.md §5.3).
+
+The reference's water/HeartBeatThread gossips liveness between nodes;
+a node missing heartbeats is declared gone and, because the cloud is
+locked, the cluster becomes unusable: jobs fail cleanly and the cloud
+reports unhealthy. On TPU the failure mode is a chip/runtime hang or a
+dead ICI link, so the heartbeat is a tiny collective probe across the
+mesh executed under a deadline in a worker thread.
+
+Semantics mirror the reference — detection + fail-fast, no elasticity:
+once a probe fails, `healthy()` flips false, `require_healthy()` (run
+at every MRTask `doall`) raises `ClusterHealthError`, and
+`cluster_status()` reports unhealthy. Recovery is checkpoint-restart
+(persist/orbax + AutoML's resume manifest), not cloud re-formation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_state = {
+    "healthy": True,
+    "last_beat": None,    # wall time of last successful probe
+    "beats": 0,
+    "error": "",
+}
+_lock = threading.Lock()
+_thread: threading.Thread | None = None
+_stop = threading.Event()
+
+
+class ClusterHealthError(RuntimeError):
+    """The device mesh failed its liveness probe (fail-fast)."""
+
+
+def _probe() -> float:
+    """One heartbeat: psum a scalar across the whole mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from .mesh import ROWS, global_mesh
+
+    mesh = global_mesh()
+    fn = jax.jit(jax.shard_map(
+        lambda x: jax.lax.psum(jnp.sum(x), ROWS), mesh=mesh,
+        in_specs=P(ROWS), out_specs=P()))
+    arr = jax.device_put(
+        jnp.ones(mesh.shape[ROWS]),
+        jax.sharding.NamedSharding(mesh, P(ROWS)))
+    return float(fn(arr))
+
+
+def heartbeat(timeout: float = 60.0) -> bool:
+    """Run one liveness probe under a deadline; update cluster health.
+
+    The probe runs on a DAEMON thread joined with a timeout — an
+    executor/`with` block would join the hung worker (the very failure
+    this probe detects) and block heartbeat() itself, and a non-daemon
+    worker would also block interpreter exit."""
+    box: dict = {}
+
+    def run():
+        try:
+            box["val"] = _probe()
+        except Exception as e:  # noqa: BLE001 — any device error is fatal
+            box["exc"] = e
+
+    t = threading.Thread(target=run, name="h2o-tpu-probe", daemon=True)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        ok, err = False, f"heartbeat probe hung > {timeout}s"
+    elif "exc" in box:
+        ok, err = False, f"heartbeat probe failed: {box['exc']!r}"
+    else:
+        ok, err = True, ""
+    with _lock:
+        if ok:
+            _state["healthy"] = True
+            _state["last_beat"] = time.time()
+            _state["beats"] += 1
+            _state["error"] = ""
+        else:
+            _state["healthy"] = False
+            _state["error"] = err
+    return ok
+
+
+def healthy() -> bool:
+    with _lock:
+        return bool(_state["healthy"])
+
+
+def health_status() -> dict:
+    with _lock:
+        return dict(_state)
+
+
+def require_healthy() -> None:
+    """Fail fast (reference: jobs on a broken cloud fail cleanly)."""
+    with _lock:
+        if not _state["healthy"]:
+            raise ClusterHealthError(
+                f"cluster unhealthy: {_state['error']} — restart the "
+                "cluster and resume from the last checkpoint")
+
+
+def mark_unhealthy(error: str) -> None:
+    """Record an externally-observed failure (e.g. a device error
+    escaping a training step)."""
+    with _lock:
+        _state["healthy"] = False
+        _state["error"] = error
+
+
+def reset() -> None:
+    """Clear health state (new cluster after restart)."""
+    with _lock:
+        _state.update(healthy=True, error="", last_beat=None, beats=0)
+
+
+def start_heartbeat(interval: float = 30.0, timeout: float = 60.0) -> None:
+    """Background heartbeat loop (the HeartBeatThread analog)."""
+    global _thread
+    if _thread is not None and _thread.is_alive():
+        return
+    _stop.clear()
+
+    def loop():
+        while not _stop.wait(interval):
+            heartbeat(timeout=timeout)
+
+    _thread = threading.Thread(target=loop, name="h2o-tpu-heartbeat",
+                               daemon=True)
+    _thread.start()
+
+
+def stop_heartbeat() -> None:
+    _stop.set()

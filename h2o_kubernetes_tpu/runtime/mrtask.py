@@ -44,6 +44,9 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
     Returns the fully reduced pytree, replicated on every device (like
     `MRTask.getResult()` returning the reduced task object to the caller).
     """
+    from .health import require_healthy
+
+    require_healthy()     # fail fast on a broken cloud (SURVEY.md §5.3)
     mesh = mesh or global_mesh()
 
     def body(*shards):

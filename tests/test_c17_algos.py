@@ -1,0 +1,157 @@
+"""GLRM / CoxPH / Aggregator (SURVEY.md §2b C17 round-2 additions).
+
+Oracles: GLRM with quadratic loss vs sklearn TruncatedSVD (both solve
+rank-k least squares on complete data); CoxPH coefficient recovery on
+simulated exponential survival data + a hand-checkable no-ties case;
+Aggregator invariants (coverage, counts, target tolerance).
+"""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import GLRM, Aggregator, CoxPH
+
+
+# -- GLRM --------------------------------------------------------------------
+
+def _lowrank_frame(n=400, d=6, k=2, seed=0, na_frac=0.0):
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(n, k))
+    V = rng.normal(size=(d, k))
+    X = (U @ V.T + 0.05 * rng.normal(size=(n, d))).astype(np.float32)
+    if na_frac:
+        mask = rng.random(X.shape) < na_frac
+        X = X.copy()
+        X[mask] = np.nan
+    return h2o.Frame.from_arrays({f"c{i}": X[:, i] for i in range(d)}), X
+
+
+def test_glrm_matches_svd_reconstruction(mesh8):
+    fr, X = _lowrank_frame()
+    m = GLRM(k=2, transform="DEMEAN", max_iterations=500, seed=1).train(
+        training_frame=fr)
+    rec = m.reconstruct(fr)
+    Xc = X - X.mean(axis=0)
+    got = np.stack([rec[f"reconstr_c{i}"].to_numpy()
+                    for i in range(X.shape[1])], axis=1)
+    glrm_mse = float(np.mean((got - Xc) ** 2))
+    from sklearn.decomposition import TruncatedSVD
+
+    svd = TruncatedSVD(n_components=2, random_state=0).fit(Xc)
+    svd_mse = float(np.mean(
+        (svd.inverse_transform(svd.transform(Xc)) - Xc) ** 2))
+    # alternating minimization should land near the SVD optimum
+    assert glrm_mse < svd_mse * 1.25 + 1e-4, (glrm_mse, svd_mse)
+    assert m.archetypes().shape == (2, X.shape[1])
+    assert m.x_frame().shape == (fr.nrows, 2)
+
+
+def test_glrm_missing_cells_imputed(mesh8):
+    fr, X = _lowrank_frame(na_frac=0.15, seed=3)
+    m = GLRM(k=2, transform="NONE", max_iterations=500, seed=1).train(
+        training_frame=fr)
+    # objective only counts observed cells; reconstruction must still
+    # correlate with the (unseen) complete structure
+    _, Xfull = _lowrank_frame(na_frac=0.0, seed=3)
+    rec = m.reconstruct(fr)
+    got = np.stack([rec[f"reconstr_c{i}"].to_numpy()
+                    for i in range(X.shape[1])], axis=1)
+    miss = np.isnan(X)
+    assert miss.sum() > 100
+    corr = np.corrcoef(got[miss], Xfull[miss])[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_glrm_non_negative_regularizer(mesh8):
+    rng = np.random.default_rng(5)
+    X = rng.random((200, 4)).astype(np.float32)      # non-negative data
+    fr = h2o.Frame.from_arrays({f"c{i}": X[:, i] for i in range(4)})
+    m = GLRM(k=2, transform="NONE", regularization_x="non_negative",
+             regularization_y="non_negative", max_iterations=300).train(
+        training_frame=fr)
+    assert np.all(np.asarray(m.U) >= 0)
+    assert np.all(np.asarray(m.V) >= 0)
+
+
+# -- CoxPH -------------------------------------------------------------------
+
+def _survival_frame(n=3000, beta=(0.8, -0.5), censor_rate=0.3, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, len(beta)))
+    lam = np.exp(X @ np.asarray(beta))
+    t_event = rng.exponential(1.0 / lam)
+    t_cens = rng.exponential(1.0 / (censor_rate * lam.mean()))
+    t = np.minimum(t_event, t_cens)
+    e = (t_event <= t_cens).astype(np.float64)
+    fr = h2o.Frame.from_arrays({
+        "x0": X[:, 0].astype(np.float32),
+        "x1": X[:, 1].astype(np.float32),
+        "stop": t.astype(np.float32), "event": e})
+    return fr, X, t, e
+
+
+def test_coxph_recovers_coefficients(mesh8):
+    fr, X, t, e = _survival_frame()
+    m = CoxPH(stop_column="stop", event_column="event").train(
+        training_frame=fr)
+    coef = m.coef()
+    np.testing.assert_allclose(coef["x0"], 0.8, atol=0.1)
+    np.testing.assert_allclose(coef["x1"], -0.5, atol=0.1)
+    assert m.loglik > m.loglik_null       # fitted beats null
+    assert m.concordance(fr) > 0.6
+    hr = m.hazard_ratios()
+    np.testing.assert_allclose(hr["x0"], np.exp(coef["x0"]), rtol=1e-6)
+
+
+def test_coxph_hand_checked_no_ties(mesh8):
+    # 3 subjects, times 1<2<3, all events, covariate x=[0,1,0]: the
+    # partial likelihood -log(e^b+2) + b - log(e^b+1) has the closed-
+    # form maximizer e^b = sqrt(2) (set the score to zero) — a finite,
+    # hand-derivable optimum
+    fr = h2o.Frame.from_arrays({
+        "x": np.array([0.0, 1.0, 0.0], dtype=np.float32),
+        "stop": np.array([1.0, 2.0, 3.0], dtype=np.float32),
+        "event": np.array([1.0, 1.0, 1.0], dtype=np.float32)})
+    m = CoxPH(stop_column="stop", event_column="event",
+              max_iterations=50).train(training_frame=fr)
+    np.testing.assert_allclose(m.coef()["x"], np.log(np.sqrt(2.0)),
+                               atol=2e-2)
+
+
+def test_coxph_breslow_close_to_efron_few_ties(mesh8):
+    fr, *_ = _survival_frame(n=800, seed=11)
+    me = CoxPH(stop_column="stop", event_column="event",
+               ties="efron").train(training_frame=fr)
+    mb = CoxPH(stop_column="stop", event_column="event",
+               ties="breslow").train(training_frame=fr)
+    # continuous times → almost no ties → the two agree closely
+    np.testing.assert_allclose(me.coef()["x0"], mb.coef()["x0"],
+                               rtol=2e-2)
+
+
+def test_coxph_requires_columns(mesh8):
+    fr = h2o.Frame.from_arrays({"x": np.arange(5.0)})
+    with pytest.raises(ValueError):
+        CoxPH().train(training_frame=fr)
+
+
+# -- Aggregator --------------------------------------------------------------
+
+def test_aggregator_reduces_to_target(mesh8):
+    rng = np.random.default_rng(13)
+    n = 3000
+    X = np.concatenate([rng.normal(loc=c, scale=0.3, size=(n // 3, 2))
+                        for c in (-3, 0, 3)]).astype(np.float32)
+    fr = h2o.Frame.from_arrays({"a": X[:, 0], "b": X[:, 1]})
+    m = Aggregator(target_num_exemplars=50).train(training_frame=fr)
+    agg = m.aggregated_frame
+    assert "counts" in agg.names
+    counts = agg["counts"].to_numpy()
+    assert counts.sum() == n              # every row accounted for
+    # within the rel_tol band around the target
+    assert 25 <= m.num_exemplars() <= 75, m.num_exemplars()
+    # exemplars span all three clusters
+    a = agg["a"].to_numpy()
+    assert (a < -1.5).any() and (np.abs(a) < 1.5).any() and \
+        (a > 1.5).any()
