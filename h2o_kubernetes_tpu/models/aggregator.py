@@ -61,7 +61,7 @@ def _aggregate(Xs: np.ndarray, radius2: float,
     """Single pass: returns (exemplar_row_indices, member_counts)."""
     n = Xs.shape[0]
     ex_idx: list[int] = [0]
-    counts: list[int] = [1]
+    counts = np.ones(1, dtype=np.int64)
     E = Xs[0:1]
     i = 1
     while i < n:
@@ -77,17 +77,16 @@ def _aggregate(Xs: np.ndarray, radius2: float,
         # batched distance math)
         out = np.flatnonzero(~near)
         upto = out[0] if len(out) else len(B)
-        for j, a in zip(range(upto), assign[:upto]):
-            counts[a] += 1
+        np.add.at(counts, assign[:upto], 1)   # vectorized member tally
         if len(out):
             new = i + out[0]
             ex_idx.append(new)
-            counts.append(1)
+            counts = np.append(counts, 1)
             E = np.concatenate([E, Xs[new: new + 1]], axis=0)
             i = new + 1
         else:
             i += len(B)
-    return np.asarray(ex_idx), np.asarray(counts)
+    return np.asarray(ex_idx), counts
 
 
 class AggregatorModel(Model):

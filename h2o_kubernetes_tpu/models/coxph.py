@@ -115,10 +115,11 @@ def _cox_step(X, ev, grp, ngrp, beta, ties: str):
 class CoxPHModel(Model):
     algo = "coxph"
 
-    def __init__(self, data, params, beta, names, loglik, loglik_null,
-                 n_events):
+    def __init__(self, data, params, dinfo, beta, names, loglik,
+                 loglik_null, n_events):
         super().__init__(data)
         self.params = params
+        self.dinfo = dinfo
         self.beta = beta
         self._names = names
         self.loglik = loglik
@@ -135,7 +136,8 @@ class CoxPHModel(Model):
 
     def _score_matrix(self, X):
         """Linear predictor (log partial hazard), the h2o predict."""
-        return X @ self.beta
+        Xe = self.dinfo.expand(X)[:, :-1]
+        return Xe @ self.beta
 
     def concordance(self, frame: Frame) -> float:
         """Harrell's c-index on (stop, event) vs the risk score."""
@@ -173,12 +175,23 @@ class CoxPH:
         ignored = list(ignored_columns or []) + [p.stop_column,
                                                 p.event_column]
         data = resolve_x(training_frame, x, ignored)
+        # categorical covariates one-hot expand through DataInfo (the
+        # reference does the same in hex/coxph) — raw enum codes fitted
+        # as a single slope would be meaningless
+        from .datainfo import build_datainfo
+
+        dinfo = build_datainfo(data, training_frame, standardize=False,
+                               drop_first=True)
         t = training_frame.vec(p.stop_column).to_numpy().astype(np.float64)
         e = training_frame.vec(p.event_column).to_numpy().astype(np.float64)
         n = training_frame.nrows
-        X = np.asarray(data.X)[:n].astype(np.float64)
-        ok = ~(np.isnan(t) | np.isnan(e) | np.isnan(X).any(axis=1))
-        t, e, X = t[ok], e[ok], X[ok]
+        Xraw = np.asarray(data.X)[:n]
+        ok = ~(np.isnan(t) | np.isnan(e) | np.isnan(Xraw).any(axis=1))
+        t, e = t[ok], e[ok]
+        Xe = np.asarray(jax.jit(dinfo.expand)(
+            jnp.asarray(Xraw[ok])))[:, :-1].astype(np.float64)
+        X = Xe
+        coef_names = dinfo.coef_names[:-1]
         # standardize for conditioning; de-standardize beta at the end
         mu, sd = X.mean(axis=0), X.std(axis=0) + 1e-12
         Xs = (X - mu) / sd
@@ -216,7 +229,6 @@ class CoxPH:
             ll_prev = llf
         ll_final = float(_cox_step(Xj, ej, gj, ngrp, beta, p.ties)[0])
         beta_orig = np.asarray(beta, dtype=np.float64) / sd
-        return CoxPHModel(data, p, jnp.asarray(beta_orig,
-                                               dtype=jnp.float32),
-                          list(data.feature_names), ll_final, ll0,
-                          int(e.sum()))
+        return CoxPHModel(data, p, dinfo,
+                          jnp.asarray(beta_orig, dtype=jnp.float32),
+                          coef_names, ll_final, ll0, int(e.sum()))

@@ -134,29 +134,30 @@ class GLRMModel(Model):
         return Frame.from_arrays(
             {f"Arch{i+1}": U[:, i] for i in range(U.shape[1])})
 
+    def _solve_u(self, X) -> jax.Array:
+        """Per-row ridge solve of U for fixed V on fresh rows. The
+        missing mask comes from the RAW matrix — expand() mean-imputes,
+        so masking the expanded matrix would treat every cell as
+        observed and drag sparse rows toward the column means."""
+        Xe = self.dinfo.expand(X)[:, :-1]
+        mask = _expand_mask(self.dinfo, X, X.shape[0])
+        Xz = jnp.nan_to_num(Xe) * mask
+        V = self.V
+        G = V.T @ V + 1e-6 * jnp.eye(V.shape[1])
+        return Xz @ V @ jnp.linalg.inv(G)
+
     def reconstruct(self, frame: Frame) -> Frame:
         """Impute/reconstruct a frame through the low-rank model
         (h2o predict → reconstructed columns)."""
         X = self._design_matrix(frame)
-        Xe = self.dinfo.expand(X)[:, :-1]
-        mask = (~jnp.isnan(Xe)).astype(jnp.float32)
-        Xz = jnp.nan_to_num(Xe)
-        # fresh rows: solve U for fixed V (ridge least squares per row)
-        V = self.V
-        G = V.T @ V + 1e-6 * jnp.eye(V.shape[1])
-        U = (Xz * mask) @ V @ jnp.linalg.inv(G)
-        rec = U @ V.T
+        rec = self._solve_u(X) @ self.V.T
         names = self.dinfo.coef_names[:-1]
         out = np.asarray(rec)[: frame.nrows]
         return Frame.from_arrays(
             {f"reconstr_{n}": out[:, i] for i, n in enumerate(names)})
 
     def _score_matrix(self, X):
-        Xe = self.dinfo.expand(X)[:, :-1]
-        mask = (~jnp.isnan(Xe)).astype(jnp.float32)
-        Xz = jnp.nan_to_num(Xe)
-        G = self.V.T @ self.V + 1e-6 * jnp.eye(self.V.shape[1])
-        return (Xz * mask) @ self.V @ jnp.linalg.inv(G)
+        return self._solve_u(X)
 
 
 class GLRM:

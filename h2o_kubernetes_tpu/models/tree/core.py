@@ -130,7 +130,13 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
 
 
 def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
-    """Per-shard tree build (runs under shard_map; histograms psum'd)."""
+    """Per-shard tree build (runs under shard_map; histograms psum'd).
+
+    Returns (Tree, leaf_node): `leaf_node` is each row's final absolute
+    heap index — the grower already walks every row to its resting node,
+    so the boost loop reads `tree.value[leaf_node]` instead of paying a
+    second full heap descent per tree (predict_tree).
+    """
     F = binned.shape[1]
     N = 2 ** (p.max_depth + 1) - 1
     split_feat = jnp.full(N, -1, dtype=jnp.int32)
@@ -141,6 +147,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
     gain = jnp.zeros(N, dtype=jnp.float32)
 
     rel = jnp.zeros(binned.shape[0], dtype=jnp.int32)   # relative node @ lvl
+    abs_node = jnp.zeros(binned.shape[0], dtype=jnp.int32)
 
     hist_prev = None        # parent histograms for sibling subtraction
     can_prev = None
@@ -203,9 +210,12 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         is_na = rowbin == p.n_bins - 1
         go_right = jnp.where(is_na, ~nl, rowbin > b)
         child = 2 * rel + go_right.astype(jnp.int32)  # rel index at d+1
-        rel = jnp.where(live & can[safe_rel], child, -1)
+        moved = live & can[safe_rel]
+        rel = jnp.where(moved, child, -1)
+        abs_node = jnp.where(moved, (2 ** (d + 1) - 1) + child, abs_node)
 
-    return Tree(split_feat, split_bin, na_left, is_split, value, gain)
+    return Tree(split_feat, split_bin, na_left, is_split, value, gain), \
+        abs_node
 
 
 def grow_tree(binned, g, h, w, p: TreeParams, col_mask=None, key=None,
@@ -274,11 +284,13 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
             g, h = -y, jnp.ones_like(y)
         else:
             g, h = _grad_hess(bp.distribution, margin, y)
-        tree = _grow_tree_shard(binned, g, h, w_t, col_mask, k_tree, p)
+        tree, leaf = _grow_tree_shard(binned, g, h, w_t, col_mask,
+                                      k_tree, p)
         tree = tree._replace(value=bp.learn_rate * tree.value)
         if not bp.drf_mode:
-            margin = margin + predict_tree(tree, binned, p.max_depth,
-                                           p.n_bins)
+            # the grower already walked each row to its leaf: one gather
+            # replaces a full predict_tree heap re-descent per tree
+            margin = margin + tree.value[leaf]
         return margin, tree
 
     margin, trees = lax.scan(body, margin, keys)
@@ -311,8 +323,12 @@ def boost_trees(binned, y, w, margin, key, n_trees: int, p: TreeParams,
 @functools.partial(jax.jit, static_argnums=(6, 7))
 def _grow_tree_jit(binned, g, h, w, col_mask, key, p: TreeParams,
                    mesh) -> Tree:
+    def body(*args):
+        tree, _ = _grow_tree_shard(*args, p=p)
+        return tree
+
     fn = jax.shard_map(
-        functools.partial(_grow_tree_shard, p=p),
+        body,
         mesh=mesh,
         in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
         out_specs=P(),

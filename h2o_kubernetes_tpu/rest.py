@@ -1,0 +1,228 @@
+"""REST v3 API server — the water/api RequestServer analog.
+
+Reference: h2o-core water/api (RequestServer + schemas3, SURVEY.md §2b
+C9): a Jetty server on :54321 where every client verb is a versioned
+endpoint — /3/Cloud, /3/ImportFiles, /3/Parse, /3/Frames,
+/3/ModelBuilders/{algo}, /3/Models, /3/Predictions, /3/Jobs.
+
+This build is Python-first (the client talks to the library directly),
+so the REST layer is a thin JSON adapter over the same registries the
+Python API uses: Frames and Models live in module-level key-value
+stores (the DKV-for-small-objects analog), model builds run on a
+worker thread under a Job, and every response is plain JSON. Start one
+with `h2o_kubernetes_tpu.rest.start_server(port)` or
+`python -m h2o_kubernetes_tpu.rest`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import traceback
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+FRAMES: dict[str, object] = {}     # key -> Frame (DKV analog)
+MODELS: dict[str, object] = {}     # key -> Model
+
+_ALGOS = ("gbm", "drf", "glm", "deeplearning", "xgboost", "kmeans",
+          "naivebayes", "pca", "isolationforest", "glrm", "coxph",
+          "aggregator")
+
+
+def _algo_estimator(algo: str):
+    from . import models as M
+
+    return {
+        "gbm": M.GBM, "drf": M.DRF, "glm": M.GLM,
+        "deeplearning": M.DeepLearning, "xgboost": M.XGBoost,
+        "kmeans": M.KMeans, "naivebayes": M.NaiveBayes, "pca": M.PCA,
+        "isolationforest": M.IsolationForest, "glrm": M.GLRM,
+        "coxph": M.CoxPH, "aggregator": M.Aggregator,
+    }[algo]
+
+
+def _frame_schema(key: str, fr) -> dict:
+    return {"frame_id": {"name": key}, "rows": fr.nrows,
+            "columns": [{"label": n,
+                         "type": fr.vec(n).kind} for n in fr.names]}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "h2o-tpu-rest/1"
+
+    def log_message(self, *a):       # quiet by default
+        pass
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _json(self, obj, code: int = 200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str):
+        self._json({"__schema": "H2OErrorV3", "http_status": code,
+                    "msg": msg}, code)
+
+    def _params(self) -> dict:
+        q = urllib.parse.urlparse(self.path).query
+        out = {k: v[0] for k, v in urllib.parse.parse_qs(q).items()}
+        ln = int(self.headers.get("Content-Length") or 0)
+        if ln:
+            raw = self.rfile.read(ln).decode()
+            ctype = self.headers.get("Content-Type", "")
+            if "json" in ctype:
+                out.update(json.loads(raw))
+            else:
+                out.update({k: v[0] for k, v in
+                            urllib.parse.parse_qs(raw).items()})
+        return out
+
+    # -- routes --------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            path = urllib.parse.urlparse(self.path).path.rstrip("/")
+            if path == "/3/Cloud":
+                from . import cluster_status
+
+                return self._json(cluster_status())
+            if path == "/3/Jobs":
+                from .automl import jobs
+
+                return self._json({"jobs": jobs()})
+            if path == "/3/Frames":
+                return self._json({"frames": [
+                    _frame_schema(k, f) for k, f in FRAMES.items()]})
+            if path.startswith("/3/Frames/"):
+                rest = path[len("/3/Frames/"):]
+                key, _, verb = rest.partition("/")
+                if key not in FRAMES:
+                    return self._error(404, f"frame '{key}' not found")
+                fr = FRAMES[key]
+                if verb == "summary":
+                    return self._json({"frame_id": {"name": key},
+                                       "summary": fr.summary()})
+                return self._json(_frame_schema(key, fr))
+            if path == "/3/Models":
+                return self._json({"models": [
+                    {"model_id": {"name": k}, "algo": m.algo}
+                    for k, m in MODELS.items()]})
+            if path.startswith("/3/Models/"):
+                key = path[len("/3/Models/"):]
+                if key not in MODELS:
+                    return self._error(404, f"model '{key}' not found")
+                m = MODELS[key]
+                return self._json({"model_id": {"name": key},
+                                   "algo": m.algo,
+                                   "nclasses": m.nclasses})
+            return self._error(404, f"no route for GET {path}")
+        except Exception as e:       # noqa: BLE001
+            traceback.print_exc()
+            return self._error(500, repr(e))
+
+    def do_POST(self):
+        try:
+            path = urllib.parse.urlparse(self.path).path.rstrip("/")
+            params = self._params()
+            if path == "/3/ImportFiles" or path == "/3/Parse":
+                from .frame import import_file
+
+                src = params.get("path") or params.get("source_frames")
+                if not src:
+                    return self._error(400, "missing 'path'")
+                key = params.get("destination_frame") or \
+                    src.rsplit("/", 1)[-1]
+                FRAMES[key] = import_file(src)
+                return self._json(_frame_schema(key, FRAMES[key]))
+            if path.startswith("/3/ModelBuilders/"):
+                algo = path[len("/3/ModelBuilders/"):]
+                if algo not in _ALGOS:
+                    return self._error(404, f"unknown algo '{algo}'")
+                return self._build_model(algo, params)
+            if path.startswith("/3/Predictions/models/"):
+                rest = path[len("/3/Predictions/models/"):]
+                mkey, _, fpart = rest.partition("/frames/")
+                if mkey not in MODELS:
+                    return self._error(404, f"model '{mkey}' not found")
+                if fpart not in FRAMES:
+                    return self._error(404, f"frame '{fpart}' not found")
+                pred = MODELS[mkey].predict(FRAMES[fpart])
+                key = f"prediction_{mkey}_{fpart}"
+                FRAMES[key] = pred
+                return self._json({"predictions_frame": {"name": key},
+                                   **_frame_schema(key, pred)})
+            return self._error(404, f"no route for POST {path}")
+        except Exception as e:       # noqa: BLE001
+            traceback.print_exc()
+            return self._error(500, repr(e))
+
+    def _build_model(self, algo: str, params: dict):
+        from .automl import Job
+
+        training = params.pop("training_frame", None)
+        if training not in FRAMES:
+            return self._error(404, f"frame '{training}' not found")
+        y = params.pop("response_column", params.pop("y", None))
+        model_id = params.pop("model_id", None) or \
+            f"{algo}_{len(MODELS) + 1}"
+        ignored = params.pop("ignored_columns", None)
+        # remaining params go to the estimator; numbers arrive as strings
+        # from form encoding — coerce the obvious ones
+        kw = {}
+        for k, v in params.items():
+            if isinstance(v, str):
+                try:
+                    v = json.loads(v)      # "50" -> 50, "true" -> True
+                except (ValueError, TypeError):
+                    pass
+            kw[k] = v
+        job = Job(dest=model_id,
+                  description=f"{algo} on {training}").start()
+
+        def run():
+            try:
+                est = _algo_estimator(algo)(**kw)
+                if y is not None:
+                    model = est.train(y=y, training_frame=FRAMES[training],
+                                      ignored_columns=ignored)
+                else:
+                    model = est.train(training_frame=FRAMES[training],
+                                      ignored_columns=ignored)
+                MODELS[model_id] = model
+                job.done()
+            except Exception as e:     # noqa: BLE001
+                job.failed(repr(e))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=float(params.get("_sync_timeout", 600)))
+        return self._json({"job": {"dest": {"name": model_id},
+                                   "status": job.status,
+                                   "msg": job.msg}})
+
+
+def start_server(port: int = 54321, host: str = "127.0.0.1",
+                 background: bool = True) -> ThreadingHTTPServer:
+    """Start the REST server (:54321 is the reference's default port)."""
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    if background:
+        t = threading.Thread(target=srv.serve_forever,
+                             name="h2o-tpu-rest", daemon=True)
+        t.start()
+    else:
+        srv.serve_forever()
+    return srv
+
+
+if __name__ == "__main__":
+    import sys
+
+    start_server(int(sys.argv[1]) if len(sys.argv) > 1 else 54321,
+                 background=False)

@@ -78,14 +78,15 @@ def heartbeat(timeout: float = 60.0) -> bool:
         ok, err = True, ""
     with _lock:
         if ok:
-            _state["healthy"] = True
+            # a success does NOT clear a tripped unhealthy state: the
+            # cloud is locked (reference semantics — no elasticity);
+            # recovery is an explicit restart via reset()
             _state["last_beat"] = time.time()
             _state["beats"] += 1
-            _state["error"] = ""
         else:
             _state["healthy"] = False
             _state["error"] = err
-    return ok
+    return ok and healthy()
 
 
 def healthy() -> bool:

@@ -1,0 +1,93 @@
+"""REST v3 adapter (SURVEY.md §2b C9): the full client loop over HTTP —
+import → inspect → build → poll → predict — against a live server, the
+way h2o-py drives the reference's RequestServer."""
+
+import json
+import socket
+import urllib.parse
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import rest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def server(mesh8):
+    port = _free_port()
+    srv = rest.start_server(port)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    rest.FRAMES.clear()
+    rest.MODELS.clear()
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _post(base, route, **params):
+    data = urllib.parse.urlencode(params).encode()
+    req = urllib.request.Request(base + route, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=600) as r:
+        return json.loads(r.read())
+
+
+def test_cloud_and_jobs(server):
+    cloud = _get(server, "/3/Cloud")
+    assert cloud["cloud_size"] == 8 and cloud["cloud_healthy"]
+    jobs = _get(server, "/3/Jobs")
+    assert "jobs" in jobs
+
+
+def test_full_rest_loop(server, tmp_path):
+    rng = np.random.default_rng(3)
+    n = 400
+    x = rng.normal(size=n)
+    y = np.where(x + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+    fr = h2o.Frame.from_arrays({"x": x.astype(np.float32), "y": y})
+    csv = tmp_path / "train.csv"
+    h2o.export_file(fr, str(csv))
+
+    # import → frame appears with schema
+    imp = _post(server, "/3/ImportFiles", path=str(csv),
+                destination_frame="train")
+    assert imp["rows"] == n
+    frames = _get(server, "/3/Frames")
+    assert any(f["frame_id"]["name"] == "train"
+               for f in frames["frames"])
+    summ = _get(server, "/3/Frames/train/summary")
+    assert "x" in summ["summary"]
+
+    # build a GBM over REST; the call returns when the job finishes
+    job = _post(server, "/3/ModelBuilders/gbm", training_frame="train",
+                response_column="y", ntrees="10", max_depth="3",
+                model_id="gbm_rest")
+    assert job["job"]["status"] == "DONE", job
+    models = _get(server, "/3/Models")
+    assert any(m["model_id"]["name"] == "gbm_rest"
+               for m in models["models"])
+
+    # score over REST → prediction frame registered
+    pred = _post(server, "/3/Predictions/models/gbm_rest/frames/train")
+    assert pred["rows"] == n
+    pname = pred["predictions_frame"]["name"]
+    assert _get(server, f"/3/Frames/{pname}")["rows"] == n
+
+
+def test_rest_errors(server):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(server, "/3/Frames/nope")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(server, "/3/ModelBuilders/notanalgo", training_frame="x")
+    assert e.value.code == 404
