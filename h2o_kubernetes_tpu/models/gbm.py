@@ -11,6 +11,9 @@ Distributions (hex/genmodel DistributionFamily analogs):
   bernoulli    g = p - y,            h = p(1-p)       (logit link)
   multinomial  K trees/iter, softmax gradient
   poisson      g = exp(f) - y,       h = exp(f)        (log link)
+  gamma        g = 1 - y·exp(-f),    h = y·exp(-f)      (log link)
+  tweedie      compound-poisson deviance at power 1.5   (log link)
+  laplace      g = sign(f - y),      h = 1              (L1 loss)
 """
 
 from __future__ import annotations
@@ -74,7 +77,7 @@ def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
     if dist == "multinomial":
         pr = jax.nn.softmax(margin, axis=1)
         return {"train_logloss": M.multinomial_logloss(y, pr, w=w)}
-    if dist == "poisson":
+    if dist in ("poisson", "gamma", "tweedie"):
         return {"train_rmse": M.rmse(y, jnp.exp(margin), w=w)}
     return {"train_rmse": M.rmse(y, margin, w=w)}
 
@@ -126,6 +129,7 @@ class GBMModel(Model):
             self.trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
             self.ntrees = len(trees)
         self.init_score = init_score
+        self.margin_scale = 1.0       # laplace robust scaling (train sets)
         self._varimp = varimp
         self._edges = jnp.asarray(bin_spec.edges_matrix())
         self._enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
@@ -139,7 +143,8 @@ class GBMModel(Model):
             m = _stack_predict(self.trees, binned, p.max_depth, p.nbins)
             if p._drf_mode:
                 m = m / self.ntrees
-            return self.init_score + m
+            return self.init_score + \
+                getattr(self, "margin_scale", 1.0) * m
         # multinomial: trees interleaved [T*K]; de-interleave per class
         outs = []
         for k in range(K):
@@ -162,7 +167,7 @@ class GBMModel(Model):
                 m = jnp.clip(m, 0.0, None)
                 return m / (jnp.sum(m, axis=1, keepdims=True) + 1e-10)
             return jax.nn.softmax(m, axis=1)
-        if d == "poisson":
+        if d in ("poisson", "gamma", "tweedie"):
             return jnp.exp(m)
         return m
 
@@ -198,6 +203,7 @@ class GBM:
                 [self.cv_args.fold_column]
         data = resolve_xy(training_frame, y, x, ignored_columns,
                           weights_column, p.distribution)
+        margin_scale = 1.0
         ckpt = p.checkpoint
         if ckpt is not None:
             if self.cv_args.enabled:
@@ -279,13 +285,39 @@ class GBM:
                 init[k] = np.log(max(pk, 1e-8))
             margin = jnp.broadcast_to(jnp.asarray(init)[None, :],
                                       (data.y.shape[0], K))
-        elif data.distribution == "poisson":
+        elif data.distribution in ("poisson", "gamma", "tweedie"):
             mu = float(jnp.sum(data.y * data.w)) / w_sum
             init = np.log(max(mu, 1e-8))
             margin = jnp.full_like(data.y, init)
+        elif data.distribution == "laplace":
+            # L1 leaf steps are bounded by learn_rate, so fit in
+            # median/MAD-scaled space: |y-f| is scale-equivariant and
+            # the minimizer is unchanged; predictions rescale on read
+            yv = np.asarray(data.y)[np.asarray(data.w) > 0]
+            init = float(np.median(yv)) if len(yv) else 0.0
+            mad = float(np.median(np.abs(yv - init))) if len(yv) else 1.0
+            margin_scale = max(mad * 1.4826, 1e-8)
+            import dataclasses
+
+            data = dataclasses.replace(
+                data, y=(data.y - init) / margin_scale)
+            margin = jnp.zeros_like(data.y)
         else:
             init = float(jnp.sum(data.y * data.w)) / w_sum
             margin = jnp.full_like(data.y, init)
+
+        if ckpt is not None and data.distribution == "laplace":
+            # continuation must reuse the checkpoint's robust scaling or
+            # the new trees' leaf units would not compose; the working
+            # margin lives in SCALED units (tree leaves), so drop the
+            # init the generic ckpt branch added above
+            init = ckpt.init_score
+            margin_scale = getattr(ckpt, "margin_scale", 1.0)
+            import dataclasses
+
+            data = dataclasses.replace(
+                data, y=(data.y - init) / margin_scale)
+            margin = margin - init
 
         start_t = 0
         if ckpt is not None:
@@ -356,6 +388,7 @@ class GBM:
 
         model = self.model_cls(data, p, bin_spec, trees,
                                init_score=init, varimp=None)
+        model.margin_scale = margin_scale
         model._varimp = _stacked_varimp(model.trees, data.feature_names)
         if p._drf_mode:
             perf = model.model_performance(training_frame, y)
@@ -364,6 +397,11 @@ class GBM:
         else:
             history.append({"ntrees": p.ntrees, **_margin_metrics(
                 data.distribution, margin, data.y, data.w)})
+        if margin_scale != 1.0 and history:
+            # report rmse in ORIGINAL units, not MAD units
+            for hrow in history:
+                if "train_rmse" in hrow:
+                    hrow["train_rmse"] *= margin_scale
         model.scoring_history = history
         from .cv import finalize_train
 

@@ -240,6 +240,18 @@ def _grad_hess(distribution: str, margin, y):
     if distribution == "poisson":
         mu = jnp.exp(margin)
         return mu - y, mu
+    if distribution == "gamma":
+        # gamma deviance, log link: g = 1 - y·e^{-f}, h = y·e^{-f}
+        ye = y * jnp.exp(-margin)
+        return 1.0 - ye, jnp.clip(ye, 1e-10, None)
+    if distribution == "tweedie":
+        pw = 1.5                      # variance power (fixed, like H2O's
+        a = y * jnp.exp((1.0 - pw) * margin)      # default 1.5)
+        b = jnp.exp((2.0 - pw) * margin)
+        return b - a, jnp.clip((2.0 - pw) * b - (1.0 - pw) * a,
+                               1e-10, None)
+    if distribution == "laplace":
+        return jnp.sign(margin - y), jnp.ones_like(margin)
     raise ValueError(distribution)
 
 

@@ -9,7 +9,8 @@ artifact runs on any serving host.
 Supported: GBM / DRF / XGBoost (trees + bin edges), GLM (beta + design
 layout, all families/links incl. multinomial), KMeans (centers),
 DeepLearning (layer weights; MLP, softmax and autoencoder modes),
-NaiveBayes (priors + likelihood tables), PCA (eigenvectors).
+NaiveBayes (priors + likelihood tables), PCA (eigenvectors),
+Word2Vec (embeddings + vocab with word_vector/find_synonyms accessors).
 """
 
 from __future__ import annotations
@@ -32,14 +33,15 @@ def _np(a):
 def export_mojo(model, path: str) -> str:
     """Write `model` as a standalone scoring artifact at `path`."""
     algo = model.algo
+    # word2vec has no tabular design, so the shared fields are optional
     meta = {
         "format": _FORMAT,
         "algo": algo,
-        "feature_names": model.feature_names,
-        "feature_domains": model.feature_domains,
-        "nclasses": model.nclasses,
-        "response_domain": model.response_domain,
-        "distribution": model.distribution,
+        "feature_names": getattr(model, "feature_names", []),
+        "feature_domains": getattr(model, "feature_domains", {}),
+        "nclasses": getattr(model, "nclasses", 1),
+        "response_domain": getattr(model, "response_domain", None),
+        "distribution": getattr(model, "distribution", None),
     }
     arrays: dict[str, np.ndarray] = {}
     if algo in ("gbm", "drf", "xgboost"):
@@ -48,6 +50,7 @@ def export_mojo(model, path: str) -> str:
         meta["drf_mode"] = bool(model.params._drf_mode)
         meta["ntrees"] = model.ntrees
         meta["na_bin"] = model.bin_spec.na_bin
+        meta["margin_scale"] = float(getattr(model, "margin_scale", 1.0))
         arrays["init_score"] = _np(model.init_score)
         arrays["edges"] = _np(model._edges)
         arrays["enum_mask"] = _np(model._enum_mask)
@@ -98,6 +101,9 @@ def export_mojo(model, path: str) -> str:
         arrays["stds"] = _np(d.stds)
         arrays["eigenvectors"] = _np(model.eigenvectors)
         arrays["eigenvalues"] = _np(model.eigenvalues)
+    elif algo == "word2vec":
+        meta["vocab"] = list(model.vocab)
+        arrays["embeddings"] = _np(model.W)
     elif algo == "kmeans":
         arrays["centers"] = _np(model.centers_std)
         d = model.dinfo
@@ -134,6 +140,9 @@ class MojoModel:
         self.algo = self.meta["algo"]
         self.feature_names = self.meta["feature_names"]
         self.nclasses = self.meta["nclasses"]
+        if self.algo == "word2vec":   # O(1) lookups on large vocabs
+            self._word_index = {w: i for i, w in
+                                enumerate(self.meta["vocab"])}
 
     # -- feature matrix from a dict of columns ------------------------------
 
@@ -171,6 +180,33 @@ class MojoModel:
         if self.algo == "pca":
             return self._predict_pca(X)
         raise ValueError(self.algo)
+
+    # -- word2vec accessors (no row scoring; embeddings ARE the model) ------
+
+    def word_vector(self, word: str) -> np.ndarray:
+        if self.algo != "word2vec":
+            raise ValueError("word_vector() is a word2vec accessor")
+        if word not in self._word_index:
+            raise KeyError(word)
+        return self.arrays["embeddings"][self._word_index[word]]
+
+    def find_synonyms(self, word: str, count: int = 10) -> dict:
+        if self.algo != "word2vec":
+            raise ValueError("find_synonyms() is a word2vec accessor")
+        W = self.arrays["embeddings"]
+        vocab = self.meta["vocab"]
+        v = self.word_vector(word)
+        sims = (W @ v) / (np.linalg.norm(W, axis=1) *
+                          np.linalg.norm(v) + 1e-12)
+        order = np.argsort(-sims)
+        out = {}
+        for i in order:
+            if vocab[i] == word:
+                continue
+            out[vocab[i]] = float(sims[i])
+            if len(out) >= count:
+                break
+        return out
 
     # -- scorers -------------------------------------------------------------
 
@@ -258,8 +294,11 @@ class MojoModel:
                 return z / (z.sum(axis=1, keepdims=True) + 1e-10)
             z = np.exp(probsum - probsum.max(axis=1, keepdims=True))
             return z / z.sum(axis=1, keepdims=True)
-        if d == "poisson":
+        if d in ("poisson", "gamma", "tweedie"):
             return np.exp(probsum[:, 0])
+        scale = m.get("margin_scale", 1.0)
+        if scale != 1.0:
+            return init[0] + scale * totals[:, 0]
         return probsum[:, 0]
 
     def _predict_glm(self, X):

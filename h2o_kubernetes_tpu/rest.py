@@ -22,10 +22,10 @@ import traceback
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import numpy as np
-
 FRAMES: dict[str, object] = {}     # key -> Frame (DKV analog)
 MODELS: dict[str, object] = {}     # key -> Model
+_ID_LOCK = threading.Lock()
+_MODEL_SEQ = 0
 
 _ALGOS = ("gbm", "drf", "glm", "deeplearning", "xgboost", "kmeans",
           "naivebayes", "pca", "isolationforest", "glrm", "coxph",
@@ -135,7 +135,9 @@ class _Handler(BaseHTTPRequestHandler):
                 from .frame import import_file
 
                 src = params.get("path") or params.get("source_frames")
-                if not src:
+                if isinstance(src, (list, tuple)):
+                    src = src[0] if src else None     # h2o-py list form
+                if not src or not isinstance(src, str):
                     return self._error(400, "missing 'path'")
                 key = params.get("destination_frame") or \
                     src.rsplit("/", 1)[-1]
@@ -170,8 +172,13 @@ class _Handler(BaseHTTPRequestHandler):
         if training not in FRAMES:
             return self._error(404, f"frame '{training}' not found")
         y = params.pop("response_column", params.pop("y", None))
-        model_id = params.pop("model_id", None) or \
-            f"{algo}_{len(MODELS) + 1}"
+        sync_timeout = float(params.pop("_sync_timeout", 600))
+        model_id = params.pop("model_id", None)
+        if not model_id:
+            with _ID_LOCK:                 # ThreadingHTTPServer: no races
+                global _MODEL_SEQ
+                _MODEL_SEQ += 1
+                model_id = f"{algo}_{_MODEL_SEQ}"
         ignored = params.pop("ignored_columns", None)
         # remaining params go to the estimator; numbers arrive as strings
         # from form encoding — coerce the obvious ones
@@ -202,7 +209,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         t = threading.Thread(target=run, daemon=True)
         t.start()
-        t.join(timeout=float(params.get("_sync_timeout", 600)))
+        t.join(timeout=sync_timeout)
         return self._json({"job": {"dest": {"name": model_id},
                                    "status": job.status,
                                    "msg": job.msg}})

@@ -170,3 +170,70 @@ def test_time_feature_binning_consistent(mesh8):
     fr = Frame.from_arrays({"t": t, "y": y})
     m = GBM(ntrees=5, max_depth=2, seed=0).train(y="y", training_frame=fr)
     assert m.model_performance(fr, "y")["auc"] > 0.99
+
+
+# -- round-2 distribution breadth (hex/genmodel DistributionFamily) ----------
+
+def test_gbm_gamma_distribution(mesh8):
+    rng = np.random.default_rng(31)
+    n = 3000
+    x = rng.normal(size=n)
+    mu = np.exp(0.6 * x + 1.0)
+    y = rng.gamma(shape=3.0, scale=mu / 3.0)
+    fr = Frame.from_arrays({"x": x.astype(np.float32), "y": y})
+    m = GBM(ntrees=40, max_depth=3, learn_rate=0.2,
+            distribution="gamma", seed=1).train(y="y", training_frame=fr)
+    pred = m.predict_raw(fr)
+    assert np.all(np.asarray(pred)[:n] > 0)       # log link → positive
+    corr = np.corrcoef(np.asarray(pred)[:n], mu)[0, 1]
+    assert corr > 0.9, corr
+
+
+def test_gbm_tweedie_distribution(mesh8):
+    rng = np.random.default_rng(32)
+    n = 3000
+    x = rng.normal(size=n)
+    mu = np.exp(0.5 * x)
+    npois = rng.poisson(mu)
+    y = np.array([rng.gamma(s, 1.0) if s > 0 else 0.0 for s in npois])
+    fr = Frame.from_arrays({"x": x.astype(np.float32), "y": y})
+    m = GBM(ntrees=40, max_depth=3, learn_rate=0.2,
+            distribution="tweedie", seed=1).train(y="y",
+                                                  training_frame=fr)
+    pred = np.asarray(m.predict_raw(fr))[:n]
+    assert np.all(pred > 0)
+    assert np.corrcoef(pred, mu)[0, 1] > 0.8
+
+
+def test_gbm_laplace_robust_to_outliers(mesh8):
+    rng = np.random.default_rng(33)
+    n = 3000
+    x = rng.normal(size=n)
+    y = 2.0 * x + rng.normal(scale=0.1, size=n)
+    y[::50] += 100.0                              # gross outliers
+    fr = Frame.from_arrays({"x": x.astype(np.float32),
+                            "y": y.astype(np.float32)})
+    m_l1 = GBM(ntrees=40, max_depth=3, learn_rate=0.3,
+               distribution="laplace", seed=1).train(
+        y="y", training_frame=fr)
+    clean = np.ones(n, dtype=bool); clean[::50] = False
+    pred = np.asarray(m_l1.predict_raw(fr))[:n]
+    mae_clean = float(np.mean(np.abs(pred[clean] - y[clean])))
+    assert mae_clean < 0.5, mae_clean             # outliers ignored
+
+
+def test_gbm_laplace_large_scale_response(mesh8):
+    # leaf steps are bounded by learn_rate, so without the internal
+    # median/MAD scaling a y spanning thousands could never be fit
+    rng = np.random.default_rng(34)
+    n = 2000
+    x = rng.normal(size=n)
+    y = 1000.0 * x + rng.normal(scale=10.0, size=n)
+    fr = Frame.from_arrays({"x": x.astype(np.float32),
+                            "y": y.astype(np.float32)})
+    m = GBM(ntrees=40, max_depth=3, learn_rate=0.3,
+            distribution="laplace", seed=1).train(
+        y="y", training_frame=fr)
+    pred = np.asarray(m.predict_raw(fr))[:n]
+    assert float(np.mean(np.abs(pred - y))) < 150.0
+    assert pred.std() > 500.0             # predictions span the range

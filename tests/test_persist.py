@@ -266,3 +266,26 @@ class TestMojoRound2:
         np.testing.assert_allclose(mj.predict(data),
                                    np.asarray(m.predict_raw(fr.drop("y"))),
                                    rtol=2e-4, atol=2e-4)
+
+    def test_word2vec_mojo(self, tmp_path, mesh8):
+        from h2o_kubernetes_tpu.models import Word2Vec
+
+        rng = np.random.default_rng(3)
+        words = ["king", "queen", "man", "woman", "apple", "pear"]
+        toks = []
+        for _ in range(150):
+            toks += list(rng.choice(words[:4], 5)) + [None]
+        for _ in range(150):
+            toks += list(rng.choice(words[4:], 5)) + [None]
+        fr = h2o.Frame.from_arrays(
+            {"words": np.array(toks, dtype=object)})
+        m = Word2Vec(vec_size=8, epochs=3, min_word_freq=2,
+                     seed=1).train(fr)
+        p = str(tmp_path / "w2v.zip")
+        h2o.export_mojo(m, p)
+        mj = h2o.import_mojo(p)
+        np.testing.assert_allclose(mj.word_vector("king"),
+                                   np.asarray(m.W)[m.word_index["king"]],
+                                   rtol=1e-6)
+        syn = mj.find_synonyms("king", count=3)
+        assert len(syn) == 3 and "king" not in syn
