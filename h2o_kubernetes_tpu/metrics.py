@@ -9,6 +9,8 @@ reductions — cheap next to training).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -54,18 +56,30 @@ def _auc_impl(y, s, wt):
 
 
 def logloss(y_true, p, eps: float = 1e-7, w=None) -> float:
-    # eps must stay f32-representable: with 1e-15, 1-eps rounds to 1.0 and
-    # the (1-y)*log1p(-1) term produces 0*inf = NaN
     y = jnp.asarray(y_true).astype(jnp.float32).ravel()
-    p = jnp.clip(jnp.asarray(p).astype(jnp.float32).ravel(), eps, 1 - eps)
+    p = jnp.asarray(p).astype(jnp.float32).ravel()
     if w is None:
-        return float(-jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log1p(-p)))
-    wt = jnp.asarray(w).astype(jnp.float32).ravel()
+        return float(_logloss_unw(y, p, eps))
+    return float(_logloss_w(y, p, jnp.asarray(w).astype(
+        jnp.float32).ravel(), eps))
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _logloss_unw(y, p, eps):
+    # eps must stay f32-representable: with 1e-15, 1-eps rounds to 1.0
+    # and the (1-y)*log1p(-1) term produces 0*inf = NaN
+    p = jnp.clip(p, eps, 1 - eps)
+    return -jnp.mean(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _logloss_w(y, p, wt, eps):
+    p = jnp.clip(p, eps, 1 - eps)
     bad = jnp.any((wt > 0) & jnp.isnan(y))     # NaN on live rows surfaces
     y = jnp.where(wt > 0, jnp.nan_to_num(y), 0.0)
     ll = y * jnp.log(p) + (1 - y) * jnp.log1p(-p)
     out = -jnp.sum(wt * jnp.where(wt > 0, ll, 0.0)) / jnp.sum(wt)
-    return float(jnp.where(bad, jnp.nan, out))
+    return jnp.where(bad, jnp.nan, out)
 
 
 def multinomial_logloss(y_true, probs, eps: float = 1e-7, w=None) -> float:
@@ -86,12 +100,22 @@ def rmse(y_true, pred, w=None) -> float:
     y = jnp.asarray(y_true).astype(jnp.float32).ravel()
     p = jnp.asarray(pred).astype(jnp.float32).ravel()
     if w is None:
-        return float(jnp.sqrt(jnp.mean((y - p) ** 2)))
-    wt = jnp.asarray(w).astype(jnp.float32).ravel()
+        return float(_rmse_unw(y, p))
+    return float(_rmse_w(y, p,
+                         jnp.asarray(w).astype(jnp.float32).ravel()))
+
+
+@jax.jit
+def _rmse_unw(y, p):
+    return jnp.sqrt(jnp.mean((y - p) ** 2))
+
+
+@jax.jit
+def _rmse_w(y, p, wt):
     bad = jnp.any((wt > 0) & jnp.isnan(y - p))
     se = jnp.where(wt > 0, jnp.nan_to_num(y - p) ** 2, 0.0)
     out = jnp.sqrt(jnp.sum(wt * se) / jnp.sum(wt))
-    return float(jnp.where(bad, jnp.nan, out))
+    return jnp.where(bad, jnp.nan, out)
 
 
 def mae(y_true, pred) -> float:

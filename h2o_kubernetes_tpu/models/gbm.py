@@ -61,6 +61,14 @@ class GBMParams:
     _drf_mode: bool = False
 
 
+# module-level jitted transforms: a fresh jax.jit per call would
+# retrace every scoring event (the jit-inside-a-loop antipattern), and
+# an eager sharded op risks the XLA:CPU rendezvous flake
+_jit_sigmoid = jax.jit(jax.nn.sigmoid)
+_jit_softmax = jax.jit(functools.partial(jax.nn.softmax, axis=1))
+_jit_exp = jax.jit(jnp.exp)
+
+
 def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
     """Training metrics from the CURRENT boosting margin (no re-predict).
 
@@ -71,22 +79,23 @@ def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
     from .. import metrics as M
 
     if dist == "bernoulli":
-        p1 = jax.nn.sigmoid(margin)
+        p1 = _jit_sigmoid(margin)
         return {"train_logloss": M.logloss(y, p1, w=w),
                 "train_auc": M.roc_auc(y, p1, w=w)}
     if dist == "multinomial":
-        pr = jax.nn.softmax(margin, axis=1)
+        pr = _jit_softmax(margin)
         return {"train_logloss": M.multinomial_logloss(y, pr, w=w)}
     if dist in ("poisson", "gamma", "tweedie"):
-        return {"train_rmse": M.rmse(y, jnp.exp(margin), w=w)}
+        return {"train_rmse": M.rmse(y, _jit_exp(margin), w=w)}
     return {"train_rmse": M.rmse(y, margin, w=w)}
 
 
 def _tree_sampling(p: "GBMParams", key_t, w, F: int):
     """Row/column sampling for one boosting round → (key, w_t, col_mask).
 
-    Shared by GBM/DRF and the XGBoost rank loop so the sampling + key
-    derivation stays identical across estimators.
+    Used by the multinomial host loop; the fused GBM scan and the
+    XGBoost _rank_round implement the same scheme inside their jitted
+    bodies (keep the three in sync when changing sampling semantics).
     """
     kt, w_t, col_mask = key_t, w, None
     if p.sample_rate < 1.0:
@@ -126,7 +135,13 @@ class GBMModel(Model):
             self.trees = trees
             self.ntrees = int(trees.value.shape[0])
         else:
-            self.trees = jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+            # stack on HOST: an eager 90-operand jnp.stack on committed
+            # multi-device arrays is exactly the dispatch shape that
+            # trips XLA:CPU's flaky rendezvous (device_get transfers
+            # never do)
+            self.trees = jax.tree.map(
+                lambda *xs: jnp.asarray(
+                    np.stack([np.asarray(x) for x in xs])), *trees)
             self.ntrees = len(trees)
         self.init_score = init_score
         self.margin_scale = 1.0       # laplace robust scaling (train sets)
@@ -203,6 +218,16 @@ class GBM:
                 [self.cv_args.fold_column]
         data = resolve_xy(training_frame, y, x, ignored_columns,
                           weights_column, p.distribution)
+        if data.distribution in ("gamma", "tweedie", "poisson"):
+            ymin = float(jnp.nanmin(jnp.where(data.w > 0, data.y,
+                                              jnp.inf)))
+            if data.distribution == "gamma" and ymin <= 0:
+                raise ValueError(
+                    "gamma distribution needs a strictly positive "
+                    "response")
+            if ymin < 0:
+                raise ValueError(f"{data.distribution} distribution "
+                                 "needs a non-negative response")
         margin_scale = 1.0
         ckpt = p.checkpoint
         if ckpt is not None:
@@ -296,7 +321,14 @@ class GBM:
             yv = np.asarray(data.y)[np.asarray(data.w) > 0]
             init = float(np.median(yv)) if len(yv) else 0.0
             mad = float(np.median(np.abs(yv - init))) if len(yv) else 1.0
-            margin_scale = max(mad * 1.4826, 1e-8)
+            # MAD degenerates to 0 on zero-inflated data (>=50% of y at
+            # one value) — only then fall back to the non-robust std,
+            # otherwise keep the outlier-insensitive scale
+            if mad * 1.4826 > 1e-8:
+                margin_scale = mad * 1.4826
+            else:
+                std = float(np.std(yv)) if len(yv) else 1.0
+                margin_scale = max(std, 1e-8)
             import dataclasses
 
             data = dataclasses.replace(
@@ -430,6 +462,7 @@ def _stacked_varimp(trees: Tree, names: list[str]) -> dict[str, float]:
     """Varimp from a stacked [T, N] Tree pytree in ONE host transfer —
     a per-tree np.asarray would force a device sync every boosting
     iteration, which dominates wall-clock when the chip sits behind a
-    network tunnel. tree.map keeps field association by name."""
-    flat = jax.tree.map(jnp.ravel, trees)
+    network tunnel. The ravel happens host-side (np) — an eager jnp op
+    on the committed tree arrays is a multi-device dispatch."""
+    flat = Tree(*(np.asarray(x).ravel() for x in trees))
     return dict(zip(names, _gain_by_feat(flat, len(names))))

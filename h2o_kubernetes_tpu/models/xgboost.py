@@ -34,10 +34,9 @@ from jax import lax
 from .. import metrics as M
 from ..frame import Frame
 from .base import resolve_xy
-from .gbm import (GBM, GBMModel, _predict_jit, _stacked_varimp,
-                  _tree_sampling)
+from .gbm import GBM, GBMModel, _stacked_varimp
 from .tree.binning import apply_bins, fit_bins
-from .tree.core import TreeParams, grow_tree
+from .tree.core import TreeParams
 
 _OBJECTIVE_ALIASES = {
     "reg:squarederror": "gaussian",
@@ -99,6 +98,13 @@ class _GroupLayout:
         self.mask = jnp.asarray(idx >= 0)    # [G, M]
 
 
+def _dense_layout(y, idx, mask):
+    """Row-sharded y → [G, M] dense group layout + ideal DCG, in one
+    compiled program (no eager sharded gathers on the hot setup path)."""
+    y_dense = jnp.where(mask, y[jnp.maximum(idx, 0)], 0.0)
+    return y_dense, _ideal_dcg(y_dense, mask)
+
+
 def _ideal_dcg(y_dense: jax.Array, mask: jax.Array) -> jax.Array:
     """Max DCG per group over the full list (LambdaMART normalizer)."""
     gains = jnp.where(mask, 2.0 ** y_dense - 1.0, 0.0)
@@ -135,6 +141,38 @@ def _lambda_grads_batch(f, y, mask, maxdcg, use_ndcg: bool):
     g = -jnp.sum(A, axis=2) + jnp.sum(A, axis=1)
     h = jnp.sum(Hh, axis=2) + jnp.sum(Hh, axis=1)
     return g, h
+
+
+@functools.partial(jax.jit, static_argnums=(9, 10, 11, 12, 13, 14, 15))
+def _rank_round(binned, margin, y_dense, maxdcg, idx, pos, mask, w, key,
+                tp: TreeParams, use_ndcg: bool, batch: int, lr: float,
+                sample_rate: float, col_rate: float, mesh=None):
+    """ONE compiled program per boosting round: lambda gradients → row
+    sampling → tree growth → margin update.
+
+    The round-1/round-2 suite hangs (and the SIGABRTs before the
+    rendezvous timeout was raised) were all in EAGER multi-device
+    dispatch inside this loop — an eager op on sharded arrays
+    occasionally deadlocks XLA:CPU's collective rendezvous. Keeping the
+    whole round inside one jit removes every eager sharded dispatch
+    from the hot path (the fused GBM loop got the same treatment via
+    boost_trees)."""
+    from .tree.core import _grow_tree_jit, predict_tree
+
+    g, h = _lambda_grads(margin, idx, pos, mask, use_ndcg, batch,
+                         y_dense=y_dense, maxdcg=maxdcg)
+    k_row, k_col, k_tree = jax.random.split(key, 3)
+    w_t = w
+    if sample_rate < 1.0:
+        w_t = w * (jax.random.uniform(k_row, w.shape) < sample_rate)
+    F = binned.shape[1]
+    col_mask = jnp.ones(F, dtype=bool)
+    if col_rate < 1.0:
+        col_mask = jax.random.uniform(k_col, (F,)) < col_rate
+    tree = _grow_tree_jit(binned, g, h, w_t, col_mask, k_tree, tp, mesh)
+    tree = tree._replace(value=lr * tree.value)
+    margin = margin + predict_tree(tree, binned, tp.max_depth, tp.n_bins)
+    return margin, tree
 
 
 @functools.partial(jax.jit, static_argnums=(4, 5))
@@ -266,9 +304,8 @@ class XGBoost(GBM):
         binned = jax.jit(apply_bins, static_argnums=3)(
             data.X, edges, enum_mask, bin_spec.na_bin)
 
-        y_dense = jnp.where(layout.mask,
-                            data.y[jnp.maximum(layout.idx, 0)], 0.0)
-        maxdcg = _ideal_dcg(y_dense, layout.mask)
+        y_dense, maxdcg = jax.jit(_dense_layout)(data.y, layout.idx,
+                                                 layout.mask)
 
         tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,
                         min_rows=p.min_rows, reg_lambda=p.reg_lambda,
@@ -280,16 +317,16 @@ class XGBoost(GBM):
         margin = jnp.zeros_like(data.y)
         trees, history = [], []
         batch = min(self._ndcg_group_batch, layout.n_groups)
+        from ..runtime.mesh import global_mesh
+
+        mesh = global_mesh()
         for t in range(p.ntrees):
             key, kt = jax.random.split(key)
-            g, h = _lambda_grads(margin, layout.idx, layout.pos,
-                                 layout.mask, use_ndcg, batch,
-                                 y_dense=y_dense, maxdcg=maxdcg)
-            kt, w_t, col_mask = _tree_sampling(p, kt, data.w, F)
-            tree = grow_tree(binned, g, h, w_t, tp, col_mask, kt)
-            tree = tree._replace(value=p.learn_rate * tree.value)
-            margin = margin + _predict_jit(tree, binned, tp.max_depth,
-                                           tp.n_bins)
+            margin, tree = _rank_round(
+                binned, margin, y_dense, maxdcg, layout.idx, layout.pos,
+                layout.mask, data.w, kt, tp, use_ndcg, batch,
+                p.learn_rate, p.sample_rate, p.col_sample_rate_per_tree,
+                mesh)
             trees.append(tree)
             if p.score_every and (t + 1) % p.score_every == 0:
                 sc = np.asarray(margin)[: frame.nrows]

@@ -3,8 +3,10 @@
 Reference: water/persist/* (SURVEY.md §2b C20) provides binary model
 save/load and frame export over pluggable backends (local/S3/HDFS/GCS);
 h2o.save_model / h2o.load_model / h2o.export_file are the client verbs
-(h2o-py). This build implements the local backend; remote schemes can
-register via PERSIST_SCHEMES (the reference's PersistManager registry).
+(h2o-py). Built-in backends: local FS, mem:// (in-process object
+store), read-only http(s)://; S3/GCS/HDFS register the same way via
+PERSIST_SCHEMES (the reference's PersistManager registry) when their
+client libraries are present.
 
 Device arrays are converted to host numpy on save (a model file is
 readable on any backend — the reference's binary models are likewise

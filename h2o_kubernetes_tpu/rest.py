@@ -136,7 +136,11 @@ class _Handler(BaseHTTPRequestHandler):
 
                 src = params.get("path") or params.get("source_frames")
                 if isinstance(src, (list, tuple)):
-                    src = src[0] if src else None     # h2o-py list form
+                    if len(src) != 1:   # refuse, don't silently truncate
+                        return self._error(
+                            400, "multi-file Parse is not supported over "
+                            "REST; pass one path (globs allowed)")
+                    src = src[0]
                 if not src or not isinstance(src, str):
                     return self._error(400, "missing 'path'")
                 key = params.get("destination_frame") or \

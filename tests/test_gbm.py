@@ -237,3 +237,26 @@ def test_gbm_laplace_large_scale_response(mesh8):
     pred = np.asarray(m.predict_raw(fr))[:n]
     assert float(np.mean(np.abs(pred - y))) < 150.0
     assert pred.std() > 500.0             # predictions span the range
+
+
+def test_gbm_gamma_rejects_nonpositive(mesh8):
+    fr = Frame.from_arrays({"x": np.arange(10.0),
+                            "y": np.arange(10.0) - 5.0})
+    with pytest.raises(ValueError, match="positive"):
+        GBM(distribution="gamma").train(y="y", training_frame=fr)
+
+
+def test_gbm_laplace_zero_inflated_mad(mesh8):
+    # 70% of y at exactly 0 → MAD = 0; the scale must fall back to std
+    # instead of collapsing to 1e-8 (which froze predictions at 0)
+    rng = np.random.default_rng(35)
+    n = 2000
+    y = np.where(rng.random(n) < 0.7, 0.0, rng.uniform(100, 1000, n))
+    x = y + rng.normal(scale=20.0, size=n)
+    fr = Frame.from_arrays({"x": x.astype(np.float32),
+                            "y": y.astype(np.float32)})
+    m = GBM(ntrees=30, max_depth=3, learn_rate=0.3,
+            distribution="laplace", seed=1).train(
+        y="y", training_frame=fr)
+    pred = np.asarray(m.predict_raw(fr))[:n]
+    assert pred.std() > 50.0
