@@ -9,6 +9,7 @@ See SURVEY.md for the reference blueprint this is built against.
 """
 
 from .automl import AutoML, Job, Leaderboard, jobs
+from .grid import GridSearch, H2OGridSearch
 from .diagnostics import device_memory, log, profile, timeline
 from .frame import Frame, Vec, import_file, parse_setup
 from .mojo import MojoModel, export_mojo, import_mojo

@@ -30,7 +30,7 @@ from jax import lax
 from ..frame import Frame
 from ..runtime.mesh import global_mesh
 from .base import Model, TrainData, resolve_xy
-from .tree.binning import BinSpec, apply_bins, fit_bins
+from .tree.binning import BinSpec, apply_bins, apply_bins_jit, fit_bins
 from .tree.core import (BoostParams, Tree, TreeParams, _grad_hess,
                         boost_trees, grow_tree, predict_tree)
 
@@ -67,6 +67,8 @@ class GBMParams:
 _jit_sigmoid = jax.jit(jax.nn.sigmoid)
 _jit_softmax = jax.jit(functools.partial(jax.nn.softmax, axis=1))
 _jit_exp = jax.jit(jnp.exp)
+_jit_min_pos = jax.jit(
+    lambda y, w: jnp.nanmin(jnp.where(w > 0, y, jnp.inf)))
 
 
 def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
@@ -219,8 +221,7 @@ class GBM:
         data = resolve_xy(training_frame, y, x, ignored_columns,
                           weights_column, p.distribution)
         if data.distribution in ("gamma", "tweedie", "poisson"):
-            ymin = float(jnp.nanmin(jnp.where(data.w > 0, data.y,
-                                              jnp.inf)))
+            ymin = float(_jit_min_pos(data.y, data.w))
             if data.distribution == "gamma" and ymin <= 0:
                 raise ValueError(
                     "gamma distribution needs a strictly positive "
@@ -260,8 +261,7 @@ class GBM:
                                 n_bins=p.nbins)
         edges = jnp.asarray(bin_spec.edges_matrix())
         enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
-        binned = jax.jit(apply_bins, static_argnums=3)(
-            data.X, edges, enum_mask, bin_spec.na_bin)
+        binned = apply_bins_jit(data.X, edges, enum_mask, bin_spec.na_bin)
 
         K = data.nclasses if data.nclasses > 2 else 1
         tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,

@@ -116,3 +116,9 @@ def apply_bins(X: jax.Array, edges_matrix: jax.Array, enum_mask: jax.Array,
     binned = jax.vmap(bin_feature, in_axes=(1, 0, 0), out_axes=1)(
         X, edges_matrix, enum_mask)
     return binned.astype(jnp.uint8)
+
+
+# module-level jitted form: a fresh jax.jit per train() call would
+# retrace the binning program on every model fit (grid search / AutoML
+# build many models per process)
+apply_bins_jit = jax.jit(apply_bins, static_argnums=3)

@@ -35,7 +35,7 @@ from .. import metrics as M
 from ..frame import Frame
 from .base import resolve_xy
 from .gbm import GBM, GBMModel, _stacked_varimp
-from .tree.binning import apply_bins, fit_bins
+from .tree.binning import apply_bins, apply_bins_jit, fit_bins
 from .tree.core import TreeParams
 
 _OBJECTIVE_ALIASES = {
@@ -103,6 +103,9 @@ def _dense_layout(y, idx, mask):
     compiled program (no eager sharded gathers on the hot setup path)."""
     y_dense = jnp.where(mask, y[jnp.maximum(idx, 0)], 0.0)
     return y_dense, _ideal_dcg(y_dense, mask)
+
+
+_dense_layout_jit = jax.jit(_dense_layout)
 
 
 def _ideal_dcg(y_dense: jax.Array, mask: jax.Array) -> jax.Array:
@@ -301,11 +304,10 @@ class XGBoost(GBM):
         bin_spec = fit_bins(frame, data.feature_names, n_bins=p.nbins)
         edges = jnp.asarray(bin_spec.edges_matrix())
         enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
-        binned = jax.jit(apply_bins, static_argnums=3)(
-            data.X, edges, enum_mask, bin_spec.na_bin)
+        binned = apply_bins_jit(data.X, edges, enum_mask, bin_spec.na_bin)
 
-        y_dense, maxdcg = jax.jit(_dense_layout)(data.y, layout.idx,
-                                                 layout.mask)
+        y_dense, maxdcg = _dense_layout_jit(data.y, layout.idx,
+                                            layout.mask)
 
         tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,
                         min_rows=p.min_rows, reg_lambda=p.reg_lambda,

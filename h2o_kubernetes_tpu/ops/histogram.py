@@ -64,6 +64,98 @@ def _bin_block(n_nodes: int, n_bins: int) -> int:
     return k * n_bins
 
 
+def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
+                      n_hi):
+    """Factorized one-hot histogram matmul (the fast path).
+
+    seg = rel·B + bin is split as seg = hi·128 + lo.  The LHS packs the
+    three weighted value channels against the hi one-hot —
+    A[c·n_hi + hi, t] = v_c[t]·1[hi_t = hi] — and the RHS is the exact
+    lo one-hot [T, 128], so hist[c, seg] = (A @ B)[c·n_hi + hi, lo].
+    Against the bin-blocked kernel below this turns the MXU shape from
+    [3, T]x[T, ≤2048] (3/128 row occupancy, ≤16 lane passes) into
+    [3·n_hi, T]x[T, 128] (full rows for n_hi ≥ 43, ONE lane pass).  A is
+    split into three bf16 terms (hi/mid/lo mantissa) so the f32 products
+    match the segment path to ~2^-24; B is 0/1 and thus exact in bf16.
+    """
+    rt = pl.program_id(1)
+
+    @pl.when(rt == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    bins = binned_ref[:]                             # [T]
+    rel = rel_ref[:]                                 # [T]
+    seg = rel * n_bins + bins
+    hi = lax.shift_right_arithmetic(seg, 7)          # floor(seg/128)
+    lo = seg - hi * 128                              # seg mod 128, >= 0
+    T = bins.shape[0]
+    # hi one-hot, transposed: [n_hi, T].  Dead rows (rel=-1) have hi < 0
+    # and match no slot; their vals are zeroed upstream anyway.
+    iota_hi = lax.broadcasted_iota(jnp.int32, (n_hi, T), 0)
+    oh_hi = (iota_hi == hi[None, :]).astype(jnp.float32)
+    vals_t = vals_ref[:].T                           # [3, T]
+    A = jnp.concatenate([oh_hi * vals_t[c][None, :] for c in range(3)],
+                        axis=0)                      # [3*n_hi, T]
+    iota_lo = lax.broadcasted_iota(jnp.int32, (T, 128), 1)
+    B = (iota_lo == lo[:, None]).astype(jnp.bfloat16)
+
+    a1 = A.astype(jnp.bfloat16)
+    r1 = A - a1.astype(jnp.float32)
+    a2 = r1.astype(jnp.bfloat16)
+    a3 = (r1 - a2.astype(jnp.float32)).astype(jnp.bfloat16)
+    dn = (((1,), (0,)), ((), ()))
+
+    def dg(a):
+        return lax.dot_general(a, B, dimension_numbers=dn,
+                               preferred_element_type=jnp.float32)
+
+    out_ref[0] += dg(a1) + dg(a2) + dg(a3)           # [3*n_hi, 128]
+
+
+# VMEM cap for the factorized kernel's working set: A f32 [3*n_hi, T]
+# plus its three bf16 split terms and the hi one-hot is ~22 B per A
+# element — n_hi=256 is ~9 MB, safely inside v5e VMEM alongside the
+# [3*n_hi, 128] accumulator. Deeper trees (n_nodes*n_bins > 2^15) take
+# the bin-blocked kernel below.
+_FACT_MAX_NHI = 256
+
+
+def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int):
+    r, F = binned.shape
+    nB = n_nodes * n_bins
+    n_hi = -(-nB // 128)                             # ceil
+    pad = (-r) % ROW_TILE
+    if pad:
+        binned = jnp.pad(binned, ((0, pad), (0, 0)))
+        rel = jnp.pad(rel, (0, pad), constant_values=-1)
+        vals = jnp.pad(vals, ((0, pad), (0, 0)))
+    rp = r + pad
+    binned_flat = binned.T.astype(jnp.int32).reshape(F * rp)
+    rel32 = rel.astype(jnp.int32)
+    rblocks = rp // ROW_TILE
+
+    grid = (F, rblocks)
+    vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
+    out = pl.pallas_call(
+        functools.partial(_hist_fact_kernel, n_bins=n_bins, n_hi=n_hi),
+        out_shape=jax.ShapeDtypeStruct((F, 3 * n_hi, 128), jnp.float32,
+                                       vma=vma),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_TILE,),
+                         lambda f, rt, rb=rblocks: (f * rb + rt,)),
+            pl.BlockSpec((ROW_TILE,), lambda f, rt: (rt,)),
+            pl.BlockSpec((ROW_TILE, 3), lambda f, rt: (rt, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 3 * n_hi, 128), lambda f, rt: (f, 0, 0)),
+        interpret=jax.default_backend() != "tpu",
+    )(binned_flat, rel32, vals)
+    # [F, 3*n_hi, 128] -> [F, 3, n_hi*128] -> [n, F, B, 3]
+    out = out.reshape(F, 3, n_hi * 128)[:, :, :nB]
+    return out.reshape(F, 3, n_nodes, n_bins).transpose(2, 0, 3, 1)
+
+
 def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
     nb = pl.program_id(1)
     rt = pl.program_id(2)
@@ -92,6 +184,8 @@ def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
 def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
     r, F = binned.shape
     nB = n_nodes * n_bins
+    if -(-nB // 128) <= _FACT_MAX_NHI:
+        return _hist_pallas_fact(binned, rel, vals, n_nodes, n_bins)
     nbt = _bin_block(n_nodes, n_bins)
     if nbt % 128 and nbt != nB:
         # un-tileable bin block (non-power-of-2 n_bins hitting the lane

@@ -136,6 +136,8 @@ class _Handler(BaseHTTPRequestHandler):
 
                 src = params.get("path") or params.get("source_frames")
                 if isinstance(src, (list, tuple)):
+                    if not src:
+                        return self._error(400, "missing 'path'")
                     if len(src) != 1:   # refuse, don't silently truncate
                         return self._error(
                             400, "multi-file Parse is not supported over "

@@ -1,0 +1,124 @@
+"""GridSearch (H2OGridSearch analog) tests — SURVEY.md §2b C16/C19."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import GridSearch
+from h2o_kubernetes_tpu.models import GBM, GLM
+
+
+@pytest.fixture(scope="module")
+def binom_frame():
+    rng = np.random.default_rng(7)
+    n = 600
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    logit = 1.5 * x0 - x1 + rng.normal(scale=0.3, size=n)
+    return h2o.Frame.from_arrays({
+        "x0": x0, "x1": x1,
+        "y": np.where(logit > 0, "yes", "no")})
+
+
+def test_cartesian_walks_full_product(binom_frame):
+    grid = GridSearch(GBM, {"ntrees": [3, 5], "max_depth": [2, 3]})
+    grid.train(y="y", training_frame=binom_frame)
+    assert len(grid.model_ids) == 4
+    # every hyper combo appears exactly once
+    combos = {(m.grid_params["ntrees"], m.grid_params["max_depth"])
+              for m in grid.models}
+    assert combos == {(3, 2), (3, 3), (5, 2), (5, 3)}
+
+
+def test_models_ranked_by_metric(binom_frame):
+    grid = GridSearch(GBM, {"ntrees": [2, 10]})
+    grid.train(y="y", training_frame=binom_frame)
+    rows = grid.get_grid()
+    assert grid.sort_metric == "auc"
+    aucs = [r["auc"] for r in rows]
+    assert aucs == sorted(aucs, reverse=True)
+    assert grid.leader is grid.models[0]
+
+
+def test_random_discrete_respects_max_models(binom_frame):
+    grid = GridSearch(
+        GBM, {"ntrees": [2, 3, 4], "max_depth": [2, 3], "learn_rate":
+              [0.1, 0.3]},
+        search_criteria={"strategy": "RandomDiscrete", "max_models": 3,
+                         "seed": 42})
+    grid.train(y="y", training_frame=binom_frame)
+    assert len(grid.model_ids) == 3
+    # draws are distinct
+    seen = [tuple(sorted(m.grid_params.items())) for m in grid.models]
+    assert len(set(seen)) == 3
+
+
+def test_random_discrete_deterministic_seed(binom_frame):
+    def run():
+        g = GridSearch(GBM, {"ntrees": [2, 3, 4, 5]},
+                       search_criteria={"strategy": "RandomDiscrete",
+                                        "max_models": 2, "seed": 9})
+        g.train(y="y", training_frame=binom_frame)
+        return sorted(m.grid_params["ntrees"] for m in g.models)
+
+    assert run() == run()
+
+
+def test_base_params_from_instance(binom_frame):
+    base = GBM(learn_rate=0.4, seed=5)
+    grid = GridSearch(base, {"ntrees": [2, 3]})
+    grid.train(y="y", training_frame=binom_frame)
+    assert all(m.params.learn_rate == 0.4 for m in grid.models)
+    assert all(m.params.seed == 5 for m in grid.models)
+
+
+def test_failed_combo_recorded_not_fatal(binom_frame):
+    grid = GridSearch(GBM, {"ntrees": [-1, 3]})   # -1 invalid
+    grid.train(y="y", training_frame=binom_frame)
+    assert len(grid.model_ids) == 1
+    assert len(grid.failed_params) == 1
+    assert grid.failed_params[0]["ntrees"] == -1
+
+
+def test_grid_with_validation_frame_and_glm(binom_frame):
+    rng = np.random.default_rng(11)
+    n = 300
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    logit = 1.5 * x0 - x1
+    valid = h2o.Frame.from_arrays({
+        "x0": x0, "x1": x1,
+        "y": np.where(logit > 0, "yes", "no")})
+    grid = GridSearch(GLM(family="binomial"), {"alpha": [0.0, 0.5]},
+                      search_criteria={"strategy": "Cartesian"})
+    grid.train(y="y", training_frame=binom_frame,
+               validation_frame=valid)
+    assert len(grid.model_ids) == 2
+    assert all("auc" in r for r in grid.get_grid())
+
+
+def test_get_grid_sort_by_override(binom_frame):
+    grid = GridSearch(GBM, {"ntrees": [2, 8]})
+    grid.train(y="y", training_frame=binom_frame)
+    rows = grid.get_grid(sort_by="logloss")
+    lls = [r["logloss"] for r in rows]
+    assert lls == sorted(lls)
+
+
+def test_unknown_strategy_rejected():
+    with pytest.raises(ValueError, match="strategy"):
+        GridSearch(GBM, {"ntrees": [1]},
+                   search_criteria={"strategy": "Bayesian"})
+
+
+def test_empty_hyper_params_rejected():
+    with pytest.raises(ValueError, match="hyper_params"):
+        GridSearch(GBM, {})
+
+
+def test_grid_registers_job(binom_frame):
+    from h2o_kubernetes_tpu.automl import JOBS
+
+    grid = GridSearch(GBM, {"ntrees": [2]}, grid_id="grid_job_test")
+    grid.train(y="y", training_frame=binom_frame)
+    assert JOBS["grid_job_test"].status == "DONE"

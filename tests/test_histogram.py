@@ -41,6 +41,37 @@ def test_pallas_matches_segment(r, F, n_nodes, n_bins):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_blocked_kernel_matches_segment(monkeypatch):
+    """The bin-blocked fallback kernel (taken when the factorized A
+    operand would blow VMEM) stays parity-tested even though small
+    trees now route to the factorized path."""
+    import h2o_kubernetes_tpu.ops.histogram as H
+
+    monkeypatch.setattr(H, "_FACT_MAX_NHI", 0)   # force the fallback
+    binned, rel, g, h, w = _random_case(1000, 3, 4, 64, seed=5)
+    ref = build_histogram(binned, rel, g, h, w, 4, 64, impl="segment")
+    got = build_histogram(binned, rel, g, h, w, 4, 64, impl="pallas")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_factorized_vs_blocked_agree(monkeypatch):
+    """The two Pallas formulations agree on a shape the blocked kernel
+    actually tiles (n_nodes*n_bins = 2048 = one full bin block)."""
+    import h2o_kubernetes_tpu.ops.histogram as H
+
+    binned, rel, g, h, w = _random_case(777, 2, 16, 128, seed=9)
+    live = (np.asarray(rel) >= 0) & (np.asarray(w) > 0)
+    vals = jnp.where(jnp.asarray(live)[:, None],
+                     jnp.stack([g * w, h * w, w], axis=1), 0.0)
+    rel_live = jnp.where(jnp.asarray(live), rel, -1)
+    fact = H._hist_pallas_fact(binned, rel_live, vals, 16, 128)
+    monkeypatch.setattr(H, "_FACT_MAX_NHI", 0)
+    blocked = H._hist_pallas(binned, rel_live, vals, 16, 128)
+    np.testing.assert_allclose(np.asarray(fact), np.asarray(blocked),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_totals_preserved():
     binned, rel, g, h, w = _random_case(700, 3, 8, 32, seed=1)
     hist = build_histogram(binned, rel, g, h, w, 8, 32, impl="pallas")
