@@ -49,11 +49,19 @@ class GridSearch:
         else:
             self.model_cls = type(model)
             # reconstruct constructor kwargs from the instance's params
-            # dataclass (estimators store them on .params)
+            # dataclass (estimators store them on .params) AND its CV
+            # settings (popped into .cv_args at construction — dropping
+            # them would silently train grid models without the
+            # requested cross-validation)
             p = getattr(model, "params", None)
             self.base_params = {
                 k: v for k, v in vars(p).items()
                 if not k.startswith("_")} if p is not None else {}
+            cv = getattr(model, "cv_args", None)
+            if cv is not None:
+                self.base_params.update(
+                    {k: v for k, v in vars(cv).items()
+                     if not k.startswith("_")})
         self.hyper_params = {k: list(v) for k, v in hyper_params.items()}
         crit = dict(search_criteria or {})
         self.strategy = crit.pop("strategy", "Cartesian")
@@ -117,44 +125,51 @@ class GridSearch:
         self.leaderboard = Leaderboard(metric, asc)
         self.job = Job(dest=self.grid_id,
                        description=f"grid {self.model_cls.__name__}")
-        self.job.start()
-        from .automl import JOBS
-
-        JOBS[self.grid_id] = self.job
+        self.job.start()           # registers itself in automl.JOBS
 
         combos = self._cartesian() if self.strategy == "Cartesian" \
             else self._random()
-        n = 0
-        for hp in combos:
-            if self.max_models and n >= self.max_models:
-                break
-            if deadline is not None and time.monotonic() > deadline:
-                break
-            params = {**self.base_params, **hp}
-            model_id = f"{self.grid_id}_model_{n + 1}"
-            call_kw = dict(train_kw)
-            if x is not None:
-                call_kw["x"] = x
-            if validation_frame is not None:
-                call_kw["validation_frame"] = validation_frame
-            try:
-                est = self.model_cls(**params)
-                model = est.train(y=y, training_frame=training_frame,
-                                  **call_kw)
-            except Exception as e:  # noqa: BLE001 - grid keeps going
-                self.failed_params.append({**hp, "error": repr(e)})
-                n += 1
-                continue
-            if validation_frame is not None:
-                metrics = model.model_performance(validation_frame, y)
-            elif getattr(model, "cv", None) is not None:
-                metrics = model.cv.metrics
-            else:
-                metrics = model.model_performance(training_frame, y)
-            model.grid_params = dict(hp)
-            self.leaderboard.add(model_id, model, metrics)
-            n += 1
-            self.job.update(min(0.99, n / max(self.max_models or 20, 1)))
+        built = attempt = 0
+        try:
+            for hp in combos:
+                # H2O's max_models bounds BUILT models, not attempts —
+                # a failed combo doesn't eat the budget (generators are
+                # finite, so all-failing grids still terminate)
+                if self.max_models and built >= self.max_models:
+                    break
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                attempt += 1
+                params = {**self.base_params, **hp}
+                model_id = f"{self.grid_id}_model_{attempt}"
+                call_kw = dict(train_kw)
+                if x is not None:
+                    call_kw["x"] = x
+                if validation_frame is not None:
+                    call_kw["validation_frame"] = validation_frame
+                try:
+                    est = self.model_cls(**params)
+                    model = est.train(y=y, training_frame=training_frame,
+                                      **call_kw)
+                    if validation_frame is not None:
+                        metrics = model.model_performance(
+                            validation_frame, y)
+                    elif getattr(model, "cv", None) is not None:
+                        metrics = model.cv.metrics
+                    else:
+                        metrics = model.model_performance(
+                            training_frame, y)
+                except Exception as e:  # noqa: BLE001 - grid keeps going
+                    self.failed_params.append({**hp, "error": repr(e)})
+                    continue
+                model.grid_params = dict(hp)
+                self.leaderboard.add(model_id, model, metrics)
+                built += 1
+                self.job.update(
+                    min(0.99, built / max(self.max_models or 20, 1)))
+        except BaseException as e:
+            self.job.failed(repr(e))
+            raise
         # expose models sorted by the grid metric (H2O sorts get_grid
         # output; .models follows the sorted order for convenience)
         rows = self.leaderboard.as_list()

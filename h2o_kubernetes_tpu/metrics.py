@@ -16,8 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def roc_auc(y_true, score, w=None) -> float:
-    """Exact AUC with average-rank tie handling (Mann-Whitney U).
+_AUC_BINS = 4096        # reference AUC2 uses 400 bins; 4096 is ~free here
+_AUC_EXACT_MAX = 65536  # above this, the histogram path takes over
+
+
+def roc_auc(y_true, score, w=None, exact: bool | None = None) -> float:
+    """AUC with average-rank tie handling (Mann-Whitney U).
+
+    Two paths, both jitted:
+    - exact: full sort — O(n log n), used for n <= 65536 (or exact=True);
+    - histogram: scores binned into 4096 equal-width bins, in-bin pairs
+      tied at 0.5 — the reference's own design (hex/AUC2 computes AUC
+      from a 400-bin score histogram [U3]), error bounded by in-bin pair
+      mass (~1e-4 here). The binning rides ops/histogram's MXU kernel,
+      replacing a ~0.5 s 1M-row device sort with one histogram pass.
 
     Optionally weighted; rows with w == 0 (e.g. shard padding) are
     excluded entirely, so callers can pass padded device arrays without
@@ -27,7 +39,42 @@ def roc_auc(y_true, score, w=None) -> float:
     s = jnp.asarray(score).astype(jnp.float32).ravel()
     wt = jnp.ones_like(y) if w is None else \
         jnp.asarray(w).astype(jnp.float32).ravel()
-    return float(_auc_impl(y, s, wt))
+    if exact is None:
+        exact = y.shape[0] <= _AUC_EXACT_MAX
+    if exact:
+        return float(_auc_impl(y, s, wt))
+    return float(_auc_hist_impl(y, s, wt))
+
+
+@jax.jit
+def _auc_hist_impl(y, s, wt):
+    from .ops.histogram import build_histogram
+
+    live = wt > 0
+    bad = jnp.any(live & (jnp.isnan(y) | jnp.isnan(s)))
+    y = jnp.where(live, jnp.nan_to_num(y), 0.0)
+    # NaN→0 only (nan_to_num would also finitize ±inf and defeat the
+    # pinning below); ±inf live scores (diverged model) must not set
+    # the bin scale — they'd collapse every finite score into bin 0;
+    # bin the finite range and pin infinities to the end bins (= the
+    # exact-path rank)
+    sx = jnp.where(live & ~jnp.isnan(s), s, 0.0)
+    fin = live & jnp.isfinite(sx)
+    smin = jnp.min(jnp.where(fin, sx, jnp.inf))
+    smax = jnp.max(jnp.where(fin, sx, -jnp.inf))
+    scale = (_AUC_BINS - 1) / jnp.maximum(smax - smin, 1e-30)
+    idx = jnp.clip((sx - smin) * scale, 0, _AUC_BINS - 1).astype(jnp.int32)
+    idx = jnp.where(sx == jnp.inf, _AUC_BINS - 1, idx)
+    idx = jnp.where(sx == -jnp.inf, 0, idx)
+    rel = jnp.where(live, 0, -1).astype(jnp.int32)
+    # per-bin (Σ y·w, Σ (1-y)·w, Σ w) in one kernel pass
+    hist = build_histogram(idx[:, None], rel, y, 1.0 - y, wt,
+                           1, _AUC_BINS)[0, 0]
+    posb, negb = hist[:, 0], hist[:, 1]
+    below = jnp.cumsum(negb) - negb
+    P, N = jnp.sum(posb), jnp.sum(negb)
+    auc = jnp.sum(posb * (below + 0.5 * negb)) / (P * N)
+    return jnp.where(bad, jnp.nan, auc)
 
 
 @jax.jit

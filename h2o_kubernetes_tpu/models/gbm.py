@@ -69,6 +69,15 @@ _jit_softmax = jax.jit(functools.partial(jax.nn.softmax, axis=1))
 _jit_exp = jax.jit(jnp.exp)
 _jit_min_pos = jax.jit(
     lambda y, w: jnp.nanmin(jnp.where(w > 0, y, jnp.inf)))
+# one dispatch + one transfer for the init-prior scalars (w·y sum and
+# w sum) — separate float() syncs each pay a full tunnel round trip
+_jit_init_sums = jax.jit(
+    lambda y, w: (jnp.sum(w), jnp.sum(y * w)))
+_jit_class_sums = jax.jit(
+    lambda y, w, K: jax.ops.segment_sum(
+        w, jnp.where(w > 0, jnp.nan_to_num(y), K).astype(jnp.int32),
+        num_segments=K + 1)[:K],
+    static_argnums=2)
 
 
 def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
@@ -273,7 +282,8 @@ class GBM:
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 
-        w_sum = float(jnp.sum(data.w))
+        w_sum, yw_sum = (float(v) for v in
+                         jax.device_get(_jit_init_sums(data.y, data.w)))
         if ckpt is not None:
             if ckpt.params.nbins != p.nbins or \
                     ckpt.params.max_depth != p.max_depth:
@@ -299,19 +309,18 @@ class GBM:
             margin = jnp.zeros((data.y.shape[0], K)) if K > 1 \
                 else jnp.zeros_like(data.y)
         elif data.distribution == "bernoulli":
-            p1 = float(jnp.sum(data.y * data.w)) / w_sum
+            p1 = yw_sum / w_sum
             p1 = min(max(p1, 1e-6), 1 - 1e-6)
             init = np.log(p1 / (1 - p1))
             margin = jnp.full_like(data.y, init)
         elif data.distribution == "multinomial":
-            init = np.zeros(K, dtype=np.float32)
-            for k in range(K):
-                pk = float(jnp.sum((data.y == k) * data.w)) / w_sum
-                init[k] = np.log(max(pk, 1e-8))
+            cls_w = np.asarray(_jit_class_sums(data.y, data.w, K))
+            init = np.log(np.maximum(cls_w / w_sum, 1e-8)).astype(
+                np.float32)
             margin = jnp.broadcast_to(jnp.asarray(init)[None, :],
                                       (data.y.shape[0], K))
         elif data.distribution in ("poisson", "gamma", "tweedie"):
-            mu = float(jnp.sum(data.y * data.w)) / w_sum
+            mu = yw_sum / w_sum
             init = np.log(max(mu, 1e-8))
             margin = jnp.full_like(data.y, init)
         elif data.distribution == "laplace":
@@ -335,7 +344,7 @@ class GBM:
                 data, y=(data.y - init) / margin_scale)
             margin = jnp.zeros_like(data.y)
         else:
-            init = float(jnp.sum(data.y * data.w)) / w_sum
+            init = yw_sum / w_sum
             margin = jnp.full_like(data.y, init)
 
         if ckpt is not None and data.distribution == "laplace":

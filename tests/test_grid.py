@@ -122,3 +122,42 @@ def test_grid_registers_job(binom_frame):
     grid = GridSearch(GBM, {"ntrees": [2]}, grid_id="grid_job_test")
     grid.train(y="y", training_frame=binom_frame)
     assert JOBS["grid_job_test"].status == "DONE"
+
+
+def test_instance_cv_args_carried_into_grid(binom_frame):
+    grid = GridSearch(GBM(ntrees=3, nfolds=3), {"max_depth": [2, 3]})
+    grid.train(y="y", training_frame=binom_frame)
+    assert len(grid.models) == 2
+    # grid models must actually cross-validate (ranking uses CV metrics)
+    assert all(m.cv is not None for m in grid.models)
+
+
+def test_bad_response_column_recorded_not_fatal(binom_frame):
+    """A missing y fails every combo (inside the per-combo try), so the
+    grid finishes DONE with zero models and the errors recorded."""
+    from h2o_kubernetes_tpu.automl import JOBS
+
+    grid = GridSearch(GBM, {"ntrees": [2]}, grid_id="grid_bad_y_test")
+    grid.train(y="no_such_column", training_frame=binom_frame)
+    assert grid.model_ids == []
+    assert len(grid.failed_params) == 1
+    assert JOBS["grid_bad_y_test"].status == "DONE"
+
+
+def test_job_failed_on_grid_crash(binom_frame):
+    """A BaseException (user interrupt) escapes the per-combo guard and
+    must mark the Job FAILED instead of leaving it RUNNING forever."""
+    from h2o_kubernetes_tpu.automl import JOBS
+
+    class Interrupting:
+        def __init__(self, **kw):
+            pass
+
+        def train(self, **kw):
+            raise KeyboardInterrupt
+
+    grid = GridSearch(Interrupting, {"ntrees": [2]},
+                      grid_id="grid_crash_test")
+    with pytest.raises(KeyboardInterrupt):
+        grid.train(y="y", training_frame=binom_frame)
+    assert JOBS["grid_crash_test"].status == "FAILED"

@@ -99,3 +99,34 @@ def test_tree_with_pallas_impl(mesh8):
                 _hist_impl="pallas").train(y="y", training_frame=fr)
     np.testing.assert_allclose(m_pal.predict_raw(fr),
                                m_seg.predict_raw(fr), rtol=1e-5)
+
+
+def test_histogram_auc_matches_exact():
+    from h2o_kubernetes_tpu import metrics as M
+
+    rng = np.random.default_rng(2)
+    n = 30_000
+    y = (rng.random(n) < 0.4).astype(np.float32)
+    s = np.clip(y * 0.3 + rng.normal(scale=0.35, size=n) + 0.35, 0, 1)
+    s = s.astype(np.float32)
+    w = (rng.random(n) < 0.9).astype(np.float32)
+    exact = M.roc_auc(y, s, w=w, exact=True)
+    hist = M.roc_auc(y, s, w=w, exact=False)
+    assert abs(exact - hist) < 2e-3, (exact, hist)
+    # NaN on a live row surfaces through the histogram path too
+    s2 = s.copy(); s2[17] = np.nan
+    assert np.isnan(M.roc_auc(y, s2, w=w, exact=False))
+
+
+def test_histogram_auc_inf_scores_pinned():
+    from h2o_kubernetes_tpu import metrics as M
+
+    rng = np.random.default_rng(4)
+    n = 20_000
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    s = (y * 0.5 + rng.normal(scale=0.3, size=n)).astype(np.float32)
+    exact = M.roc_auc(y, s, exact=True)
+    s_inf = s.copy(); s_inf[0] = np.inf; s_inf[1] = -np.inf
+    hist = M.roc_auc(y, s_inf, exact=False)
+    # one +inf / one -inf row must not collapse the binning
+    assert abs(exact - hist) < 5e-3, (exact, hist)
