@@ -12,7 +12,7 @@ names:
 from .automl import AutoML as H2OAutoML
 from .models import (DRF, GBM, GLM, GLRM, PCA, Aggregator, CoxPH,
                      DeepLearning, IsolationForest, KMeans, NaiveBayes,
-                     StackedEnsemble, Word2Vec, XGBoost)
+                     StackedEnsemble, TargetEncoder, Word2Vec, XGBoost)
 
 H2OGradientBoostingEstimator = GBM
 H2ORandomForestEstimator = DRF
@@ -28,6 +28,7 @@ H2OIsolationForestEstimator = IsolationForest
 H2OGeneralizedLowRankEstimator = GLRM
 H2OCoxProportionalHazardsEstimator = CoxPH
 H2OAggregatorEstimator = Aggregator
+H2OTargetEncoderEstimator = TargetEncoder
 
 __all__ = [
     "H2OAutoML", "H2OGradientBoostingEstimator",
@@ -38,4 +39,5 @@ __all__ = [
     "H2ONaiveBayesEstimator", "H2OIsolationForestEstimator",
     "H2OGeneralizedLowRankEstimator",
     "H2OCoxProportionalHazardsEstimator", "H2OAggregatorEstimator",
+    "H2OTargetEncoderEstimator",
 ]

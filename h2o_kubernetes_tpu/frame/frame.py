@@ -334,6 +334,11 @@ class Vec:
     def ceil(self): return self._math(jnp.ceil)
     def sign(self): return self._math(jnp.sign)
 
+    def unique(self):
+        """Distinct non-NA values as a 1-column Frame (h2o unique)."""
+        from .munge import unique as _unique
+        return _unique(self)
+
     def isna(self) -> "Vec":
         """1.0 where the value is NA (h2o isna — NA itself maps to 1)."""
         if self.kind == "enum":
@@ -535,6 +540,21 @@ class Frame:
         """Join on key columns (h2o merge: inner, or left when all_x)."""
         from .munge import merge as _merge
         return _merge(self, other, by=by, all_x=all_x)
+
+    def impute(self, column: str, method: str = "mean", by=None):
+        """Fill NAs in place (h2o.impute: mean/median/mode, by-groups)."""
+        from .munge import impute as _impute
+        return _impute(self, column, method=method, by=by)
+
+    def table(self, col: str, col2: str | None = None) -> "Frame":
+        """Frequency table of 1-2 categorical columns (h2o table)."""
+        from .munge import table as _table
+        return _table(self, col, col2)
+
+    def quantile(self, prob=None) -> "Frame":
+        """Per-numeric-column quantiles (h2o quantile defaults)."""
+        from .munge import quantile as _quantile
+        return _quantile(self) if prob is None else _quantile(self, prob)
 
     def sort(self, by, ascending: bool = True) -> "Frame":
         """Rows ordered by the given column(s) (h2o sort; stable,

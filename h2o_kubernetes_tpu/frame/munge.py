@@ -21,8 +21,12 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+# module-level jit: a fresh wrapper per column would retrace per call
+_jit_nanquantile = jax.jit(jnp.nanquantile)
 
 from ..runtime.mrtask import doall
 from .frame import NA_ENUM, Frame, Vec
@@ -266,4 +270,144 @@ def merge(left: Frame, right: Frame, by=None, all_x: bool = False) -> Frame:
         while n in out:
             n += "0"                          # cbind-style dedup suffix
         out[n] = nv
+    return out
+
+
+# -- impute / table / quantile / unique --------------------------------------
+# h2o-py surface: h2o.frame.H2OFrame.impute / .table / .quantile /
+# .unique (water/rapids AstImpute, AstTable, AstQtile, AstUnique [U3]).
+
+def impute(frame: Frame, column: str, method: str = "mean",
+           by=None) -> float | str:
+    """Fill NAs in `column` in place; returns the fill value used
+    (or the per-group fill vector's mean when `by` is given).
+
+    method: mean | median (numeric) | mode (enum). `by` (mean only):
+    group-wise fill from the group means, NA groups fall back to the
+    global mean — one segment-sum doall, reference AstImpute semantics.
+    """
+    v = frame.vec(column)
+    if method not in ("mean", "median", "mode"):
+        raise ValueError(f"unknown impute method '{method}'")
+    if v.is_enum():
+        if method != "mode":
+            raise ValueError(f"impute '{column}': categorical columns "
+                             "impute with method='mode'")
+        codes = v.to_numpy()
+        counts = np.bincount(codes[codes >= 0],
+                             minlength=v.cardinality())
+        fill = int(np.argmax(counts))
+        out = np.where(codes < 0, fill, codes).astype(np.int32)
+        frame[column] = Vec.from_numpy(out, column, domain=v.domain)
+        return (v.domain or [])[fill]
+    x = v.to_numpy()
+    if by is not None:
+        if method != "mean":
+            raise ValueError("grouped impute supports method='mean'")
+        by = [by] if isinstance(by, str) else list(by)
+        if len(by) != 1:
+            raise ValueError("impute by= takes one grouping column")
+        g = frame.vec(by[0])
+        if not g.is_enum():
+            raise ValueError(f"impute by='{by[0]}': must be categorical")
+        G = g.cardinality()
+        codes = g.to_numpy().astype(np.int64)
+        ok = ~np.isnan(x) & (codes >= 0)
+        s = np.bincount(codes[ok], weights=x[ok], minlength=G)
+        c = np.bincount(codes[ok], minlength=G)
+        gmean = np.divide(s, c, out=np.full(G, np.nan), where=c > 0)
+        glob = float(np.nanmean(x)) if np.any(~np.isnan(x)) else 0.0
+        gmean = np.where(np.isnan(gmean), glob, gmean)
+        fill_vec = np.where(codes >= 0, gmean[np.maximum(codes, 0)],
+                            glob)
+        out = np.where(np.isnan(x), fill_vec, x)
+        # kind= keeps time columns time-typed (origin-relative f32
+        # storage; a bare from_numpy would flatten them to numeric and
+        # round full epoch magnitudes into f32)
+        frame[column] = Vec.from_numpy(out, column, kind=v.kind)
+        return float(np.mean(gmean))
+    if method == "mean":
+        fill = float(np.nanmean(x)) if np.any(~np.isnan(x)) else 0.0
+    else:
+        fill = float(np.nanmedian(x)) if np.any(~np.isnan(x)) else 0.0
+    out = np.where(np.isnan(x), fill, x)
+    frame[column] = Vec.from_numpy(out, column, kind=v.kind)
+    return fill
+
+
+def table(frame: Frame, col: str, col2: str | None = None) -> Frame:
+    """Frequency table of one or two categorical columns → Frame with
+    the level column(s) + 'Count' (NA rows excluded, zero rows kept
+    out, h2o table semantics)."""
+    v1 = frame.vec(col)
+    if not v1.is_enum():
+        raise ValueError(f"table: '{col}' must be categorical")
+    c1 = v1.to_numpy().astype(np.int64)
+    d1 = list(v1.domain or [])
+    if col2 is None:
+        cnt = np.bincount(c1[c1 >= 0], minlength=len(d1))
+        keep = cnt > 0
+        lv = np.flatnonzero(keep)
+        out = Frame()
+        out[col] = Vec.from_numpy(lv.astype(np.int32), col, domain=d1)
+        out["Count"] = Vec.from_numpy(cnt[keep].astype(np.float32),
+                                      "Count")
+        return out
+    v2 = frame.vec(col2)
+    if not v2.is_enum():
+        raise ValueError(f"table: '{col2}' must be categorical")
+    c2 = v2.to_numpy().astype(np.int64)
+    d2 = list(v2.domain or [])
+    ok = (c1 >= 0) & (c2 >= 0)
+    flat = c1[ok] * len(d2) + c2[ok]
+    cnt = np.bincount(flat, minlength=len(d1) * len(d2))
+    keep = cnt > 0
+    lv = np.flatnonzero(keep)
+    out = Frame()
+    out[col] = Vec.from_numpy((lv // len(d2)).astype(np.int32), col,
+                              domain=d1)
+    out[col2] = Vec.from_numpy((lv % len(d2)).astype(np.int32), col2,
+                               domain=d2)
+    out["Count"] = Vec.from_numpy(cnt[keep].astype(np.float32), "Count")
+    return out
+
+
+def quantile(frame: Frame, prob: Sequence[float] = (
+        0.001, 0.01, 0.1, 0.25, 0.333, 0.5, 0.667, 0.75, 0.9, 0.99,
+        0.999)) -> Frame:
+    """Per-numeric-column quantiles (device nanquantile, one sort per
+    column) → Frame with 'Probs' + one column per numeric input."""
+    import jax
+
+    probs = np.asarray(list(prob), dtype=np.float32)
+    if probs.size == 0 or np.any((probs < 0) | (probs > 1)):
+        raise ValueError("quantile probs must be in [0, 1]")
+    out = Frame()
+    out["Probs"] = Vec.from_numpy(probs, "Probs")
+    qs = jnp.asarray(probs)
+    for name in frame.names:
+        v = frame.vec(name)
+        if v.is_enum():
+            continue
+        col = _jit_nanquantile(v.as_float()[: len(v)], qs)
+        out[name] = Vec.from_numpy(
+            np.asarray(col).astype(np.float32), name)
+    if out.ncols == 1:
+        raise ValueError("quantile: frame has no numeric columns")
+    return out
+
+
+def unique(vec: Vec) -> Frame:
+    """Distinct non-NA values of one column as a single-column Frame."""
+    if vec.is_enum():
+        codes = vec.to_numpy()
+        lv = np.unique(codes[codes >= 0]).astype(np.int32)
+        out = Frame()
+        out[vec.name or "C1"] = Vec.from_numpy(lv, vec.name,
+                                               domain=vec.domain)
+        return out
+    x = vec.to_numpy()
+    vals = np.unique(x[~np.isnan(x)]).astype(np.float32)
+    out = Frame()
+    out[vec.name or "C1"] = Vec.from_numpy(vals, vec.name)
     return out

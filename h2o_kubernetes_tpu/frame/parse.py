@@ -315,6 +315,139 @@ def _import_arrow(files: list[str], fmt: str,
     return Frame(cols)
 
 
+def _looks_arff(path: str) -> bool:
+    """Content sniff: first non-comment line starts with @relation."""
+    try:
+        with _open_text(path) as f:
+            for ln in f:
+                s = ln.strip()
+                if not s or s.startswith("%"):
+                    continue
+                return s.lower().startswith("@relation")
+    except OSError:
+        return False
+    return False
+
+
+def _arff_unquote(tok: str) -> str:
+    tok = tok.strip()
+    if len(tok) >= 2 and tok[0] in "'\"" and tok[-1] == tok[0]:
+        return tok[1:-1]
+    return tok
+
+
+def _import_arff(files: list[str], skipped: set[str]) -> Frame:
+    """ARFF ingest (h2o-parsers ARFF parser analog [U3]): @attribute
+    declarations give names AND types — numeric/real/integer,
+    {nominal,...} with the DECLARED level order kept (unlike CSV enum
+    inference, which sorts), string (interned like nominal), date
+    (epoch-ms time column). '?' is NA. Dense rows only; the sparse
+    `{i v, ...}` form is rejected loudly."""
+    names: list[str] = []
+    types: list[str | list[str]] = []
+    raw: list[list[str]] = []
+    for fi, fp in enumerate(files):
+        in_data = False
+        f_names: list[str] = []
+        f_types: list[str | list[str]] = []
+        with _open_text(fp) as f:
+            for lineno, ln in enumerate(f, start=1):
+                s = ln.strip()
+                if not s or s.startswith("%"):
+                    continue
+                low = s.lower()
+                if not in_data:
+                    if low.startswith("@relation"):
+                        continue
+                    if low.startswith("@attribute"):
+                        body = s[len("@attribute"):].strip()
+                        if body.startswith(("'", '"')):
+                            q = body[0]
+                            end = body.find(q, 1)
+                            if end < 0:
+                                raise ValueError(
+                                    f"{fp}:{lineno}: unterminated "
+                                    f"quoted attribute name '{s}'")
+                            aname = body[1:end]
+                            atype = body[end + 1:].strip()
+                        else:
+                            parts = body.split(None, 1)
+                            if len(parts) != 2:
+                                raise ValueError(
+                                    f"{fp}:{lineno}: malformed "
+                                    f"@attribute '{s}'")
+                            aname, atype = parts
+                        if atype.startswith("{"):
+                            dom = [_arff_unquote(t) for t in
+                                   _split_line(atype.strip("{}"), ",")]
+                            f_types.append(dom)
+                        else:
+                            t = atype.split()[0].lower()
+                            if t in ("numeric", "real", "integer"):
+                                f_types.append("numeric")
+                            elif t == "string":
+                                f_types.append("string")
+                            elif t == "date":
+                                f_types.append("time")
+                            else:
+                                raise ValueError(
+                                    f"{fp}:{lineno}: unsupported ARFF "
+                                    f"type '{atype}'")
+                        f_names.append(aname)
+                        continue
+                    if low.startswith("@data"):
+                        if fi == 0:
+                            names, types = f_names, f_types
+                            raw = [[] for _ in names]
+                        elif f_names != names or f_types != types:
+                            # a type mismatch silently materializing
+                            # under the first file's types would turn
+                            # nominal tokens into NaNs
+                            raise ValueError(
+                                f"{fp}: ARFF attributes differ from "
+                                f"{files[0]}")
+                        in_data = True
+                        continue
+                    raise ValueError(
+                        f"{fp}:{lineno}: unexpected ARFF line '{s}'")
+                else:
+                    if s.startswith("{"):
+                        raise ValueError(
+                            f"{fp}:{lineno}: sparse ARFF rows are not "
+                            "supported")
+                    toks = [_arff_unquote(t)
+                            for t in _split_line(s, ",")]
+                    if len(toks) != len(names):
+                        raise ValueError(
+                            f"{fp}:{lineno}: {len(toks)} values, "
+                            f"expected {len(names)}")
+                    for c, t in enumerate(toks):
+                        raw[c].append(t)
+        if not in_data:
+            raise ValueError(f"{fp}: no @data section")
+    vecs: dict[str, Vec] = {}
+    for c, (name, typ) in enumerate(zip(names, types)):
+        if name in skipped:
+            continue
+        if isinstance(typ, list):          # declared nominal domain
+            pos = {d: i for i, d in enumerate(typ)}
+            codes = np.empty(len(raw[c]), dtype=np.int32)
+            for i, tok in enumerate(raw[c]):
+                if tok == "?" or tok == "":
+                    codes[i] = -1
+                elif tok in pos:
+                    codes[i] = pos[tok]
+                else:
+                    raise ValueError(
+                        f"'{tok}' not in declared domain of '{name}'")
+            vecs[name] = Vec.from_numpy(codes, name, domain=list(typ))
+        elif typ == "string":
+            vecs[name] = _materialize(raw[c], "enum", name, {"?", ""})
+        else:
+            vecs[name] = _materialize(raw[c], typ, name, {"?", ""})
+    return Frame(vecs)
+
+
 def import_file(path: str | Sequence[str], sep: str | None = None,
                 header: int = -1, col_names: Sequence[str] | None = None,
                 col_types: Mapping[str, str] | Sequence[str] | None = None,
@@ -329,6 +462,12 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
         return _import_arrow(files, fmt,
                              col_types if isinstance(col_types, Mapping)
                              else None, set(skipped_columns or []))
+    base = files[0].lower()
+    for z in (".gz", ".bz2", ".xz"):
+        if base.endswith(z):
+            base = base[: -len(z)]
+    if base.endswith(".arff") or _looks_arff(files[0]):
+        return _import_arff(files, set(skipped_columns or []))
     setup = parse_setup(path, sep=sep, header=header, na_strings=na_strings)
     # copy: uniquification below must not leak into setup["names"], which
     # later files' first records are compared against verbatim

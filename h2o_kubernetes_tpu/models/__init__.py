@@ -10,6 +10,7 @@ from .kmeans import KMeans, KMeansModel
 from .naivebayes import NaiveBayes, NaiveBayesModel
 from .pca import PCA, PCAModel
 from .stackedensemble import StackedEnsemble, StackedEnsembleModel
+from .targetencoder import TargetEncoder, TargetEncoderModel
 from .word2vec import Word2Vec, Word2VecModel
 from .xgboost import XGBoost, XGBoostModel
 
@@ -20,4 +21,5 @@ __all__ = ["Aggregator", "AggregatorModel", "CoxPH", "CoxPHModel",
            "KMeans", "KMeansModel", "NaiveBayes", "NaiveBayesModel",
            "PCA", "PCAModel",
            "StackedEnsemble", "StackedEnsembleModel",
+           "TargetEncoder", "TargetEncoderModel",
            "Word2Vec", "Word2VecModel", "XGBoost", "XGBoostModel"]

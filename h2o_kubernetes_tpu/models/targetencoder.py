@@ -1,0 +1,217 @@
+"""Target encoding — the H2OTargetEncoderEstimator analog.
+
+Reference: ai/h2o/targetencoding/TargetEncoder* (h2o-automl) and
+h2o-py's H2OTargetEncoderEstimator [U3]: replace a categorical column
+with the per-level mean of the response, with three leakage-handling
+modes (none / leave_one_out / k_fold), optional blending toward the
+global prior (lambda = 1/(1+exp(-(n-k)/f))), and optional uniform
+noise on training transforms.
+
+TPU-first design: per-level (Σy, n) are dense [card] accumulators from
+one segment-sum pass per column (the same doall shape as GroupBy);
+fold-out statistics are the totals minus the fold's own accumulator, so
+k_fold needs one [nfolds, card] segment-sum, not nfolds passes. The
+transform is a device gather through the level→encoding table. This is
+the reference's answer to high-cardinality categoricals (which
+histogram binning rejects beyond 255 levels): encode first, then feed
+the numeric column to any estimator.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..frame import Frame, Vec
+
+__all__ = ["TargetEncoder"]
+
+_MODES = ("none", "leave_one_out", "k_fold")
+
+
+@dataclass
+class TargetEncoderParams:
+    data_leakage_handling: str = "none"   # see _MODES
+    blending: bool = False
+    inflection_point: float = 10.0        # k in lambda(n) = σ((n-k)/f)
+    smoothing: float = 20.0               # f
+    noise: float = 0.01                   # uniform(±noise) on as_training
+    fold_column: str | None = None        # required for k_fold
+    seed: int = 0
+
+
+class TargetEncoderModel:
+    """Fitted encoder: per-column level→encoding tables."""
+
+    algo = "targetencoder"
+
+    def __init__(self, params: TargetEncoderParams, y: str,
+                 columns: list[str], prior: float,
+                 tables: dict[str, dict]):
+        self.params = params
+        self.y = y
+        self.columns = columns
+        self.prior = prior
+        # per column: {"domain": [...], "sum": [card], "cnt": [card],
+        #              "fold_sum": [F, card]|None, "fold_cnt": ...}
+        self.tables = tables
+
+    def _encode(self, sums: np.ndarray, cnts: np.ndarray) -> np.ndarray:
+        safe = np.maximum(cnts, 1.0)
+        mean = sums / safe
+        if self.params.blending:
+            lam = 1.0 / (1.0 + np.exp(
+                -(cnts - self.params.inflection_point)
+                / max(self.params.smoothing, 1e-12)))
+            enc = lam * mean + (1.0 - lam) * self.prior
+        else:
+            enc = mean
+        return np.where(cnts > 0, enc, self.prior)
+
+    def transform(self, frame: Frame, as_training: bool = False,
+                  noise: float | None = None) -> Frame:
+        """Return a frame with `<col>_te` columns appended.
+
+        as_training=True applies the fitted leakage handling (fold-out /
+        LOO statistics) plus noise; False (scoring, the default) uses
+        the full-data encoding with no noise.
+        """
+        p = self.params
+        rng = np.random.default_rng(p.seed)
+        noise = p.noise if noise is None else noise
+        out = Frame({n: frame.vec(n) for n in frame.names})
+        mode = p.data_leakage_handling if as_training else "none"
+        fold = None
+        if mode == "k_fold":
+            fv = frame.vec(p.fold_column).to_numpy()
+            fold = np.nan_to_num(fv).astype(np.int64)
+        yv = None
+        if mode == "leave_one_out":
+            if self.y not in frame.names:
+                # silently falling back to full-data means would inject
+                # exactly the leakage this mode exists to prevent
+                raise ValueError(
+                    "leave_one_out training transform needs the "
+                    f"response column '{self.y}' in the frame")
+            yraw = frame.vec(self.y)
+            if yraw.is_enum():
+                c = yraw.to_numpy()
+                # NA codes -> NaN so the subtraction below skips them
+                # (they were never counted in the fitted stats)
+                yv = np.where(c < 0, np.nan,
+                              (c == 1).astype(np.float64))
+            else:
+                yv = yraw.to_numpy().astype(np.float64)
+        for col in self.columns:
+            t = self.tables[col]
+            v = frame.vec(col)
+            codes = self._codes_for(v, t["domain"])
+            sums = np.asarray(t["sum"], dtype=np.float64)
+            cnts = np.asarray(t["cnt"], dtype=np.float64)
+            if mode == "k_fold":
+                fs = np.asarray(t["fold_sum"])
+                fc = np.asarray(t["fold_cnt"])
+                nf = fs.shape[0]
+                fidx = np.clip(fold, 0, nf - 1)
+                s_out = sums[None, :] - fs            # [F, card]
+                c_out = cnts[None, :] - fc
+                enc_tab = np.stack([self._encode(s_out[f], c_out[f])
+                                    for f in range(nf)])  # [F, card]
+                enc = enc_tab[fidx, np.maximum(codes, 0)]
+            elif mode == "leave_one_out" and yv is not None:
+                s_row = sums[np.maximum(codes, 0)]
+                c_row = cnts[np.maximum(codes, 0)]
+                ok = ~np.isnan(yv)
+                s_loo = s_row - np.where(ok, yv, 0.0)
+                c_loo = c_row - ok.astype(np.float64)
+                enc = np.asarray(self._encode(s_loo, c_loo))
+            else:
+                enc_tab = self._encode(sums, cnts)
+                enc = enc_tab[np.maximum(codes, 0)]
+            enc = np.where(codes >= 0, enc, self.prior)
+            if as_training and noise > 0:
+                enc = enc + rng.uniform(-noise, noise, size=enc.shape)
+            out[f"{col}_te"] = Vec.from_numpy(
+                enc.astype(np.float32), f"{col}_te")
+        return out
+
+    @staticmethod
+    def _codes_for(v: Vec, domain: list[str]) -> np.ndarray:
+        """Map a column's codes onto the TRAINING domain (unseen → -1)."""
+        if not v.is_enum():
+            raise ValueError(f"'{v.name}' is not categorical")
+        codes = v.to_numpy().astype(np.int64)
+        if list(v.domain or []) == list(domain):
+            return codes
+        pos = {d: i for i, d in enumerate(domain)}
+        lut = np.array([pos.get(d, -1) for d in (v.domain or [])] + [-1],
+                       dtype=np.int64)
+        return lut[np.where(codes < 0, len(lut) - 1, codes)]
+
+
+class TargetEncoder:
+    """H2OTargetEncoderEstimator analog (fit on train, then transform)."""
+
+    def __init__(self, **kw):
+        self.params = TargetEncoderParams(**kw)
+        if self.params.data_leakage_handling not in _MODES:
+            raise ValueError(
+                f"unknown data_leakage_handling "
+                f"'{self.params.data_leakage_handling}' "
+                f"(supported: {', '.join(_MODES)})")
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None) -> TargetEncoderModel:
+        p = self.params
+        if p.data_leakage_handling == "k_fold" and not p.fold_column:
+            raise ValueError("k_fold leakage handling needs fold_column")
+        yv = training_frame.vec(y)
+        if yv.is_enum():
+            if yv.cardinality() != 2:
+                raise ValueError("target encoding needs a numeric or "
+                                 "binary response")
+            yn = (yv.to_numpy() == 1).astype(np.float64)
+            yna = yv.to_numpy() < 0
+        else:
+            raw = yv.to_numpy().astype(np.float64)
+            yna = np.isnan(raw)
+            yn = np.nan_to_num(raw)
+        cols = list(x) if x is not None else [
+            n for n in training_frame.names
+            if n not in (y, p.fold_column)
+            and training_frame.vec(n).is_enum()]
+        if not cols:
+            raise ValueError("no categorical columns to encode")
+        ok = ~yna
+        prior = float(yn[ok].mean()) if ok.any() else 0.0
+        fold = None
+        nf = 0
+        if p.data_leakage_handling == "k_fold":
+            fv = training_frame.vec(p.fold_column).to_numpy()
+            fold = np.nan_to_num(fv).astype(np.int64)
+            nf = int(fold.max()) + 1 if fold.size else 1
+        tables: dict[str, dict] = {}
+        for col in cols:
+            v = training_frame.vec(col)
+            if not v.is_enum():
+                raise ValueError(f"column '{col}' is not categorical")
+            card = v.cardinality()
+            codes = v.to_numpy().astype(np.int64)
+            live = ok & (codes >= 0)
+            s = np.bincount(codes[live], weights=yn[live],
+                            minlength=card).astype(np.float64)
+            c = np.bincount(codes[live], minlength=card).astype(
+                np.float64)
+            t = {"domain": list(v.domain or []), "sum": s, "cnt": c,
+                 "fold_sum": None, "fold_cnt": None}
+            if fold is not None:
+                flat = fold[live] * card + codes[live]
+                fs = np.bincount(flat, weights=yn[live],
+                                 minlength=nf * card)
+                fc = np.bincount(flat, minlength=nf * card)
+                t["fold_sum"] = fs.reshape(nf, card)
+                t["fold_cnt"] = fc.reshape(nf, card).astype(np.float64)
+            tables[col] = t
+        return TargetEncoderModel(p, y, cols, prior, tables)

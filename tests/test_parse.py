@@ -277,3 +277,89 @@ def test_orc_ingest(tmp_path, mesh8):
     fr = import_file(str(path))
     np.testing.assert_allclose(fr["a"].to_numpy(), [1.5, 2.5, 3.5])
     assert fr["b"].is_enum()
+
+
+# -- ARFF --------------------------------------------------------------------
+
+ARFF_DOC = """% weather data
+@relation weather
+@attribute temp numeric
+@attribute 'wind speed' real
+@attribute outlook {sunny, rainy, 'very cloudy'}
+@attribute note string
+@data
+71.0, 3.5, sunny, ok
+?, 2.0, rainy, bad
+65.5, ?, 'very cloudy', ok
+"""
+
+
+def test_arff_basic(tmp_path):
+    import h2o_kubernetes_tpu as h2o
+
+    p = tmp_path / "w.arff"
+    p.write_text(ARFF_DOC)
+    fr = h2o.import_file(str(p))
+    assert fr.names == ["temp", "wind speed", "outlook", "note"]
+    t = fr.vec("temp").to_numpy()
+    assert np.isnan(t[1]) and abs(t[0] - 71.0) < 1e-5
+    o = fr.vec("outlook")
+    # DECLARED level order is kept (CSV inference would sort)
+    assert o.domain == ["sunny", "rainy", "very cloudy"]
+    assert list(o.to_numpy()) == [0, 1, 2]
+    assert fr.vec("note").is_enum()
+    w = fr.vec("wind speed").to_numpy()
+    assert np.isnan(w[2])
+
+
+def test_arff_content_sniff_without_extension(tmp_path):
+    import h2o_kubernetes_tpu as h2o
+
+    p = tmp_path / "noext.dat"
+    p.write_text(ARFF_DOC)
+    fr = h2o.import_file(str(p))
+    assert fr.shape == (3, 4)
+
+
+def test_arff_multifile_and_errors(tmp_path):
+    import h2o_kubernetes_tpu as h2o
+    import pytest
+
+    (tmp_path / "a.arff").write_text(ARFF_DOC)
+    (tmp_path / "b.arff").write_text(ARFF_DOC)
+    fr = h2o.import_file(str(tmp_path / "*.arff"))
+    assert fr.nrows == 6
+    # sparse rows are rejected loudly
+    bad = tmp_path / "sparse.arff"
+    bad.write_text("@relation r\n@attribute a numeric\n@data\n{0 1}\n")
+    with pytest.raises(ValueError, match="sparse"):
+        h2o.import_file(str(bad))
+    # out-of-domain nominal is a loud error
+    bad2 = tmp_path / "dom.arff"
+    bad2.write_text(
+        "@relation r\n@attribute c {x, y}\n@data\nz\n")
+    with pytest.raises(ValueError, match="declared domain"):
+        h2o.import_file(str(bad2))
+
+
+def test_arff_multifile_type_mismatch_rejected(tmp_path):
+    import h2o_kubernetes_tpu as h2o
+    import pytest
+
+    (tmp_path / "a.arff").write_text(
+        "@relation r\n@attribute c numeric\n@data\n1\n")
+    (tmp_path / "b.arff").write_text(
+        "@relation r\n@attribute c {x, y}\n@data\nx\n")
+    with pytest.raises(ValueError, match="attributes differ"):
+        h2o.import_file([str(tmp_path / "a.arff"),
+                         str(tmp_path / "b.arff")])
+
+
+def test_arff_unterminated_quote_diagnostic(tmp_path):
+    import h2o_kubernetes_tpu as h2o
+    import pytest
+
+    p = tmp_path / "bad.arff"
+    p.write_text("@relation r\n@attribute 'wind speed numeric\n@data\n")
+    with pytest.raises(ValueError, match="unterminated"):
+        h2o.import_file(str(p))

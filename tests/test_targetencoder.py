@@ -1,0 +1,210 @@
+"""TargetEncoder tests (H2OTargetEncoderEstimator analog) plus the
+impute/table/quantile/unique munging surface."""
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import TargetEncoder
+
+
+@pytest.fixture(scope="module")
+def te_frame():
+    rng = np.random.default_rng(5)
+    n = 400
+    cat = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, n)]
+    base = {"a": 0.8, "b": 0.6, "c": 0.3, "d": 0.1}
+    y = (rng.random(n) < np.vectorize(base.get)(cat)).astype(np.float32)
+    fold = (np.arange(n) % 3).astype(np.float32)
+    return h2o.Frame.from_arrays({
+        "cat": cat, "fold": fold,
+        "x": rng.normal(size=n).astype(np.float32), "y": y}), cat, y
+
+
+def test_none_mode_encodes_level_means(te_frame):
+    fr, cat, y = te_frame
+    model = TargetEncoder(noise=0.0).train(
+        y="y", training_frame=fr, x=["cat"])
+    out = model.transform(fr)
+    enc = out.vec("cat_te").to_numpy()
+    for lvl in "abcd":
+        want = y[cat == lvl].mean()
+        got = enc[cat == lvl]
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_loo_excludes_own_row(te_frame):
+    fr, cat, y = te_frame
+    model = TargetEncoder(data_leakage_handling="leave_one_out",
+                          noise=0.0).train(
+        y="y", training_frame=fr, x=["cat"])
+    out = model.transform(fr, as_training=True, noise=0.0)
+    enc = out.vec("cat_te").to_numpy()
+    i = 0
+    lvl = cat[i]
+    sel = cat == lvl
+    want = (y[sel].sum() - y[i]) / (sel.sum() - 1)
+    assert abs(enc[i] - want) < 1e-5
+
+
+def test_kfold_uses_out_of_fold_stats(te_frame):
+    fr, cat, y = te_frame
+    fold = np.asarray(fr.vec("fold").to_numpy()).astype(int)
+    model = TargetEncoder(data_leakage_handling="k_fold",
+                          fold_column="fold", noise=0.0).train(
+        y="y", training_frame=fr, x=["cat"])
+    out = model.transform(fr, as_training=True, noise=0.0)
+    enc = out.vec("cat_te").to_numpy()
+    i = 7
+    sel = (cat == cat[i]) & (fold != fold[i])
+    want = y[sel].mean()
+    assert abs(enc[i] - want) < 1e-5
+    # scoring transform ignores folds
+    out2 = model.transform(fr)
+    enc2 = out2.vec("cat_te").to_numpy()
+    sel_all = cat == cat[i]
+    assert abs(enc2[i] - y[sel_all].mean()) < 1e-5
+
+
+def test_blending_shrinks_rare_levels():
+    rng = np.random.default_rng(1)
+    n = 200
+    cat = np.array(["common"] * (n - 2) + ["rare"] * 2)
+    y = np.concatenate([
+        (rng.random(n - 2) < 0.3).astype(np.float32),
+        np.ones(2, dtype=np.float32)])
+    fr = h2o.Frame.from_arrays({"cat": cat, "y": y})
+    m = TargetEncoder(blending=True, inflection_point=10, smoothing=5,
+                      noise=0.0).train(y="y", training_frame=fr,
+                                       x=["cat"])
+    enc = m.transform(fr).vec("cat_te").to_numpy()
+    rare_enc = enc[cat == "rare"][0]
+    prior = y.mean()
+    # rare level (n=2, raw mean 1.0): lambda = sigma((2-10)/5) ~ 0.17,
+    # so the encoding shrinks most of the way back toward the prior
+    lam = 1.0 / (1.0 + np.exp((10 - 2) / 5))
+    want = lam * 1.0 + (1 - lam) * prior
+    assert abs(rare_enc - want) < 1e-5, (rare_enc, want)
+    assert prior < rare_enc < 0.5
+
+
+def test_unseen_level_and_na_get_prior(te_frame):
+    fr, cat, y = te_frame
+    model = TargetEncoder(noise=0.0).train(
+        y="y", training_frame=fr, x=["cat"])
+    new = h2o.Frame.from_arrays({
+        "cat": np.array(["a", "zzz", "b"]),
+        "y": np.zeros(3, dtype=np.float32)})
+    enc = model.transform(new).vec("cat_te").to_numpy()
+    assert abs(enc[1] - model.prior) < 1e-6
+    assert abs(enc[0] - y[cat == "a"].mean()) < 1e-5
+
+
+def test_training_noise_applied(te_frame):
+    fr, cat, y = te_frame
+    model = TargetEncoder(noise=0.05).train(
+        y="y", training_frame=fr, x=["cat"])
+    a = model.transform(fr, as_training=True).vec("cat_te").to_numpy()
+    b = model.transform(fr).vec("cat_te").to_numpy()
+    d = np.abs(a - b)
+    assert d.max() <= 0.05 + 1e-6
+    assert d.mean() > 0.005       # noise actually applied
+
+
+def test_te_feeds_gbm(te_frame):
+    """End-to-end: encode then train — the high-cardinality recipe."""
+    fr, cat, y = te_frame
+    model = TargetEncoder(noise=0.0).train(
+        y="y", training_frame=fr, x=["cat"])
+    enc = model.transform(fr)
+    from h2o_kubernetes_tpu.models import GBM
+
+    fr2 = h2o.Frame.from_arrays({
+        "cat_te": enc.vec("cat_te").to_numpy(),
+        "x": fr.vec("x").to_numpy(),
+        "y": np.where(y > 0, "yes", "no")})
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(
+        y="y", training_frame=fr2)
+    assert m.model_performance(fr2, "y")["auc"] > 0.6
+
+
+def test_estimator_alias():
+    from h2o_kubernetes_tpu.estimators import H2OTargetEncoderEstimator
+
+    assert H2OTargetEncoderEstimator is TargetEncoder
+
+
+# -- munge surface -----------------------------------------------------------
+
+def test_impute_mean_and_mode():
+    x = np.array([1.0, 2.0, np.nan, 3.0], dtype=np.float32)
+    g = np.array(["u", "v", "u", None])
+    fr = h2o.Frame.from_arrays({"x": x, "g": g})
+    fill = fr.impute("x", method="mean")
+    assert abs(fill - 2.0) < 1e-6
+    assert not np.isnan(fr.vec("x").to_numpy()).any()
+    lvl = fr.impute("g", method="mode")
+    assert lvl == "u"
+    assert (fr.vec("g").to_numpy() >= 0).all()
+
+
+def test_impute_grouped_mean():
+    x = np.array([1.0, 3.0, np.nan, 10.0, np.nan], dtype=np.float32)
+    g = np.array(["a", "a", "a", "b", "b"])
+    fr = h2o.Frame.from_arrays({"x": x, "g": g})
+    fr.impute("x", method="mean", by="g")
+    got = fr.vec("x").to_numpy()
+    assert abs(got[2] - 2.0) < 1e-5       # mean of group a
+    assert abs(got[4] - 10.0) < 1e-5      # mean of group b
+
+
+def test_table_counts():
+    g = np.array(["a", "b", "a", "a", None])
+    h_ = np.array(["x", "x", "y", "x", "y"])
+    fr = h2o.Frame.from_arrays({"g": g, "h": h_})
+    t = fr.table("g")
+    d = dict(zip([t.vec("g").domain[int(c)] for c in
+                  t.vec("g").to_numpy()],
+                 t.vec("Count").to_numpy()))
+    assert d == {"a": 3.0, "b": 1.0}
+    t2 = fr.table("g", "h")
+    assert float(t2.vec("Count").to_numpy().sum()) == 4.0
+
+
+def test_quantile_and_unique():
+    x = np.arange(101, dtype=np.float32)
+    fr = h2o.Frame.from_arrays({"x": x})
+    q = fr.quantile(prob=[0.5, 0.9])
+    got = q.vec("x").to_numpy()
+    np.testing.assert_allclose(got, [50.0, 90.0], atol=0.5)
+    u = h2o.Frame.from_arrays(
+        {"v": np.array([3.0, 1.0, 3.0, np.nan], dtype=np.float32)})
+    vals = u.vec("v").unique().vec("v").to_numpy()
+    np.testing.assert_allclose(vals, [1.0, 3.0])
+
+
+def test_loo_training_transform_requires_y(te_frame):
+    fr, cat, y = te_frame
+    model = TargetEncoder(data_leakage_handling="leave_one_out",
+                          noise=0.0).train(
+        y="y", training_frame=fr, x=["cat"])
+    no_y = fr.drop("y")
+    with pytest.raises(ValueError, match="response column"):
+        model.transform(no_y, as_training=True)
+    # scoring transform (no leakage handling) works without y
+    out = model.transform(no_y)
+    assert "cat_te" in out.names
+
+
+def test_impute_preserves_time_kind():
+    t = np.array(["2024-01-01", "NaT", "2024-01-03"],
+                 dtype="datetime64[ms]")
+    fr = h2o.Frame.from_arrays({"ts": t})
+    assert fr.vec("ts").kind == "time"
+    fr.impute("ts", method="mean")
+    v = fr.vec("ts")
+    assert v.kind == "time"
+    got = v.to_numpy()
+    want_mid = (t[0].astype("datetime64[ms]").astype(np.float64)
+                + t[2].astype("datetime64[ms]").astype(np.float64)) / 2
+    assert abs(got[1] - want_mid) < 1000        # within a second
