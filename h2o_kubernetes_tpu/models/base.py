@@ -20,6 +20,12 @@ from .. import metrics as M
 from ..frame import Frame, Vec
 from ..runtime import mesh as meshlib
 
+# jitted single-column overwrite for partial_plot sweeps: an EAGER
+# .at[].set on a committed multi-device array is the XLA:CPU rendezvous
+# flake pattern the fused train paths were purged of
+_set_col_jit = jax.jit(
+    lambda X, j, v: X.at[:, j].set(v), static_argnums=1)
+
 
 @dataclass
 class TrainData:
@@ -204,6 +210,69 @@ class Model:
                 pf[f"p{name}"] = Vec.from_numpy(out[:, k])
             return pf
         return Frame.from_arrays({"predict": out})
+
+    def partial_plot(self, frame: Frame, cols: Sequence[str],
+                     nbins: int = 20, plot: bool = False
+                     ) -> list[Frame]:
+        """Partial dependence (h2o model.partial_plot, hex/PartialDependence
+        [U3]): per column, sweep a value grid, overwrite the column for
+        EVERY row, and record the mean (+sd, +std-error) of the model's
+        response — positive-class probability for binomial, prediction
+        for regression. Returns one Frame per column; `plot` is accepted
+        for h2o-py signature parity and ignored (no display surface)."""
+        if self.nclasses > 2:
+            raise ValueError("partial_plot supports binomial and "
+                             "regression models only")
+        del plot
+        out_frames = []
+        n = frame.nrows
+        # one design-matrix build; each grid step overwrites a single
+        # column on device instead of re-sharding the whole frame
+        X = self._design_matrix(frame)
+        for col in cols:
+            if col not in self.feature_names:
+                raise ValueError(
+                    f"partial_plot: '{col}' is not a model feature")
+            j = self.feature_names.index(col)
+            v = frame.vec(col)
+            if v.is_enum():
+                grid = list(range(v.cardinality()))
+                labels = list(v.domain or [])
+            else:
+                x = v.to_numpy()
+                finite = x[~np.isnan(x)]
+                if finite.size == 0:
+                    raise ValueError(f"partial_plot: '{col}' is all-NA")
+                # quantile-spaced grid like the reference's default
+                grid = list(np.unique(np.quantile(
+                    finite, np.linspace(0, 1, nbins))))
+                labels = None
+            means, sds, sems = [], [], []
+            for gv in grid:
+                pred = np.asarray(self._score_matrix(
+                    _set_col_jit(X, j, float(gv))))[:n]
+                resp = pred[:, 1] if self.nclasses == 2 else pred
+                means.append(float(np.mean(resp)))
+                sds.append(float(np.std(resp, ddof=1))
+                           if n > 1 else 0.0)
+                sems.append(sds[-1] / np.sqrt(n))
+            pd_out = Frame()
+            if labels is not None:
+                pd_out[col] = Vec.from_numpy(
+                    np.arange(len(grid), dtype=np.int32), col,
+                    domain=labels)
+            else:
+                pd_out[col] = Vec.from_numpy(
+                    np.asarray(grid, dtype=np.float32), col)
+            pd_out["mean_response"] = Vec.from_numpy(
+                np.asarray(means, dtype=np.float32), "mean_response")
+            pd_out["stddev_response"] = Vec.from_numpy(
+                np.asarray(sds, dtype=np.float32), "stddev_response")
+            pd_out["std_error_mean_response"] = Vec.from_numpy(
+                np.asarray(sems, dtype=np.float32),
+                "std_error_mean_response")
+            out_frames.append(pd_out)
+        return out_frames
 
     def model_performance(self, frame: Frame, y: str) -> dict[str, float]:
         yv = frame.vec(y)

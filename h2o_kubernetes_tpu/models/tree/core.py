@@ -57,6 +57,8 @@ class Tree(NamedTuple):
     is_split: jax.Array     # bool  [N]
     value: jax.Array        # f32   [N] leaf value (valid where not split)
     gain: jax.Array         # f32   [N] split gain (varimp attribution)
+    cover: jax.Array        # f32   [N] training weight mass reaching the
+    #                         node (global, psum'd) — TreeSHAP's r_j
 
 
 def _soft_thresh(g, alpha):
@@ -84,7 +86,9 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
     assigned to each side in turn, XGBoost-style learned NA direction.
     `feat_ok`: optional [n_nodes, F] bool mask of allowed features
     (per-tree column sampling and DRF per-node mtries).
-    Returns (feat, bin, na_left, can_split, node_value, G, H) per node.
+    Returns (feat, bin, na_left, can_split, node_value, best_gain,
+    cover) per node — cover is the node's total weight mass (TreeSHAP's
+    r_j).
     """
     nb = hist.shape[2]
     na = hist[:, :, nb - 1, :]                 # [n, F, 3]
@@ -126,7 +130,7 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
     can_split = (best_gain > p.gamma) & (C >= 2 * p.min_rows) & \
         jnp.isfinite(best_gain)
     value = _leaf_value(G, H, p)
-    return feat, bin_, na_l, can_split, value, best_gain
+    return feat, bin_, na_l, can_split, value, best_gain, C
 
 
 def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
@@ -145,6 +149,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
     is_split = jnp.zeros(N, dtype=bool)
     value = jnp.zeros(N, dtype=jnp.float32)
     gain = jnp.zeros(N, dtype=jnp.float32)
+    cover = jnp.zeros(N, dtype=jnp.float32)
 
     rel = jnp.zeros(binned.shape[0], dtype=jnp.int32)   # relative node @ lvl
     abs_node = jnp.zeros(binned.shape[0], dtype=jnp.int32)
@@ -185,7 +190,8 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             r = jnp.where(feat_ok, r, jnp.inf)
             kth = jnp.sort(r, axis=1)[:, p.mtries - 1: p.mtries]
             feat_ok = feat_ok & (r <= kth)
-        feat, bin_, na_l, can, val, g_best = _find_splits(hist, p, feat_ok)
+        feat, bin_, na_l, can, val, g_best, cov = _find_splits(hist, p,
+                                                               feat_ok)
         if d == p.max_depth:                            # final level: leaves
             can = jnp.zeros_like(can)
         idx = off + jnp.arange(n_nodes)
@@ -195,6 +201,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         is_split = is_split.at[idx].set(can)
         value = value.at[idx].set(val)
         gain = gain.at[idx].set(jnp.where(can, g_best, 0.0))
+        cover = cover.at[idx].set(cov)
         if d == p.max_depth:
             break
         hist_prev, can_prev = hist, can
@@ -214,8 +221,8 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         rel = jnp.where(moved, child, -1)
         abs_node = jnp.where(moved, (2 ** (d + 1) - 1) + child, abs_node)
 
-    return Tree(split_feat, split_bin, na_left, is_split, value, gain), \
-        abs_node
+    return Tree(split_feat, split_bin, na_left, is_split, value, gain,
+                cover), abs_node
 
 
 def grow_tree(binned, g, h, w, p: TreeParams, col_mask=None, key=None,

@@ -1,0 +1,197 @@
+"""TreeSHAP (predict_contributions) tests.
+
+Two independent checks, mirroring how the reference validates its
+h2o-genmodel TreeSHAP: (1) the additivity invariant — contributions sum
+to the raw margin for every row; (2) exact agreement with brute-force
+Shapley values computed by subset enumeration over the tree's
+cover-weighted conditional expectations."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import DRF, GBM
+
+
+@pytest.fixture(scope="module")
+def frame():
+    rng = np.random.default_rng(13)
+    n = 300
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)   # noise feature
+    g = np.array(["p", "q", "r"])[rng.integers(0, 3, n)]
+    logit = 1.5 * x0 - x1 + (g == "p") * 0.8
+    return h2o.Frame.from_arrays({
+        "x0": x0, "x1": x1, "x2": x2, "g": g,
+        "y": np.where(logit + rng.normal(scale=0.3, size=n) > 0,
+                      "yes", "no")})
+
+
+def _margin(model, fr):
+    import jax.numpy as jnp
+
+    X = model._design_matrix(fr)
+    return np.asarray(model._margins(X))[: fr.nrows]
+
+
+def test_additivity_binomial(frame):
+    m = GBM(ntrees=8, max_depth=4, seed=3).train(
+        y="y", training_frame=frame)
+    contrib = m.predict_contributions(frame)
+    total = sum(contrib.vec(n).to_numpy()
+                for n in contrib.names)
+    np.testing.assert_allclose(total, _margin(m, frame),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_additivity_regression_with_nas():
+    rng = np.random.default_rng(7)
+    n = 200
+    x0 = rng.normal(size=n).astype(np.float32)
+    x0[::11] = np.nan
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = (2 * np.nan_to_num(x0) - x1
+         + rng.normal(scale=0.2, size=n)).astype(np.float32)
+    fr = h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
+    m = GBM(ntrees=5, max_depth=3, seed=1).train(
+        y="y", training_frame=fr)
+    contrib = m.predict_contributions(fr)
+    total = sum(contrib.vec(c).to_numpy() for c in contrib.names)
+    np.testing.assert_allclose(total, _margin(m, fr),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_additivity_drf(frame):
+    m = DRF(ntrees=6, max_depth=3, seed=5).train(
+        y="y", training_frame=frame)
+    contrib = m.predict_contributions(frame)
+    total = sum(contrib.vec(c).to_numpy() for c in contrib.names)
+    np.testing.assert_allclose(total, _margin(m, frame),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_noise_feature_gets_small_contributions(frame):
+    m = GBM(ntrees=10, max_depth=4, seed=3).train(
+        y="y", training_frame=frame)
+    contrib = m.predict_contributions(frame)
+    mean_abs = {n: float(np.abs(contrib.vec(n).to_numpy()).mean())
+                for n in ("x0", "x1", "x2")}
+    assert mean_abs["x2"] < 0.3 * mean_abs["x0"]
+
+
+def test_multinomial_rejected():
+    rng = np.random.default_rng(2)
+    n = 120
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    fr = h2o.Frame.from_arrays({"x": x, "y": y})
+    m = GBM(ntrees=2, max_depth=2, seed=0).train(
+        y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="binomial and regression"):
+        m.predict_contributions(fr)
+
+
+# -- brute-force Shapley cross-check ----------------------------------------
+
+def _expvalue(sp, sf, sb, nl, val, cov, binned_row, na_bin, j, S):
+    """Cover-weighted conditional expectation E[f(x) | x_S] of the
+    path-dependent perturbation — the quantity TreeSHAP is exact for."""
+    if not sp[j]:
+        return float(val[j])
+    d = int(sf[j])
+    lc, rc = 2 * j + 1, 2 * j + 2
+    if d in S:
+        b = binned_row[d]
+        go_right = (~nl[j]) if b == na_bin else (b > sb[j])
+        return _expvalue(sp, sf, sb, nl, val, cov, binned_row, na_bin,
+                         rc if go_right else lc, S)
+    cj = max(float(cov[j]), 1e-12)
+    return (float(cov[lc]) / cj * _expvalue(
+        sp, sf, sb, nl, val, cov, binned_row, na_bin, lc, S)
+        + float(cov[rc]) / cj * _expvalue(
+        sp, sf, sb, nl, val, cov, binned_row, na_bin, rc, S))
+
+
+def test_matches_bruteforce_shapley():
+    rng = np.random.default_rng(17)
+    n = 150
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+    y = (x0 + 0.5 * x1 * x2
+         + rng.normal(scale=0.2, size=n)).astype(np.float32)
+    fr = h2o.Frame.from_arrays({"x0": x0, "x1": x1, "x2": x2, "y": y})
+    m = GBM(ntrees=2, max_depth=3, seed=9).train(
+        y="y", training_frame=fr)
+    contrib = m.predict_contributions(fr)
+
+    from h2o_kubernetes_tpu.models.tree.binning import apply_bins
+    import jax.numpy as jnp
+
+    X = m._design_matrix(fr)
+    binned = np.asarray(apply_bins(X, m._edges, m._enum_mask,
+                                   m.bin_spec.na_bin))[: fr.nrows]
+    F = 3
+    import math
+
+    fact = [math.factorial(k) for k in range(F + 1)]
+    trees = {f: np.asarray(getattr(m.trees, f))
+             for f in ("split_feat", "split_bin", "na_left", "is_split",
+                       "value", "cover")}
+    rows = [0, 3, 17, 42]
+    for r in rows:
+        phi = np.zeros(F)
+        for t in range(trees["split_feat"].shape[0]):
+            a = (trees["is_split"][t], trees["split_feat"][t],
+                 trees["split_bin"][t], trees["na_left"][t],
+                 trees["value"][t], trees["cover"][t])
+            for d in range(F):
+                others = [f for f in range(F) if f != d]
+                for k in range(F):
+                    for S in itertools.combinations(others, k):
+                        wgt = fact[k] * fact[F - k - 1] / fact[F]
+                        with_d = _expvalue(*a, binned[r],
+                                           m.bin_spec.na_bin, 0,
+                                           set(S) | {d})
+                        without = _expvalue(*a, binned[r],
+                                            m.bin_spec.na_bin, 0,
+                                            set(S))
+                        phi[d] += wgt * (with_d - without)
+        got = np.array([contrib.vec(f"x{i}").to_numpy()[r]
+                        for i in range(F)])
+        np.testing.assert_allclose(got, phi, rtol=1e-4, atol=1e-4)
+
+
+# -- partial dependence ------------------------------------------------------
+
+def test_partial_plot_monotone_feature(frame):
+    m = GBM(ntrees=8, max_depth=3, seed=3).train(
+        y="y", training_frame=frame)
+    (pd_x0,) = m.partial_plot(frame, ["x0"], nbins=8)
+    assert pd_x0.names == ["x0", "mean_response", "stddev_response",
+                           "std_error_mean_response"]
+    mr = pd_x0.vec("mean_response").to_numpy()
+    # y ~ 1.5*x0 ... : mean response must rise with x0
+    assert mr[-1] > mr[0] + 0.2
+
+
+def test_partial_plot_enum_column():
+    rng = np.random.default_rng(23)
+    n = 300
+    g = np.array(["p", "q", "r"])[rng.integers(0, 3, n)]
+    x = rng.normal(size=n).astype(np.float32)
+    logit = (g == "p") * 2.0 - 1.0 + 0.2 * x
+    fr = h2o.Frame.from_arrays({
+        "g": g, "x": x,
+        "y": np.where(logit + rng.normal(scale=0.3, size=n) > 0,
+                      "yes", "no")})
+    m = GBM(ntrees=8, max_depth=3, seed=3).train(
+        y="y", training_frame=fr)
+    (pd_g,) = m.partial_plot(fr, ["g"])
+    assert pd_g.nrows == 3                 # one row per level
+    assert pd_g.vec("g").domain == ["p", "q", "r"]
+    mr = dict(zip(["p", "q", "r"], pd_g.vec("mean_response").to_numpy()))
+    assert mr["p"] > mr["q"] + 0.2         # level p dominates the logit
