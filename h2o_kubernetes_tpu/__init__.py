@@ -9,6 +9,7 @@ See SURVEY.md for the reference blueprint this is built against.
 """
 
 from .automl import AutoML, Job, Leaderboard, jobs
+from .config import get_config, set_config
 from .grid import GridSearch, H2OGridSearch
 from .diagnostics import device_memory, log, profile, timeline
 from .frame import Frame, Vec, import_file, parse_setup

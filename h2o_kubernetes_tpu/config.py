@@ -1,0 +1,96 @@
+"""Configuration tiers — the reference's flag system, TPU-shaped.
+
+Reference (SURVEY.md §5.6): three tiers — CRD spec (declarative),
+CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
+`sys.ai.h2o.*` system properties, `H2O_KUBERNETES_*` env vars). Here:
+
+1. CRD spec → the C++ operator (native/deployment/crd.*) — declarative.
+2. Env vars (`H2O_TPU_*`) → read once at import, listed below.
+3. Programmatic `set_config(key, value)` — the in-process tier, wins
+   over env.
+
+| env var | default | meaning |
+|---|---|---|
+| H2O_TPU_LOG_LEVEL | WARNING | package logger level (water/util/Log) |
+| H2O_TPU_HIST_IMPL | auto | histogram kernel: auto/pallas/segment |
+| H2O_TPU_NBINS | 256 | default tree-learner bin count |
+| H2O_TPU_COORDINATOR | — | jax.distributed coordinator (runtime/mesh) |
+| H2O_TPU_NUM_PROCESSES | 1 | multi-host process count (runtime/mesh) |
+| H2O_TPU_PROCESS_ID | 0 | this host's process id (runtime/mesh) |
+
+The last three are the operator's injection contract and are consumed
+directly by `runtime/mesh.initialize_distributed`.
+
+Caveat: `hist_impl` is read when a training program is TRACED; XLA
+executables already compiled for a shape keep the kernel they were
+traced with, so changing it mid-process affects new shapes only (the
+usual jit-static-argument semantics).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+__all__ = ["get_config", "set_config", "CONFIG"]
+
+_DEFAULTS: dict[str, Any] = {
+    "log_level": "WARNING",
+    "hist_impl": "auto",
+    "nbins": 256,
+}
+
+_ENV_KEYS = {
+    "log_level": "H2O_TPU_LOG_LEVEL",
+    "hist_impl": "H2O_TPU_HIST_IMPL",
+    "nbins": "H2O_TPU_NBINS",
+}
+
+CONFIG: dict[str, Any] = {}
+
+
+def _load() -> None:
+    for key, default in _DEFAULTS.items():
+        raw = os.environ.get(_ENV_KEYS[key])
+        if raw is None:
+            CONFIG.setdefault(key, default)
+            continue
+        CONFIG[key] = type(default)(raw) if not isinstance(default, str) \
+            else raw
+
+
+def get_config(key: str) -> Any:
+    if key not in _DEFAULTS:
+        raise KeyError(f"unknown config key '{key}' "
+                       f"(known: {sorted(_DEFAULTS)})")
+    return CONFIG[key]
+
+
+def set_config(key: str, value: Any) -> None:
+    """Programmatic tier — applies immediately (and re-levels the
+    package logger for log_level)."""
+    if key not in _DEFAULTS:
+        raise KeyError(f"unknown config key '{key}' "
+                       f"(known: {sorted(_DEFAULTS)})")
+    if key == "hist_impl" and value not in ("auto", "pallas", "segment"):
+        raise ValueError(f"hist_impl must be auto/pallas/segment, "
+                         f"got '{value}'")
+    if key == "nbins":
+        value = int(value)
+        if not 4 <= value <= 256:
+            raise ValueError("nbins must be in [4, 256]")
+    if key == "log_level":
+        # validate BEFORE assignment so CONFIG never holds a bad level
+        level = getattr(logging, str(value).upper(), None)
+        if not isinstance(level, int):
+            raise ValueError(f"unknown log level '{value}'")
+        CONFIG[key] = value
+        from .diagnostics import log
+
+        log.setLevel(level)
+        return
+    CONFIG[key] = value
+
+
+_load()

@@ -64,7 +64,10 @@ if not log.handlers:
     _h.setFormatter(logging.Formatter(
         "%(asctime)s %(levelname).4s %(name)s: %(message)s"))
     log.addHandler(_h)
-    log.setLevel(logging.WARNING)
+    from .config import get_config
+
+    log.setLevel(getattr(logging, str(get_config("log_level")).upper(),
+                         logging.WARNING))
 
 
 @contextlib.contextmanager

@@ -47,17 +47,21 @@ def roc_auc(y_true, score, w=None, exact: bool | None = None) -> float:
 
 
 @jax.jit
-def _auc_hist_impl(y, s, wt):
+def _score_hist(y, s, wt):
+    """Shared score-binning pass: [NB, 2] (pos, neg) mass per bin +
+    (smin, smax, bad). `bad` flags NaN on a live row — callers must
+    surface it as NaN metrics, not plausible numbers.
+
+    NaN scores are parked at 0 with the NaN→bad flag set (nan_to_num
+    would also finitize ±inf); ±inf live scores (diverged model) must
+    not set the bin scale — they'd collapse every finite score into
+    bin 0 — so the finite range is binned and infinities pin to the
+    end bins (= the exact-path rank)."""
     from .ops.histogram import build_histogram
 
     live = wt > 0
     bad = jnp.any(live & (jnp.isnan(y) | jnp.isnan(s)))
     y = jnp.where(live, jnp.nan_to_num(y), 0.0)
-    # NaN→0 only (nan_to_num would also finitize ±inf and defeat the
-    # pinning below); ±inf live scores (diverged model) must not set
-    # the bin scale — they'd collapse every finite score into bin 0;
-    # bin the finite range and pin infinities to the end bins (= the
-    # exact-path rank)
     sx = jnp.where(live & ~jnp.isnan(s), s, 0.0)
     fin = live & jnp.isfinite(sx)
     smin = jnp.min(jnp.where(fin, sx, jnp.inf))
@@ -70,6 +74,12 @@ def _auc_hist_impl(y, s, wt):
     # per-bin (Σ y·w, Σ (1-y)·w, Σ w) in one kernel pass
     hist = build_histogram(idx[:, None], rel, y, 1.0 - y, wt,
                            1, _AUC_BINS)[0, 0]
+    return hist[:, :2], smin, smax, bad
+
+
+@jax.jit
+def _auc_hist_impl(y, s, wt):
+    hist, _, _, bad = _score_hist(y, s, wt)
     posb, negb = hist[:, 0], hist[:, 1]
     below = jnp.cumsum(negb) - negb
     P, N = jnp.sum(posb), jnp.sum(negb)
@@ -100,6 +110,95 @@ def _auc_impl(y, s, wt):
     auc = jnp.sum(posw * (below + 0.5 * tied)) / \
         (jnp.sum(posw) * jnp.sum(negw))
     return jnp.where(bad, jnp.nan, auc)
+
+
+def binomial_stats(y_true, p1, w=None) -> dict:
+    """Threshold-derived binomial metrics from one score histogram —
+    the reference's ModelMetricsBinomial/AUC2 surface [U3]: pr_auc,
+    Gini, max-F1 (+ its threshold), max-accuracy, mean_per_class_error
+    at the F1-optimal threshold, and the confusion counts there.
+
+    One device histogram pass (4096 bins of p1 with pos/neg mass), then
+    host-side cumulative sweeps over bin-edge thresholds — exactly how
+    hex/AUC2 computes its threshold tables from 400 bins.
+    """
+    y = jnp.asarray(y_true).astype(jnp.float32).ravel()
+    s = jnp.asarray(p1).astype(jnp.float32).ravel()
+    wt = jnp.ones_like(y) if w is None else \
+        jnp.asarray(w).astype(jnp.float32).ravel()
+    hist, smin, smax, bad = (np.asarray(a) for a in _score_hist(y, s, wt))
+    if bool(bad):
+        # NaN on a live row: every derived metric is NaN, same as
+        # roc_auc — finite-looking stats would mask a diverged model
+        nan = float("nan")
+        return {k: nan for k in
+                ("auc", "gini", "pr_auc", "f1", "max_f1_threshold",
+                 "accuracy", "mean_per_class_error")} | {
+                "confusion": np.full((2, 2), nan)}
+    pos, neg = hist[:, 0].astype(np.float64), hist[:, 1].astype(
+        np.float64)
+    P, N = pos.sum(), neg.sum()
+    if P == 0 or N == 0:
+        raise ValueError("binomial metrics need both classes present")
+    # threshold k: predict positive when the score bin >= k
+    tp = np.cumsum(pos[::-1])[::-1]
+    fp = np.cumsum(neg[::-1])[::-1]
+    fn = P - tp
+    tn = N - fp
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = np.where(tp + fp > 0, tp / (tp + fp), 1.0)
+        recall = tp / P
+        f1 = np.where(precision + recall > 0,
+                      2 * precision * recall / (precision + recall), 0.0)
+    acc = (tp + tn) / (P + N)
+    k_f1 = int(np.argmax(f1))
+    span = max(float(smax) - float(smin), 1e-30)
+    thr = float(smin) + k_f1 * span / (_AUC_BINS - 1)
+    # PR AUC: trapezoid over (recall, precision) with the conventional
+    # (0, 1) endpoint appended (an "above max score" threshold) — the
+    # same convention sklearn's precision_recall_curve uses
+    r_ext = np.append(recall, 0.0)
+    p_ext = np.append(precision, 1.0)
+    order = np.argsort(r_ext)
+    r_s, p_s = r_ext[order], p_ext[order]
+    pr_auc = float(np.trapezoid(p_s, r_s)) if hasattr(np, "trapezoid") \
+        else float(np.trapz(p_s, r_s))
+    auc = float(_auc_from_hist(pos, neg))
+    return {
+        "auc": auc,
+        "gini": 2 * auc - 1,
+        "pr_auc": pr_auc,
+        "f1": float(f1[k_f1]),
+        "max_f1_threshold": thr,
+        "accuracy": float(acc.max()),
+        "mean_per_class_error": float(
+            0.5 * (fn[k_f1] / P + fp[k_f1] / N)),
+        "confusion": np.array([[tn[k_f1], fp[k_f1]],
+                               [fn[k_f1], tp[k_f1]]]),
+    }
+
+
+def _auc_from_hist(pos, neg):
+    below = np.cumsum(neg) - neg
+    return (pos * (below + 0.5 * neg)).sum() / (pos.sum() * neg.sum())
+
+
+def confusion_matrix(y_true, p1, threshold: float | None = None,
+                     w=None) -> np.ndarray:
+    """2x2 [[TN, FP], [FN, TP]] (rows actual, cols predicted) at the
+    given threshold — F1-optimal when None, like the reference."""
+    if threshold is None:
+        return binomial_stats(y_true, p1, w=w)["confusion"]
+    y = np.asarray(y_true).ravel()
+    p = np.asarray(p1).ravel()
+    wt = np.ones_like(p) if w is None else np.asarray(w).ravel()
+    pred = p >= threshold
+    pos = y > 0
+    tp = float(wt[pred & pos].sum())
+    fp = float(wt[pred & ~pos].sum())
+    fn = float(wt[~pred & pos].sum())
+    tn = float(wt[~pred & ~pos].sum())
+    return np.array([[tn, fp], [fn, tp]])
 
 
 def logloss(y_true, p, eps: float = 1e-7, w=None) -> float:

@@ -235,9 +235,14 @@ class Model:
                     f"partial_plot: '{col}' is not a model feature")
             j = self.feature_names.index(col)
             v = frame.vec(col)
-            if v.is_enum():
-                grid = list(range(v.cardinality()))
-                labels = list(v.domain or [])
+            tdom = self.feature_domains.get(col)
+            if tdom is not None:
+                # grid/labels in TRAINING domain space — the design
+                # matrix is remapped to it, so sweeping the scoring
+                # frame's codes would mislabel every row when domains
+                # differ
+                grid = list(range(len(tdom)))
+                labels = list(tdom)
             else:
                 x = v.to_numpy()
                 finite = x[~np.isnan(x)]
@@ -274,6 +279,28 @@ class Model:
             out_frames.append(pd_out)
         return out_frames
 
+    def confusion_matrix(self, frame: Frame, y: str,
+                         threshold: float | None = None) -> np.ndarray:
+        """Confusion matrix (rows actual, cols predicted). Binomial:
+        2x2 at `threshold` (F1-optimal when None, like the reference's
+        default); multinomial: KxK argmax counts."""
+        yv = frame.vec(y)
+        preds = self.predict_raw(frame)
+        if self.nclasses == 2:
+            codes = yv.to_numpy()
+            ok = codes >= 0 if yv.is_enum() else ~np.isnan(codes)
+            return M.confusion_matrix(codes[ok], preds[ok][:, 1],
+                                      threshold=threshold)
+        if self.nclasses > 2:
+            codes = yv.to_numpy()
+            ok = codes >= 0
+            lab = preds[ok].argmax(axis=1)
+            K = self.nclasses
+            cm = np.zeros((K, K))
+            np.add.at(cm, (codes[ok].astype(int), lab), 1.0)
+            return cm
+        raise ValueError("confusion_matrix needs a classification model")
+
     def model_performance(self, frame: Frame, y: str) -> dict[str, float]:
         yv = frame.vec(y)
         out = self.predict_raw(frame)
@@ -297,11 +324,21 @@ def score_predictions(nclasses: int, distribution: str,
                          "(no rows with a valid response)")
     if nclasses == 2:
         p1 = preds[:, 1]
-        return {
+        out = {
             "auc": M.roc_auc(y_true, p1),
             "logloss": M.logloss(y_true, p1),
             "rmse": M.rmse(y_true, p1),
         }
+        try:
+            # threshold table metrics (ModelMetricsBinomial surface);
+            # degenerate single-class holdouts keep the basic metrics
+            stats = M.binomial_stats(y_true, p1)
+            out.update({k: stats[k] for k in
+                        ("pr_auc", "gini", "f1", "max_f1_threshold",
+                         "mean_per_class_error")})
+        except ValueError:
+            pass
+        return out
     if nclasses > 2:
         return {
             "logloss": M.multinomial_logloss(y_true, preds),

@@ -245,6 +245,10 @@ class GBM:
         from .cv import CVArgs
 
         self.cv_args = CVArgs.pop(kw)
+        if "nbins" not in kw:               # env/config default tier
+            from ..config import get_config
+
+            kw["nbins"] = get_config("nbins")
         self.params = GBMParams(**kw)
 
     def train(self, y: str, training_frame: Frame,

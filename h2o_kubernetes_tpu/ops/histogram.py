@@ -228,6 +228,17 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
 
 def resolve_impl(impl: str) -> str:
     if impl == "auto":
+        from ..config import get_config
+
+        cfg = get_config("hist_impl")     # env/programmatic tier
+        if cfg != "auto":
+            if cfg not in ("segment", "pallas"):
+                # the env tier (H2O_TPU_HIST_IMPL) is unvalidated at
+                # load — a typo must not silently demote the kernel
+                raise ValueError(
+                    f"H2O_TPU_HIST_IMPL/config hist_impl '{cfg}' is not "
+                    "one of auto/segment/pallas")
+            return cfg
         return "pallas" if jax.default_backend() == "tpu" else "segment"
     if impl not in ("segment", "pallas"):
         raise ValueError(f"unknown histogram impl '{impl}'")

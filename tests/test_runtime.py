@@ -50,3 +50,77 @@ def test_submesh(mesh8):
         x = np.arange(8, dtype=np.float32)
         out = doall(lambda s: jnp.sum(s), shard_rows(x))
         assert float(out) == 28.0
+
+
+# -- config tiers ------------------------------------------------------------
+
+def test_config_env_and_programmatic(monkeypatch):
+    import importlib
+
+    import h2o_kubernetes_tpu.config as C
+
+    monkeypatch.setenv("H2O_TPU_NBINS", "64")
+    monkeypatch.setenv("H2O_TPU_LOG_LEVEL", "INFO")
+    C.CONFIG.clear()
+    C._load()
+    assert C.get_config("nbins") == 64
+    assert C.get_config("log_level") == "INFO"
+    # programmatic tier wins
+    C.set_config("nbins", 32)
+    assert C.get_config("nbins") == 32
+    with pytest.raises(KeyError):
+        C.get_config("no_such_key")
+    with pytest.raises(ValueError):
+        C.set_config("hist_impl", "cuda")
+    with pytest.raises(ValueError):
+        C.set_config("nbins", 3)
+    # restore defaults for the rest of the suite
+    monkeypatch.delenv("H2O_TPU_NBINS")
+    monkeypatch.delenv("H2O_TPU_LOG_LEVEL")
+    C.CONFIG.clear()
+    C._load()
+
+
+def test_config_nbins_flows_into_gbm(monkeypatch):
+    import h2o_kubernetes_tpu.config as C
+    from h2o_kubernetes_tpu.models import GBM
+
+    C.set_config("nbins", 32)
+    try:
+        assert GBM(ntrees=1).params.nbins == 32
+        assert GBM(ntrees=1, nbins=16).params.nbins == 16   # explicit wins
+    finally:
+        C.set_config("nbins", 256)
+
+
+def test_config_hist_impl_flows_into_resolver():
+    import h2o_kubernetes_tpu.config as C
+    from h2o_kubernetes_tpu.ops.histogram import resolve_impl
+
+    C.set_config("hist_impl", "segment")
+    try:
+        assert resolve_impl("auto") == "segment"
+        assert resolve_impl("pallas") == "pallas"   # explicit wins
+    finally:
+        C.set_config("hist_impl", "auto")
+
+
+def test_bad_env_hist_impl_is_loud():
+    import h2o_kubernetes_tpu.config as C
+    from h2o_kubernetes_tpu.ops.histogram import resolve_impl
+
+    C.CONFIG["hist_impl"] = "pallsa"       # env tier typo
+    try:
+        with pytest.raises(ValueError, match="pallsa"):
+            resolve_impl("auto")
+    finally:
+        C.CONFIG["hist_impl"] = "auto"
+
+
+def test_bad_log_level_rejected_before_assignment():
+    import h2o_kubernetes_tpu.config as C
+
+    before = C.get_config("log_level")
+    with pytest.raises(ValueError, match="log level"):
+        C.set_config("log_level", "verbose")
+    assert C.get_config("log_level") == before

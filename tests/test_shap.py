@@ -195,3 +195,31 @@ def test_partial_plot_enum_column():
     assert pd_g.vec("g").domain == ["p", "q", "r"]
     mr = dict(zip(["p", "q", "r"], pd_g.vec("mean_response").to_numpy()))
     assert mr["p"] > mr["q"] + 0.2         # level p dominates the logit
+
+
+def test_partial_plot_enum_uses_training_domain():
+    """Scoring frame missing a training level must still sweep (and
+    label) the TRAINING domain, not the scoring frame's code space."""
+    rng = np.random.default_rng(29)
+    n = 300
+    g = np.array(["p", "q", "r"])[rng.integers(0, 3, n)]
+    x = rng.normal(size=n).astype(np.float32)
+    logit = (g == "p") * 2.0 - 1.0 + 0.2 * x
+    fr = h2o.Frame.from_arrays({
+        "g": g, "x": x,
+        "y": np.where(logit + rng.normal(scale=0.3, size=n) > 0,
+                      "yes", "no")})
+    m = GBM(ntrees=8, max_depth=3, seed=3).train(
+        y="y", training_frame=fr)
+    sub = np.flatnonzero(g != "p")           # no 'p' rows at all
+    score_fr = fr.select_rows(sub)
+    # select_rows keeps the domain; rebuild with a narrowed one
+    score_fr = h2o.Frame.from_arrays({
+        "g": np.asarray(g[sub]), "x": x[sub],
+        "y": np.asarray(["yes"] * len(sub))})
+    assert score_fr.vec("g").domain == ["q", "r"]
+    (pd_g,) = m.partial_plot(score_fr, ["g"])
+    assert pd_g.vec("g").domain == ["p", "q", "r"]
+    assert pd_g.nrows == 3
+    mr = dict(zip(["p", "q", "r"], pd_g.vec("mean_response").to_numpy()))
+    assert mr["p"] > mr["q"] + 0.2           # 'p' still dominates
