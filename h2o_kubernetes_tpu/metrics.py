@@ -20,6 +20,33 @@ _AUC_BINS = 4096        # reference AUC2 uses 400 bins; 4096 is ~free here
 _AUC_EXACT_MAX = 65536  # above this, the histogram path takes over
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
+def _pad_jit(y, s, wt, pad):
+    # jitted (NOT eager) because the inputs are often committed
+    # multi-device arrays — eager sharded ops are the XLA:CPU
+    # rendezvous-flake pattern purged from the training paths
+    return (jnp.concatenate([y, jnp.zeros(pad, y.dtype)]),
+            jnp.concatenate([s, jnp.zeros(pad, s.dtype)]),
+            jnp.concatenate([wt, jnp.zeros(pad, wt.dtype)]))
+
+
+def _pad_pow2(y, s, wt):
+    """Pad metric inputs to the next power of two with w=0 rows.
+
+    Every distinct holdout length would otherwise compile a fresh XLA
+    executable for the sort/histogram jits — grids, CV, and AutoML
+    score hundreds of slightly-different-sized frames, and per-shape
+    compiles dominated the CPU test-suite wall clock. All metric jits
+    ignore w=0 rows, so bucketing shapes is free (the tiny pad program
+    still compiles per shape, but in milliseconds, not seconds).
+    """
+    n = y.shape[0]
+    m = 1 << max(n - 1, 1).bit_length()
+    if m == n:
+        return y, s, wt
+    return _pad_jit(y, s, wt, m - n)
+
+
 def roc_auc(y_true, score, w=None, exact: bool | None = None) -> float:
     """AUC with average-rank tie handling (Mann-Whitney U).
 
@@ -41,6 +68,7 @@ def roc_auc(y_true, score, w=None, exact: bool | None = None) -> float:
         jnp.asarray(w).astype(jnp.float32).ravel()
     if exact is None:
         exact = y.shape[0] <= _AUC_EXACT_MAX
+    y, s, wt = _pad_pow2(y, s, wt)
     if exact:
         return float(_auc_impl(y, s, wt))
     return float(_auc_hist_impl(y, s, wt))
@@ -126,6 +154,7 @@ def binomial_stats(y_true, p1, w=None) -> dict:
     s = jnp.asarray(p1).astype(jnp.float32).ravel()
     wt = jnp.ones_like(y) if w is None else \
         jnp.asarray(w).astype(jnp.float32).ravel()
+    y, s, wt = _pad_pow2(y, s, wt)
     hist, smin, smax, bad = (np.asarray(a) for a in _score_hist(y, s, wt))
     if bool(bad):
         # NaN on a live row: every derived metric is NaN, same as

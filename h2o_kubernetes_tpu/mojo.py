@@ -112,6 +112,12 @@ def export_mojo(model, path: str) -> str:
         meta["drop_first"] = d.drop_first
         arrays["means"] = _np(d.means)
         arrays["stds"] = _np(d.stds)
+    elif algo == "isolationforest":
+        meta["max_depth"] = model.params.max_depth
+        meta["ntrees"] = model.ntrees
+        meta["sample_size_effective"] = int(model.sample_size_effective)
+        for f in ("split_feat", "split_val", "is_split", "count"):
+            arrays[f"iso_{f}"] = _np(getattr(model.trees, f))
     else:
         raise ValueError(f"mojo export not supported for algo '{algo}'")
 
@@ -147,7 +153,44 @@ class MojoModel:
     # -- feature matrix from a dict of columns ------------------------------
 
     def _matrix(self, data) -> np.ndarray:
-        """data: mapping name -> array (numeric values or string levels)."""
+        """data: mapping name -> array (numeric values or string levels),
+        or a Frame (columns decoded to raw values first — scoring-frame
+        enum codes are NOT assumed to share the training domain)."""
+        if hasattr(data, "vec") and hasattr(data, "names"):
+            decoded = {}
+            tdoms = self.meta["feature_domains"]
+            for n in self.feature_names:
+                if n not in data.names:
+                    raise ValueError(f"missing feature column '{n}'")
+                v = data.vec(n)
+                # kind mismatches raise exactly like the in-process
+                # Model._design_matrix — silently treating numerics as
+                # category codes (or vice versa) scores garbage
+                if tdoms.get(n) is not None and not v.is_enum():
+                    raise ValueError(
+                        f"column '{n}' was categorical at training time "
+                        f"but is {v.kind} in the scoring frame")
+                if tdoms.get(n) is None and v.is_enum():
+                    raise ValueError(
+                        f"column '{n}' was numeric at training time "
+                        "but is categorical in the scoring frame")
+                if v.is_enum():
+                    dom = np.array(list(v.domain or []) + [None],
+                                   dtype=object)
+                    codes = v.to_numpy()
+                    decoded[n] = dom[np.where(codes < 0, len(dom) - 1,
+                                              codes)]
+                elif v.kind == "time":
+                    # reproduce as_float() f32 rounding (rel + f32
+                    # origin) — training bin edges were fit on those
+                    # values, and exact float64 epochs can land a
+                    # boundary timestamp in a different bin
+                    ms = v.to_numpy()
+                    rel = (ms - v.origin).astype(np.float32)
+                    decoded[n] = rel + np.float32(v.origin)
+                else:
+                    decoded[n] = v.to_numpy()
+            data = decoded
         cols = []
         doms = self.meta["feature_domains"]
         for name in self.feature_names:
@@ -179,7 +222,44 @@ class MojoModel:
             return self._predict_naivebayes(X)
         if self.algo == "pca":
             return self._predict_pca(X)
+        if self.algo == "isolationforest":
+            return self._predict_isolationforest(X)
         raise ValueError(self.algo)
+
+    def _predict_isolationforest(self, X):
+        """[n, 2] (anomaly score, mean path length) — numpy mirror of
+        IsolationForestModel._score_matrix (models/isolationforest.py)."""
+        m = self.meta
+        sf = self.arrays["iso_split_feat"]       # [T, N]
+        sv = self.arrays["iso_split_val"]
+        sp = self.arrays["iso_is_split"]
+        cnt = self.arrays["iso_count"]
+        Xf = np.nan_to_num(X.astype(np.float32))
+        n = Xf.shape[0]
+
+        def c_avg(x):
+            x = np.maximum(x, 2.0)
+            return (2.0 * (np.log(x - 1.0) + 0.5772156649)
+                    - 2.0 * (x - 1.0) / x)
+
+        total = np.zeros(n, dtype=np.float64)
+        for t in range(m["ntrees"]):
+            node = np.zeros(n, dtype=np.int64)
+            depth = np.zeros(n, dtype=np.float64)
+            for _ in range(m["max_depth"]):
+                f = sf[t][node]
+                v = sv[t][node]
+                split = sp[t][node]
+                rowval = Xf[np.arange(n), np.maximum(f, 0)]
+                child = 2 * node + 1 + (rowval >= v).astype(np.int64)
+                node = np.where(split, child, node)
+                depth += split.astype(np.float64)
+            leaf_n = cnt[t][node]
+            total += depth + np.where(leaf_n > 1.0, c_avg(leaf_n), 0.0)
+        mean_len = total / m["ntrees"]
+        score = np.exp2(-mean_len / c_avg(
+            np.float64(m["sample_size_effective"])))
+        return np.stack([score, mean_len], axis=1).astype(np.float32)
 
     # -- word2vec accessors (no row scoring; embeddings ARE the model) ------
 

@@ -289,3 +289,69 @@ class TestMojoRound2:
                                    rtol=1e-6)
         syn = mj.find_synonyms("king", count=3)
         assert len(syn) == 3 and "king" not in syn
+
+
+def test_isolationforest_mojo_roundtrip(tmp_path):
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import IsolationForest
+
+    rng = np.random.default_rng(3)
+    n = 300
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    X[:5] += 6.0                              # planted anomalies
+    fr = h2o.Frame.from_arrays(
+        {f"x{i}": X[:, i] for i in range(4)})
+    m = IsolationForest(ntrees=20, seed=1).train(training_frame=fr)
+    in_proc = m.predict(fr)
+    p = str(tmp_path / "iso.mojo")
+    h2o.export_mojo(m, p)
+    mm = h2o.import_mojo(p)
+    out = mm.predict(fr)
+    np.testing.assert_allclose(out[:, 0],
+                               in_proc.vec("predict").to_numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(out[:, 1],
+                               in_proc.vec("mean_length").to_numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # anomalies score higher than the bulk
+    assert out[:5, 0].min() > np.median(out[5:, 0])
+
+
+def test_mojo_predict_accepts_frame_directly(tmp_path, mesh8):
+    """MojoModel.predict(frame) decodes enum codes through the SCORING
+    frame's own domain (h2o genmodel takes raw values, not codes)."""
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+
+    fr = _frame()
+    m = GBM(ntrees=4, max_depth=3, seed=2).train(
+        y="y", training_frame=fr)
+    p = str(tmp_path / "gbm2.mojo")
+    h2o.export_mojo(m, p)
+    mj = h2o.import_mojo(p)
+    got = mj.predict(fr)
+    np.testing.assert_allclose(got, m.predict_raw(fr),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_mojo_frame_kind_mismatch_raises(tmp_path, mesh8):
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+
+    fr = _frame()
+    m = GBM(ntrees=3, max_depth=2, seed=2).train(
+        y="y", training_frame=fr)
+    p = str(tmp_path / "gbm3.mojo")
+    h2o.export_mojo(m, p)
+    mj = h2o.import_mojo(p)
+    # swap the enum feature for a numeric column of the same name
+    enum_cols = [n for n in m.feature_names
+                 if m.feature_domains.get(n) is not None]
+    assert enum_cols, "fixture needs an enum feature"
+    bad = {n: fr[n] for n in fr.names}
+    import numpy as np
+    bad[enum_cols[0]] = h2o.Vec.from_numpy(
+        np.zeros(fr.nrows, dtype=np.float32), enum_cols[0])
+    bad_fr = h2o.Frame(bad)
+    with pytest.raises(ValueError, match="categorical at training"):
+        mj.predict(bad_fr)
