@@ -340,9 +340,21 @@ def score_predictions(nclasses: int, distribution: str,
             pass
         return out
     if nclasses > 2:
+        lab = preds.argmax(axis=1)
+        yc = np.asarray(y_true).astype(int)
+        # mean per-class error (reference ModelMetricsMultinomial):
+        # average of 1 - recall_k over classes present in the holdout
+        errs = [float((lab[yc == k] != k).mean())
+                for k in range(nclasses) if np.any(yc == k)]
+        # macro one-vs-rest AUC (reference multinomial auc_type=MACRO_OVR)
+        aucs = [M.roc_auc((yc == k).astype(np.float32), preds[:, k])
+                for k in range(nclasses) if np.any(yc == k)]
         return {
             "logloss": M.multinomial_logloss(y_true, preds),
-            "accuracy": M.accuracy(y_true, preds.argmax(axis=1)),
+            "accuracy": M.accuracy(y_true, lab),
+            "mean_per_class_error": float(np.mean(errs)) if errs
+            else float("nan"),
+            "auc": float(np.mean(aucs)) if aucs else float("nan"),
         }
     dist = "poisson" if distribution == "poisson" else "gaussian"
     return {

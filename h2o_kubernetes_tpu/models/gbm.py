@@ -32,7 +32,8 @@ from ..runtime.mesh import global_mesh
 from .base import Model, TrainData, resolve_xy
 from .tree.binning import BinSpec, apply_bins, apply_bins_jit, fit_bins
 from .tree.core import (BoostParams, Tree, TreeParams, _grad_hess,
-                        boost_trees, grow_tree, predict_tree)
+                        boost_trees, descend_tree, grow_tree,
+                        predict_tree)
 
 
 @dataclass
@@ -73,6 +74,10 @@ _jit_min_pos = jax.jit(
 # w sum) — separate float() syncs each pay a full tunnel round trip
 _jit_init_sums = jax.jit(
     lambda y, w: (jnp.sum(w), jnp.sum(y * w)))
+# max histogram work units (rows·F·nbins·2^depth summed over a chunk's
+# trees) per compiled dispatch — see the chunking comment in train()
+_DISPATCH_BUDGET = 3e12
+
 _jit_class_sums = jax.jit(
     lambda y, w, K: jax.ops.segment_sum(
         w, jnp.where(w > 0, jnp.nan_to_num(y), K).astype(jnp.int32),
@@ -129,6 +134,18 @@ def _stack_predict(trees: Tree, binned, max_depth: int, n_bins: int):
     init = jnp.zeros(binned.shape[0], dtype=jnp.float32)
     total, _ = lax.scan(body, init, trees)
     return total
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _stack_leaf_nodes(trees: Tree, binned, max_depth: int, n_bins: int):
+    """[T, rows] resting heap node index per tree (leaf assignment) —
+    shares descend_tree with predict so split semantics can't drift."""
+
+    def body(_, tree):
+        return None, descend_tree(tree, binned, max_depth, n_bins)
+
+    _, nodes = lax.scan(body, None, trees)
+    return nodes
 
 
 class GBMModel(Model):
@@ -196,6 +213,42 @@ class GBMModel(Model):
         if d in ("poisson", "gamma", "tweedie"):
             return jnp.exp(m)
         return m
+
+    def predict_leaf_node_assignment(self, frame: Frame,
+                                     type: str = "Node_ID") -> Frame:
+        """Per-row resting leaf per tree (h2o predict_leaf_node_assignment
+        [U3]): one column per tree (`T1..Tk`, class-suffixed for
+        multinomial). `Node_ID` gives dense-heap indices; `Path` gives
+        the L/R descent string from the root (h2o's default)."""
+        from ..frame.frame import Vec
+
+        if type not in ("Node_ID", "Path"):
+            raise ValueError("type must be 'Node_ID' or 'Path'")
+        X = self._design_matrix(frame)
+        binned = apply_bins_jit(X, self._edges, self._enum_mask,
+                                self.bin_spec.na_bin)
+        p = self.params
+        nodes = np.asarray(_stack_leaf_nodes(
+            self.trees, binned, p.max_depth, p.nbins))[:, : frame.nrows]
+        K = self.nclasses if self.nclasses > 2 else 1
+        out = Frame()
+        for t in range(nodes.shape[0]):
+            name = f"T{t // K + 1}" if K == 1 else \
+                f"T{t // K + 1}.C{t % K + 1}"
+            if type == "Node_ID":
+                out[name] = Vec.from_numpy(
+                    nodes[t].astype(np.float32), name)
+                continue
+            # heap index -> root path string (L/R per level, h2o style);
+            # only the ~2^depth unique leaves touch Python — per-row
+            # work stays vectorized via the unique-inverse remap
+            uniq, inv = np.unique(nodes[t], return_inverse=True)
+            paths = [_heap_path(int(i)) for i in uniq]
+            dom = sorted(set(paths))
+            pos = {s: j for j, s in enumerate(dom)}
+            remap = np.array([pos[s] for s in paths], dtype=np.int32)
+            out[name] = Vec.from_numpy(remap[inv], name, domain=dom)
+        return out
 
     def predict_contributions(self, frame: Frame) -> Frame:
         """Per-row TreeSHAP feature contributions (h2o
@@ -412,17 +465,32 @@ class GBM:
                 col_sample_rate_per_tree=p.col_sample_rate_per_tree,
                 drf_mode=p._drf_mode)
             chunks: list[Tree] = [] if ckpt is None else [ckpt.trees]
-            chunk = p.score_every if (p.score_every and not p._drf_mode) \
-                else p.ntrees - start_t
+            # cap ONE compiled dispatch's work: the TPU worker (behind
+            # its RPC deadline) kills executions that run for minutes —
+            # observed: 25 depth-12 trees on 1M rows crash the worker,
+            # 10 pass. Work/tree ~ rows·F·nbins·2^depth (deepest level
+            # dominates with sibling subtraction); the budget keeps a
+            # dispatch around ~10s on v5e and leaves shallow/bench
+            # shapes in a single dispatch.
+            per_tree = data.y.shape[0] * max(F, 1) * p.nbins \
+                * (2 ** p.max_depth)
+            budget_chunk = max(1, int(_DISPATCH_BUDGET // per_tree))
+            score = p.score_every if (p.score_every and not p._drf_mode) \
+                else 0
             t = start_t
             while t < p.ntrees:
-                n = min(chunk, p.ntrees - t)
+                n = min(budget_chunk, p.ntrees - t)
+                if score:
+                    # stop at score boundaries, but never let the budget
+                    # densify the scoring cadence (each scoring event is
+                    # a blocking host sync)
+                    n = min(n, score - (t - start_t) % score)
                 key, kc = jax.random.split(key)
                 margin, tchunk = boost_trees(binned, data.y, data.w,
                                              margin, kc, n, tp, bp)
                 chunks.append(tchunk)
                 t += n
-                if p.score_every and not p._drf_mode:
+                if score and (t - start_t) % score == 0:
                     history.append({"ntrees": t, **_margin_metrics(
                         data.distribution, margin, data.y, data.w)})
             trees = jax.tree.map(
@@ -491,6 +559,15 @@ class GBM:
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _predict_jit(tree: Tree, binned, max_depth: int, n_bins: int):
     return predict_tree(tree, binned, max_depth, n_bins)
+
+
+def _heap_path(i: int) -> str:
+    """Dense-heap index -> 'LRL...' root descent (root itself = '')."""
+    bits = []
+    while i > 0:
+        bits.append("L" if i % 2 == 1 else "R")   # odd = left child
+        i = (i - 1) // 2
+    return "".join(reversed(bits))
 
 
 def _gain_by_feat(tree: Tree, F: int) -> np.ndarray:

@@ -357,8 +357,10 @@ def _grow_tree_jit(binned, g, h, w, col_mask, key, p: TreeParams,
     return fn(binned, g, h, w, col_mask, key)
 
 
-def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int):
-    """Per-row leaf value by iterative heap descent (jittable)."""
+def descend_tree(tree: Tree, binned, max_depth: int, n_bins: int):
+    """Per-row resting heap node by iterative descent (jittable) — the
+    ONE implementation of split semantics at scoring time (NA bin
+    routing via na_left, `bin > split_bin` goes right)."""
     node = jnp.zeros(binned.shape[0], dtype=jnp.int32)
     for _ in range(max_depth):
         f = tree.split_feat[node]
@@ -372,4 +374,9 @@ def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int):
         go_right = jnp.where(is_na, ~nl, rowbin > b)
         child = 2 * node + 1 + go_right.astype(jnp.int32)
         node = jnp.where(sp, child, node)
-    return tree.value[node]
+    return node
+
+
+def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int):
+    """Per-row leaf value (descend + gather)."""
+    return tree.value[descend_tree(tree, binned, max_depth, n_bins)]

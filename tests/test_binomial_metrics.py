@@ -85,3 +85,29 @@ def test_nan_scores_surface_as_nan_stats(scored):
     stats = M.binomial_stats(y, p2)
     assert np.isnan(stats["auc"]) and np.isnan(stats["pr_auc"])
     assert np.isnan(stats["confusion"]).all()
+
+
+def test_multinomial_perf_includes_macro_auc_and_mpce():
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+    from sklearn import metrics as SK
+
+    rng = np.random.default_rng(5)
+    n = 450
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    cls = np.where(x0 > 0.5, "a", np.where(x1 > 0, "b", "c"))
+    fr = h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": cls})
+    m = GBM(ntrees=5, max_depth=3, seed=0).train(
+        y="y", training_frame=fr)
+    perf = m.model_performance(fr, "y")
+    assert {"logloss", "accuracy", "mean_per_class_error",
+            "auc"} <= set(perf)
+    # macro-OVR AUC parity with sklearn on the same predictions
+    preds = m.predict_raw(fr)
+    dom = m.response_domain
+    yc = fr.vec("y").to_numpy()
+    want = SK.roc_auc_score(yc, preds, multi_class="ovr",
+                            average="macro", labels=range(len(dom)))
+    assert abs(perf["auc"] - want) < 2e-3
+    assert 0 <= perf["mean_per_class_error"] <= 1

@@ -223,3 +223,37 @@ def test_partial_plot_enum_uses_training_domain():
     assert pd_g.nrows == 3
     mr = dict(zip(["p", "q", "r"], pd_g.vec("mean_response").to_numpy()))
     assert mr["p"] > mr["q"] + 0.2           # 'p' still dominates
+
+
+# -- leaf node assignment ----------------------------------------------------
+
+def test_leaf_node_assignment_consistent_with_predictions(frame):
+    import jax.numpy as jnp
+    from h2o_kubernetes_tpu.models.gbm import _heap_path
+
+    m = GBM(ntrees=4, max_depth=3, seed=3).train(
+        y="y", training_frame=frame)
+    la = m.predict_leaf_node_assignment(frame)            # Node_ID
+    assert la.names == ["T1", "T2", "T3", "T4"]
+    # rebuilding the margin from assigned leaves reproduces _margins
+    vals = np.asarray(m.trees.value)                      # [T, N]
+    total = sum(vals[t][la.vec(f"T{t+1}").to_numpy().astype(int)]
+                for t in range(4))
+    want = _margin(m, frame) - float(m.init_score)
+    np.testing.assert_allclose(total, want, rtol=1e-4, atol=1e-4)
+    # Path form round-trips the heap index
+    lp = m.predict_leaf_node_assignment(frame, type="Path")
+    v = lp.vec("T1")
+    node0 = int(la.vec("T1").to_numpy()[0])
+    assert v.domain[int(v.to_numpy()[0])] == _heap_path(node0)
+
+
+def test_heap_path_encoding():
+    from h2o_kubernetes_tpu.models.gbm import _heap_path
+
+    assert _heap_path(0) == ""
+    assert _heap_path(1) == "L"
+    assert _heap_path(2) == "R"
+    assert _heap_path(3) == "LL"
+    assert _heap_path(6) == "RR"
+    assert _heap_path(9) == "LRL"
