@@ -13,8 +13,11 @@ update) on a synthetic airlines-like binary-classification table.
 
 Robustness contract: this file IS the round scoreboard.  It probes the
 TPU backend in a subprocess (a hung client-init cannot take down the
-bench), retries once, falls back to CPU, and on any exception still
-emits a single diagnostic JSON line instead of a traceback.
+bench) and is STUBBORN: it keeps retrying with pauses for up to
+H2O_TPU_PROBE_BUDGET seconds (default 600 — a recovering chip must not
+cost the round its TPU number, the round-2 failure mode) before falling
+back to CPU, and on any exception still emits a single diagnostic JSON
+line instead of a traceback.
 """
 
 import json

@@ -272,6 +272,27 @@ class BoostParams(NamedTuple):
     drf_mode: bool = False
 
 
+def _round_sampling(bp: BoostParams, w, F: int, k_row, k_col):
+    """Shard-level row/column sampling for one boosting round →
+    (w_t, col_mask). Shared by ``_boost_shard`` and
+    ``_boost_shard_multi``; ``models/xgboost.py::_rank_round`` applies
+    the same scheme host-side (outside shard_map) — keep the semantics
+    in sync."""
+    w_t = w
+    if bp.sample_rate < 1.0:
+        # fold in the shard index: every shard holds different rows
+        # and must draw an independent keep-pattern
+        k_row_s = jax.random.fold_in(k_row, lax.axis_index(ROWS))
+        keep = jax.random.uniform(k_row_s, w.shape) < bp.sample_rate
+        w_t = w * keep
+    col_mask = jnp.ones(F, dtype=bool)
+    if bp.col_sample_rate_per_tree < 1.0:
+        # same key on every shard → consistent replicated mask
+        col_mask = jax.random.uniform(
+            k_col, (F,)) < bp.col_sample_rate_per_tree
+    return w_t, col_mask
+
+
 def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
                  bp: BoostParams):
     """Scan over trees INSIDE one shard_map: grad/hess → grow → local
@@ -287,18 +308,7 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
 
     def body(margin, kt):
         k_row, k_col, k_tree = jax.random.split(kt, 3)
-        w_t = w
-        if bp.sample_rate < 1.0:
-            # fold in the shard index: every shard holds different rows
-            # and must draw an independent keep-pattern
-            k_row_s = jax.random.fold_in(k_row, lax.axis_index(ROWS))
-            keep = jax.random.uniform(k_row_s, w.shape) < bp.sample_rate
-            w_t = w * keep
-        col_mask = jnp.ones(F, dtype=bool)
-        if bp.col_sample_rate_per_tree < 1.0:
-            # same key on every shard → consistent replicated mask
-            col_mask = jax.random.uniform(
-                k_col, (F,)) < bp.col_sample_rate_per_tree
+        w_t, col_mask = _round_sampling(bp, w, F, k_row, k_col)
         if bp.drf_mode:
             g, h = -y, jnp.ones_like(y)
         else:
@@ -314,6 +324,87 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
 
     margin, trees = lax.scan(body, margin, keys)
     return margin, trees
+
+
+# live histogram bytes allowed for the vmapped K-class grow (per shard,
+# deepest level) before _boost_shard_multi drops to sequential lax.map
+_MULTI_HIST_BUDGET = 2 ** 30
+
+
+def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
+                       bp: BoostParams, K: int):
+    """Multinomial analog of ``_boost_shard``: K class trees grow per
+    boosting round via ``vmap`` over the class axis (per-level psums
+    batch across classes), inside the same scan-over-rounds shard_map.
+
+    Replaces the round-2 host loop (K ``grow_tree`` + K predict
+    dispatches per iteration — the exact dispatch-latency failure mode
+    PROFILE.md documents for round-1 binomial). Margin is [rows, K] and
+    never leaves the device; one dispatch covers a whole chunk of
+    boosting rounds. Reference: hex/tree/gbm/GBM.java grows the K class
+    trees of an iteration from shared softmax probs (SURVEY.md §3.4).
+    """
+    F = binned.shape[1]
+
+    def body(margin, kt):
+        k_row, k_col, k_tree = jax.random.split(kt, 3)
+        # one row-sample per ROUND, shared by its K trees (the
+        # reference samples per iteration, not per class tree)
+        w_t, col_mask = _round_sampling(bp, w, F, k_row, k_col)
+        # NaN responses (w=0 pad rows) compare False for every class
+        yk = (y[:, None] == jnp.arange(K, dtype=y.dtype)[None, :]
+              ).astype(jnp.float32)                      # [rows, K]
+        if bp.drf_mode:
+            g = -yk.T
+            h = jnp.ones_like(g)
+        else:
+            probs = jax.nn.softmax(margin, axis=1)
+            g = (probs - yk).T                           # [K, rows]
+            h = (probs * (1.0 - probs)).T
+        def grow_one(gk, hk, kk):
+            return _grow_tree_shard(binned, gk, hk, w_t, col_mask, kk, p)
+
+        keys_k = jax.random.split(k_tree, K)
+        # vmap multiplies per-level histogram memory by K; past a VMEM/
+        # HBM budget grow classes sequentially INSIDE the dispatch
+        # (lax.map: 1/K the live histogram footprint, still one compile)
+        # ×5: at the deepest level hist_prev, hist_l, hist_r (2^(d-1)
+        # nodes each) and the stacked hist (2^d nodes) are live at once
+        hist_bytes = 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.n_bins \
+            * 3 * 4
+        if K * hist_bytes <= _MULTI_HIST_BUDGET:
+            trees, leaf = jax.vmap(grow_one)(g, h, keys_k)
+        else:
+            trees, leaf = lax.map(lambda a: grow_one(*a), (g, h, keys_k))
+        trees = trees._replace(value=bp.learn_rate * trees.value)
+        if not bp.drf_mode:
+            upd = jax.vmap(lambda v, lf: v[lf])(trees.value, leaf)
+            margin = margin + upd.T
+        return margin, trees
+
+    margin, trees = lax.scan(body, margin, keys)
+    return margin, trees
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
+def _boost_multi_jit(binned, y, w, margin, keys, p: TreeParams,
+                     bp: BoostParams, K: int, mesh):
+    fn = jax.shard_map(
+        functools.partial(_boost_shard_multi, p=p, bp=bp, K=K),
+        mesh=mesh,
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P()),
+        out_specs=(P(ROWS), P()),
+        check_vma=_resolve_impl(p.hist_impl) == "segment")
+    return fn(binned, y, w, margin, keys)
+
+
+def boost_trees_multi(binned, y, w, margin, key, n_trees: int, K: int,
+                      p: TreeParams, bp: BoostParams, mesh=None):
+    """Fused multinomial boosting: n_trees rounds × K class trees in ONE
+    compiled dispatch. Returns (margin [rows, K], trees [T, K, N])."""
+    keys = jax.random.split(key, n_trees)
+    return _boost_multi_jit(binned, y, w, margin, keys, p, bp, K,
+                            mesh or global_mesh())
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6, 7))

@@ -155,7 +155,9 @@ def test_scoring_history(mesh8):
     fr, X, y = _binary_data(n=2000, seed=12)
     m = GBM(ntrees=10, max_depth=3, score_every=5, seed=0).train(
         y="y", training_frame=fr)
-    assert len(m.scoring_history) == 3  # @5, @10, final
+    # @5 and @10; the final row IS the @10 row (no duplicate append)
+    assert len(m.scoring_history) == 2
+    assert [h["ntrees"] for h in m.scoring_history] == [5, 10]
     assert m.scoring_history[0]["train_logloss"] > \
         m.scoring_history[-1]["train_logloss"]
 
