@@ -10,7 +10,12 @@ Supported: GBM / DRF / XGBoost (trees + bin edges), GLM (beta + design
 layout, all families/links incl. multinomial), KMeans (centers),
 DeepLearning (layer weights; MLP, softmax and autoencoder modes),
 NaiveBayes (priors + likelihood tables), PCA (eigenvectors),
-Word2Vec (embeddings + vocab with word_vector/find_synonyms accessors).
+Word2Vec (embeddings + vocab with word_vector/find_synonyms accessors),
+IsolationForest, CoxPH (linear log-hazard), GLRM (archetypes; predict
+gives the per-row factor projection, reconstruct() the imputed frame),
+TargetEncoder (transform() applies the fitted level→encoding tables),
+and StackedEnsemble (every base-model MOJO plus the metalearner MOJO
+nested in one artifact — the AutoML leader exports whole).
 """
 
 from __future__ import annotations
@@ -30,9 +35,11 @@ def _np(a):
     return np.asarray(a)
 
 
-def export_mojo(model, path: str) -> str:
-    """Write `model` as a standalone scoring artifact at `path`."""
+def export_mojo(model, path) -> str:
+    """Write `model` as a standalone scoring artifact at `path` (a
+    filesystem path or a binary file-like object)."""
     algo = model.algo
+    extra_files: dict[str, bytes] = {}
     # word2vec has no tabular design, so the shared fields are optional
     meta = {
         "format": _FORMAT,
@@ -118,6 +125,56 @@ def export_mojo(model, path: str) -> str:
         meta["sample_size_effective"] = int(model.sample_size_effective)
         for f in ("split_feat", "split_val", "is_split", "count"):
             arrays[f"iso_{f}"] = _np(getattr(model.trees, f))
+    elif algo == "coxph":
+        # hex/coxph scoring is the linear log-hazard Xe·beta (SURVEY.md
+        # §2b C17); the artifact is the expanded-design layout + beta
+        d = model.dinfo
+        meta["numeric_idx"] = list(d.numeric_idx)
+        meta["enum_specs"] = [list(s) for s in d.enum_specs]
+        meta["drop_first"] = d.drop_first
+        arrays["means"] = _np(d.means)
+        arrays["stds"] = _np(d.stds)
+        arrays["beta"] = _np(model.beta)
+    elif algo == "glrm":
+        # archetypes V + design layout: scoring solves the per-row
+        # ridge U-step against fixed V (models/glrm.py::_solve_u)
+        d = model.dinfo
+        meta["numeric_idx"] = list(d.numeric_idx)
+        meta["enum_specs"] = [list(s) for s in d.enum_specs]
+        meta["drop_first"] = d.drop_first
+        meta["coef_names"] = list(d.coef_names[:-1])
+        arrays["means"] = _np(d.means)
+        arrays["stds"] = _np(d.stds)
+        arrays["V"] = _np(model.V)
+    elif algo == "targetencoder":
+        # level→encoding tables; mojo transform is the SCORING path
+        # (full-data stats, no leakage handling / noise — matching the
+        # reference's TE mojo)
+        p = model.params
+        meta["te_columns"] = list(model.columns)
+        meta["prior"] = float(model.prior)
+        meta["blending"] = bool(p.blending)
+        meta["inflection_point"] = float(p.inflection_point)
+        meta["smoothing"] = float(p.smoothing)
+        meta["te_domains"] = {c: list(model.tables[c]["domain"])
+                              for c in model.columns}
+        for i, c in enumerate(model.columns):
+            arrays[f"te_sum_{i}"] = _np(model.tables[c]["sum"])
+            arrays[f"te_cnt_{i}"] = _np(model.tables[c]["cnt"])
+    elif algo == "stackedensemble":
+        # one artifact nests every base model's MOJO plus the
+        # metalearner's (reference: StackedEnsembleMojoWriter packs the
+        # base mojos into the ensemble zip, SURVEY.md §2b C18) — so the
+        # AutoML leader is servable even when it is an ensemble
+        meta["base_tags"] = list(model.base_tags)
+        meta["base_count"] = len(model.base_models)
+        for i, bm in enumerate(model.base_models):
+            buf = io.BytesIO()
+            export_mojo(bm, buf)
+            extra_files[f"base_{i}.mojo"] = buf.getvalue()
+        buf = io.BytesIO()
+        export_mojo(model.metalearner, buf)
+        extra_files["metalearner.mojo"] = buf.getvalue()
     else:
         raise ValueError(f"mojo export not supported for algo '{algo}'")
 
@@ -126,6 +183,8 @@ def export_mojo(model, path: str) -> str:
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("model.json", json.dumps(meta))
         z.writestr("arrays.npz", npz.getvalue())
+        for name, blob in extra_files.items():
+            z.writestr(name, blob)
     return path
 
 
@@ -136,13 +195,19 @@ def import_mojo(path: str) -> "MojoModel":
 class MojoModel:
     """Loads and scores a mojo artifact with numpy only."""
 
-    def __init__(self, path: str):
+    def __init__(self, path):
         with zipfile.ZipFile(path) as z:
             self.meta = json.loads(z.read("model.json"))
             if self.meta.get("format") != _FORMAT:
                 raise ValueError(f"{path}: not a {_FORMAT} artifact")
             with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
                 self.arrays = {k: npz[k] for k in npz.files}
+            if self.meta["algo"] == "stackedensemble":
+                self._base = [
+                    MojoModel(io.BytesIO(z.read(f"base_{i}.mojo")))
+                    for i in range(self.meta["base_count"])]
+                self._metalearner = MojoModel(
+                    io.BytesIO(z.read("metalearner.mojo")))
         self.algo = self.meta["algo"]
         self.feature_names = self.meta["feature_names"]
         self.nclasses = self.meta["nclasses"]
@@ -208,6 +273,14 @@ class MojoModel:
 
     def predict(self, data) -> np.ndarray:
         """[n, K] probabilities / [n] predictions / [n] cluster ids."""
+        if self.algo == "stackedensemble":
+            # bases consume the raw columns themselves — no shared
+            # design matrix exists at the ensemble level
+            return self._predict_se(data)
+        if self.algo == "targetencoder":
+            raise ValueError(
+                "targetencoder artifacts score via transform(), not "
+                "predict()")
         X = self._matrix(data) if not isinstance(data, np.ndarray) \
             else data.astype(np.float32)
         if self.algo in ("gbm", "drf", "xgboost"):
@@ -224,7 +297,107 @@ class MojoModel:
             return self._predict_pca(X)
         if self.algo == "isolationforest":
             return self._predict_isolationforest(X)
+        if self.algo == "coxph":
+            return self._predict_coxph(X)
+        if self.algo == "glrm":
+            return self._solve_u_glrm(X)
         raise ValueError(self.algo)
+
+    def _predict_se(self, data):
+        """Run every base MOJO, assemble the level-one columns exactly
+        like models/stackedensemble.py::_level_one_columns, then run
+        the metalearner MOJO on them."""
+        cols: dict[str, np.ndarray] = {}
+        for bm, tag in zip(self._base, self.meta["base_tags"]):
+            preds = bm.predict(data)
+            if bm.nclasses == 2:
+                cols[tag] = preds[:, 1]
+            elif bm.nclasses > 2:
+                for k in range(bm.nclasses):
+                    cols[f"{tag}_p{k}"] = preds[:, k]
+            else:
+                cols[tag] = preds
+        return self._metalearner.predict(cols)
+
+    def _predict_coxph(self, X):
+        """Linear log-hazard Xe·beta (CoxPHModel._score_matrix)."""
+        return self._expand(X)[:, :-1] @ self.arrays["beta"]
+
+    def _solve_u_glrm(self, X):
+        """[n, k] row factors: per-row ridge solve against fixed V —
+        numpy mirror of GLRMModel._solve_u, with the observed mask from
+        the RAW matrix (expand mean-imputes, so the mask must not come
+        from the expanded values)."""
+        m = self.meta
+        Xe = self._expand(X)[:, :-1]
+        cols = [~np.isnan(X[:, i]) for i in m["numeric_idx"]]
+        mats = [np.stack(cols, axis=1)] if cols else []
+        for (i, L, has_na, mode) in m["enum_specs"]:
+            ok = ~np.isnan(X[:, i])
+            width = L - (1 if m["drop_first"] else 0) + (1 if has_na
+                                                         else 0)
+            mats.append(np.broadcast_to(ok[:, None], (X.shape[0], width)))
+        mask = np.concatenate(mats, axis=1).astype(np.float32)
+        Xz = np.nan_to_num(Xe) * mask
+        V = self.arrays["V"]
+        G = V.T @ V + 1e-6 * np.eye(V.shape[1], dtype=V.dtype)
+        return Xz @ V @ np.linalg.inv(G)
+
+    def reconstruct(self, data) -> dict[str, np.ndarray]:
+        """GLRM imputation: U·Vᵀ in the expanded layout, keyed by
+        coefficient name (GLRMModel.reconstruct analog)."""
+        if self.algo != "glrm":
+            raise ValueError("reconstruct() is a glrm accessor")
+        X = self._matrix(data) if not isinstance(data, np.ndarray) \
+            else data.astype(np.float32)
+        rec = self._solve_u_glrm(X) @ self.arrays["V"].T
+        return {f"reconstr_{n}": rec[:, i]
+                for i, n in enumerate(self.meta["coef_names"])}
+
+    def transform(self, data) -> dict[str, np.ndarray]:
+        """TargetEncoder scoring transform: `<col>_te` encodings from
+        the fitted full-data tables (no leakage handling, no noise —
+        the TargetEncoderModel.transform(as_training=False) path)."""
+        if self.algo != "targetencoder":
+            raise ValueError("transform() is a targetencoder accessor")
+        m = self.meta
+        out: dict[str, np.ndarray] = {}
+        for i, col in enumerate(m["te_columns"]):
+            dom = m["te_domains"][col]
+            if hasattr(data, "vec") and hasattr(data, "names"):
+                v = data.vec(col)
+                if not v.is_enum():
+                    # same kind-mismatch contract as the in-process
+                    # TargetEncoderModel._codes_for — str()-ifying
+                    # numerics would silently encode every row as the
+                    # prior (no domain string matches '1.0')
+                    raise ValueError(f"'{col}' is not categorical")
+                doms = list(v.domain or [])
+                raw = v.to_numpy().astype(np.int64)
+                vals = np.array(doms + [None], dtype=object)[
+                    np.where(raw < 0, len(doms), raw)]
+            else:
+                vals = np.asarray(data[col])
+                if vals.dtype.kind not in ("U", "S", "O"):
+                    raise ValueError(f"'{col}' is not categorical")
+            lut = {d: j for j, d in enumerate(dom)}
+            codes = np.array([lut.get(str(s), -1) if s is not None
+                              else -1 for s in vals], dtype=np.int64)
+            sums = self.arrays[f"te_sum_{i}"].astype(np.float64)
+            cnts = self.arrays[f"te_cnt_{i}"].astype(np.float64)
+            mean = sums / np.maximum(cnts, 1.0)
+            if m["blending"]:
+                lam = 1.0 / (1.0 + np.exp(
+                    -(cnts - m["inflection_point"])
+                    / max(m["smoothing"], 1e-12)))
+                enc_tab = lam * mean + (1.0 - lam) * m["prior"]
+            else:
+                enc_tab = mean
+            enc_tab = np.where(cnts > 0, enc_tab, m["prior"])
+            enc = np.where(codes >= 0, enc_tab[np.maximum(codes, 0)],
+                           m["prior"])
+            out[f"{col}_te"] = enc.astype(np.float32)
+        return out
 
     def _predict_isolationforest(self, X):
         """[n, 2] (anomaly score, mean path length) — numpy mirror of

@@ -8,6 +8,9 @@ import h2o_kubernetes_tpu as h2o
 from h2o_kubernetes_tpu.automl import AutoML, Leaderboard
 from h2o_kubernetes_tpu.models import GBM, GLM, StackedEnsemble
 
+# long-running tier: deselect locally with -m 'not slow'
+pytestmark = pytest.mark.slow
+
 
 def _frame(n=500, seed=11):
     rng = np.random.default_rng(seed)

@@ -8,6 +8,9 @@ import pytest
 import h2o_kubernetes_tpu as h2o
 from h2o_kubernetes_tpu.models import GBM, GLM, DeepLearning, KMeans
 
+# long-running tier: deselect locally with -m 'not slow'
+pytestmark = pytest.mark.slow
+
 
 def _frame(n=400, seed=21):
     rng = np.random.default_rng(seed)

@@ -14,6 +14,9 @@ import pytest
 import h2o_kubernetes_tpu as h2o
 from h2o_kubernetes_tpu.models import DRF, GBM
 
+# long-running tier: deselect locally with -m 'not slow'
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def frame():

@@ -7,6 +7,9 @@ import pytest
 import h2o_kubernetes_tpu as h2o
 from h2o_kubernetes_tpu.models import TargetEncoder
 
+# long-running tier: deselect locally with -m 'not slow'
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def te_frame():
