@@ -376,9 +376,12 @@ class AutoML:
     # -- resume manifest (checkpoint_dir) -----------------------------------
 
     def _manifest_path(self):
-        import os
+        """checkpoint_dir may live on any persist backend
+        (s3://bucket/run1 — the save-AutoML-state-from-a-pod story the
+        operator deploys, SURVEY.md §2b C20)."""
+        from .persist import join_path
 
-        return os.path.join(self.checkpoint_dir, "automl_manifest.json")
+        return join_path(self.checkpoint_dir, "automl_manifest.json")
 
     def _load_manifest(self) -> dict:
         """{model_id: {file, fam, metrics}} of completed steps."""
@@ -387,11 +390,16 @@ class AutoML:
         import json
         import os
 
+        from .persist import is_remote, read_bytes
+
         try:
-            with open(self._manifest_path()) as f:
-                return json.load(f)
+            return json.loads(read_bytes(self._manifest_path()))
         except FileNotFoundError:
-            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            # only a genuinely-missing manifest means "fresh run" —
+            # auth/transport failures must NOT silently retrain (and
+            # then clobber the valid manifest they failed to read)
+            if not is_remote(self.checkpoint_dir):
+                os.makedirs(self.checkpoint_dir, exist_ok=True)
             return {}
 
     def _save_step(self, model_id, fam, model, metrics) -> None:
@@ -400,17 +408,22 @@ class AutoML:
         import json
         import os
 
-        from .persist import save_model
+        from .persist import is_remote, join_path, save_model, write_bytes
 
-        path = os.path.join(self.checkpoint_dir, f"{model_id}.model")
+        path = join_path(self.checkpoint_dir, f"{model_id}.model")
         save_model(model, path)
         manifest = self._load_manifest()
         manifest[model_id] = {"file": path, "fam": fam,
                               "metrics": metrics}
-        tmp = self._manifest_path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f)
-        os.replace(tmp, self._manifest_path())   # crash-atomic
+        if is_remote(self.checkpoint_dir):
+            # object stores overwrite atomically per PUT
+            write_bytes(self._manifest_path(),
+                        json.dumps(manifest).encode())
+        else:
+            tmp = self._manifest_path() + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, self._manifest_path())   # crash-atomic
 
     def _load_step(self, model_id, entry):
         from .persist import load_model

@@ -35,6 +35,16 @@ def _np(a):
     return np.asarray(a)
 
 
+def _export_dinfo(meta: dict, arrays: dict, d) -> None:
+    """Serialize a DataInfo design layout (shared by every expanded-
+    design algo: glm/deeplearning/pca/kmeans/coxph/glrm)."""
+    meta["numeric_idx"] = list(d.numeric_idx)
+    meta["enum_specs"] = [list(s) for s in d.enum_specs]
+    meta["drop_first"] = d.drop_first
+    arrays["means"] = _np(d.means)
+    arrays["stds"] = _np(d.stds)
+
+
 def export_mojo(model, path) -> str:
     """Write `model` as a standalone scoring artifact at `path` (a
     filesystem path or a binary file-like object)."""
@@ -71,22 +81,14 @@ def export_mojo(model, path) -> str:
         meta["link"] = _famspec(model.params).link
         arrays["beta"] = _np(model.beta)
         d = model.dinfo
-        meta["numeric_idx"] = list(d.numeric_idx)
-        meta["enum_specs"] = [list(s) for s in d.enum_specs]
-        meta["drop_first"] = d.drop_first
-        arrays["means"] = _np(d.means)
-        arrays["stds"] = _np(d.stds)
+        _export_dinfo(meta, arrays, d)
     elif algo == "deeplearning":
         meta["activation"] = model.params.activation
         meta["loss_kind"] = model.loss_kind
         meta["autoencoder"] = bool(model.params.autoencoder)
         meta["n_layers"] = len(model.net)
         d = model.dinfo
-        meta["numeric_idx"] = list(d.numeric_idx)
-        meta["enum_specs"] = [list(s) for s in d.enum_specs]
-        meta["drop_first"] = d.drop_first
-        arrays["means"] = _np(d.means)
-        arrays["stds"] = _np(d.stds)
+        _export_dinfo(meta, arrays, d)
         for i, lyr in enumerate(model.net):
             arrays[f"net_{i}_w"] = _np(lyr["w"])
             arrays[f"net_{i}_b"] = _np(lyr["b"])
@@ -101,11 +103,7 @@ def export_mojo(model, path) -> str:
             arrays[f"nbtab_{i}"] = _np(tab)
     elif algo == "pca":
         d = model.dinfo
-        meta["numeric_idx"] = list(d.numeric_idx)
-        meta["enum_specs"] = [list(s) for s in d.enum_specs]
-        meta["drop_first"] = d.drop_first
-        arrays["means"] = _np(d.means)
-        arrays["stds"] = _np(d.stds)
+        _export_dinfo(meta, arrays, d)
         arrays["eigenvectors"] = _np(model.eigenvectors)
         arrays["eigenvalues"] = _np(model.eigenvalues)
     elif algo == "word2vec":
@@ -114,11 +112,7 @@ def export_mojo(model, path) -> str:
     elif algo == "kmeans":
         arrays["centers"] = _np(model.centers_std)
         d = model.dinfo
-        meta["numeric_idx"] = list(d.numeric_idx)
-        meta["enum_specs"] = [list(s) for s in d.enum_specs]
-        meta["drop_first"] = d.drop_first
-        arrays["means"] = _np(d.means)
-        arrays["stds"] = _np(d.stds)
+        _export_dinfo(meta, arrays, d)
     elif algo == "isolationforest":
         meta["max_depth"] = model.params.max_depth
         meta["ntrees"] = model.ntrees
@@ -129,22 +123,14 @@ def export_mojo(model, path) -> str:
         # hex/coxph scoring is the linear log-hazard Xe·beta (SURVEY.md
         # §2b C17); the artifact is the expanded-design layout + beta
         d = model.dinfo
-        meta["numeric_idx"] = list(d.numeric_idx)
-        meta["enum_specs"] = [list(s) for s in d.enum_specs]
-        meta["drop_first"] = d.drop_first
-        arrays["means"] = _np(d.means)
-        arrays["stds"] = _np(d.stds)
+        _export_dinfo(meta, arrays, d)
         arrays["beta"] = _np(model.beta)
     elif algo == "glrm":
         # archetypes V + design layout: scoring solves the per-row
         # ridge U-step against fixed V (models/glrm.py::_solve_u)
         d = model.dinfo
-        meta["numeric_idx"] = list(d.numeric_idx)
-        meta["enum_specs"] = [list(s) for s in d.enum_specs]
-        meta["drop_first"] = d.drop_first
+        _export_dinfo(meta, arrays, d)
         meta["coef_names"] = list(d.coef_names[:-1])
-        arrays["means"] = _np(d.means)
-        arrays["stds"] = _np(d.stds)
         arrays["V"] = _np(model.V)
     elif algo == "targetencoder":
         # level→encoding tables; mojo transform is the SCORING path

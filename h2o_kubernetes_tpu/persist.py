@@ -4,9 +4,9 @@ Reference: water/persist/* (SURVEY.md §2b C20) provides binary model
 save/load and frame export over pluggable backends (local/S3/HDFS/GCS);
 h2o.save_model / h2o.load_model / h2o.export_file are the client verbs
 (h2o-py). Built-in backends: local FS, mem:// (in-process object
-store), read-only http(s)://; S3/GCS/HDFS register the same way via
-PERSIST_SCHEMES (the reference's PersistManager registry) when their
-client libraries are present.
+store), read-only http(s)://, and the cloud stores s3:// gs:// hdfs://
+(persist_cloud.py — stdlib REST clients, no SDK required); more can
+register via PERSIST_SCHEMES (the reference's PersistManager registry).
 
 Device arrays are converted to host numpy on save (a model file is
 readable on any backend — the reference's binary models are likewise
@@ -24,7 +24,8 @@ from typing import Any, Callable
 import numpy as np
 
 __all__ = ["save_model", "load_model", "export_file", "save_frame",
-           "load_frame", "PERSIST_SCHEMES"]
+           "load_frame", "PERSIST_SCHEMES", "read_bytes", "write_bytes",
+           "is_remote", "join_path"]
 
 _MAGIC = b"H2OTPU1\n"
 
@@ -64,6 +65,11 @@ PERSIST_SCHEMES["mem"] = (_mem_read, _mem_write)
 PERSIST_SCHEMES["http"] = (_http_read, _http_write)
 PERSIST_SCHEMES["https"] = (_http_read, _http_write)
 
+# cloud backends (s3/gs/hdfs) — stdlib REST clients, no SDK needed
+from . import persist_cloud as _persist_cloud  # noqa: E402
+
+_persist_cloud.register(PERSIST_SCHEMES)
+
 
 def _write_bytes(path: str, data: bytes) -> None:
     scheme = path.split("://", 1)[0] if "://" in path else ""
@@ -88,6 +94,24 @@ def _read_bytes(path: str) -> bytes:
         return PERSIST_SCHEMES[scheme][0](path)
     with open(path, "rb") as f:
         return f.read()
+
+
+# public raw-bytes surface so other subsystems (AutoML checkpoints,
+# REST export) stay backend-agnostic without reaching into privates
+read_bytes = _read_bytes
+write_bytes = _write_bytes
+
+
+def is_remote(path: str) -> bool:
+    """True when `path` routes through a PERSIST_SCHEMES backend."""
+    return "://" in path
+
+
+def join_path(base: str, name: str) -> str:
+    """Join a child name onto a local dir or a scheme://-addressed one."""
+    if is_remote(base):
+        return base.rstrip("/") + "/" + name
+    return os.path.join(base, name)
 
 
 class _HostPickler(pickle.Pickler):

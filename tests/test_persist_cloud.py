@@ -1,0 +1,284 @@
+"""Cloud persist backends (s3:// gs:// hdfs://) against local fake
+servers — no network, no SDKs (reference: water/persist/{PersistS3,
+PersistGcs,PersistHdfs}, SURVEY.md §2b C20)."""
+
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import GBM
+
+
+def _server_side_sigv4(method: str, path_qs: str, headers,
+                       payload: bytes, secret: str) -> str | None:
+    """Recompute the SigV4 signature from the request AS THE SERVER SAW
+    IT (the verification minio/localstack perform), written from the
+    AWS spec: canonical request -> string-to-sign -> signing key chain.
+    Returns the expected hex signature, or None if unsigned."""
+    import hashlib
+    import hmac as hm
+
+    auth = headers.get("Authorization")
+    if not auth or not auth.startswith("AWS4-HMAC-SHA256"):
+        return None
+    cred = auth.split("Credential=")[1].split(",")[0]
+    signed = auth.split("SignedHeaders=")[1].split(",")[0]
+    _akid, datestamp, region, service, _term = cred.split("/")
+    path = path_qs.split("?", 1)[0]
+    query = path_qs.split("?", 1)[1] if "?" in path_qs else ""
+    canon_headers = "".join(
+        f"{h}:{headers.get(h).strip()}\n" for h in signed.split(";"))
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    canonical = "\n".join([method, path, query, canon_headers, signed,
+                           payload_hash])
+    amz_date = headers["x-amz-date"]
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical.encode()).hexdigest()])
+
+    def _k(key, msg):
+        return hm.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _k(_k(_k(_k(b"AWS4" + secret.encode(), datestamp), region),
+               service), "aws4_request")
+    return hm.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+
+
+class _FakeStore(BaseHTTPRequestHandler):
+    """One handler serves all three protocols: plain GET/PUT object
+    paths (S3 path-style + WebHDFS), and the GCS JSON media endpoints.
+    Signed S3 requests are VERIFIED server-side (recomputed signature
+    must match) — a signer defect 403s here like it would on minio."""
+
+    store: dict[str, bytes] = {}
+    auth_headers: list[dict] = []
+    sigv4_checked: int = 0
+
+    def log_message(self, *a):        # silence test output
+        pass
+
+    def _verify_sig(self, payload: bytes) -> bool:
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256"):
+            return True          # unsigned (gs/hdfs/anonymous) is fine
+        expect = _server_side_sigv4(self.command, self.path,
+                                    self.headers, payload, "secret")
+        got = auth.split("Signature=")[1]
+        type(self).sigv4_checked += 1
+        return expect == got
+
+    def _key(self) -> str:
+        path = self.path.split("?", 1)[0]
+        if path.startswith("/upload/storage/v1/b/"):      # GCS upload
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            bucket = path.split("/")[5]
+            return f"/{bucket}/{q['name'][0]}"
+        if path.startswith("/storage/v1/b/"):             # GCS download
+            parts = path.split("/")
+            from urllib.parse import unquote
+
+            return f"/{parts[4]}/{unquote(parts[6])}"
+        return path                                        # S3 / WebHDFS
+
+    def do_GET(self):
+        self.auth_headers.append(dict(self.headers))
+        if not self._verify_sig(b""):
+            self.send_response(403)
+            self.end_headers()
+            return
+        key = self._key()
+        if key not in self.store:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = self.store[key]
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        self.auth_headers.append(dict(self.headers))
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if not self._verify_sig(body):
+            self.send_response(403)
+            self.end_headers()
+            return
+        self.store[self._key()] = body
+        self.send_response(200)
+        self.end_headers()
+
+    do_POST = do_PUT
+
+
+@pytest.fixture()
+def fake_store():
+    _FakeStore.store = {}
+    _FakeStore.auth_headers = []
+    _FakeStore.sigv4_checked = 0
+    srv = HTTPServer(("127.0.0.1", 0), _FakeStore)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{srv.server_port}"
+    saved = {k: os.environ.get(k) for k in
+             ("AWS_ENDPOINT_URL", "STORAGE_EMULATOR_HOST",
+              "H2O_TPU_WEBHDFS", "AWS_ACCESS_KEY_ID",
+              "AWS_SECRET_ACCESS_KEY")}
+    os.environ["AWS_ENDPOINT_URL"] = url
+    os.environ["STORAGE_EMULATOR_HOST"] = url
+    os.environ["H2O_TPU_WEBHDFS"] = url
+    os.environ["AWS_ACCESS_KEY_ID"] = "AKIDEXAMPLE"
+    os.environ["AWS_SECRET_ACCESS_KEY"] = "secret"
+    yield url
+    for k, v in saved.items():
+        os.environ.pop(k, None)
+        if v is not None:
+            os.environ[k] = v
+    srv.shutdown()
+
+
+def _frame(n=200, seed=4):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.3, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays({"x": x, "y": y})
+
+
+@pytest.mark.parametrize("scheme,prefix", [
+    ("s3", "s3://bkt/dir/frame.h2f"),
+    ("gs", "gs://bkt/dir/frame.h2f"),
+    ("hdfs", "hdfs:///dir/frame.h2f"),
+])
+def test_frame_roundtrip(fake_store, mesh8, scheme, prefix):
+    fr = _frame()
+    h2o.save_frame(fr, prefix)
+    fr2 = h2o.load_frame(prefix)
+    np.testing.assert_allclose(fr["x"].to_numpy(), fr2["x"].to_numpy())
+    assert fr2["y"].domain == fr["y"].domain
+
+
+def test_s3_requests_are_sigv4_signed(fake_store, mesh8):
+    fr = _frame(50)
+    h2o.export_file(fr, "s3://bkt/export.csv")
+    auth = [h for h in _FakeStore.auth_headers
+            if "Authorization" in h or "authorization" in h]
+    assert auth, "S3 write sent no Authorization header"
+    a = auth[-1].get("Authorization", auth[-1].get("authorization"))
+    assert a.startswith("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/")
+    assert "Signature=" in a
+    # the fake 403s on signature mismatch, so landing in the store means
+    # the server-side recomputation verified the signature
+    assert _FakeStore.sigv4_checked > 0
+    body = _FakeStore.store["/bkt/export.csv"].decode()
+    assert body.splitlines()[0] == "x,y"
+
+
+def test_model_roundtrip_s3(fake_store, mesh8, tmp_path):
+    fr = _frame()
+    m = GBM(ntrees=3, max_depth=3, seed=1).train(y="y", training_frame=fr)
+    path = h2o.save_model(m, "s3://bkt/models/gbm.model")
+    m2 = h2o.load_model(path)
+    np.testing.assert_allclose(np.asarray(m.predict_raw(fr)),
+                               np.asarray(m2.predict_raw(fr)), rtol=1e-6)
+
+
+def test_gs_object_names_with_slashes(fake_store, mesh8):
+    fr = _frame(30)
+    h2o.export_file(fr, "gs://bkt/a/b/c.csv")
+    # GCS JSON API carries the full object name (slash-encoded) — the
+    # fake decodes it back, so the key keeps its path shape
+    assert "/bkt/a/b/c.csv" in _FakeStore.store
+    got = h2o.persist._read_bytes("gs://bkt/a/b/c.csv")
+    assert got == _FakeStore.store["/bkt/a/b/c.csv"]
+
+
+def test_missing_object_raises(fake_store, mesh8):
+    with pytest.raises(IOError):
+        h2o.load_frame("s3://bkt/nope.h2f")
+
+
+@pytest.mark.slow
+def test_automl_checkpoint_dir_on_s3(fake_store, mesh8):
+    """Mid-run resume manifest lives on the object store: first run
+    populates it, second run resumes from it without retraining."""
+    fr = _frame(300, seed=9)
+    aml = h2o.AutoML(max_models=2, nfolds=3, seed=0,
+                     checkpoint_dir="s3://bkt/run1")
+    aml.train(y="y", training_frame=fr)
+    assert "/bkt/run1/automl_manifest.json" in _FakeStore.store
+    import json
+
+    manifest = json.loads(_FakeStore.store["/bkt/run1/automl_manifest.json"])
+    assert manifest, "manifest is empty"
+    aml2 = h2o.AutoML(max_models=2, nfolds=3, seed=0,
+                      checkpoint_dir="s3://bkt/run1")
+    aml2.train(y="y", training_frame=fr)
+    assert aml2.leaderboard is not None
+    assert len(aml2.leaderboard.rows) >= len(manifest)
+
+
+def test_hdfs_create_follows_307_redirect(mesh8):
+    """A real namenode 307-redirects CREATE to a datanode URL; the
+    write must do the two-step PUT dance explicitly (urllib refuses to
+    follow redirects for PUT)."""
+
+    class _NameNode(BaseHTTPRequestHandler):
+        store: dict[str, bytes] = {}
+
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            path = self.path.split("?", 1)[0]
+            if "dn=1" not in self.path:            # namenode: redirect
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    f"http://127.0.0.1:{self.server.server_port}"
+                    f"{self.path}&dn=1")
+                self.end_headers()
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            self.store[path] = self.rfile.read(n)   # datanode: accept
+            self.send_response(201)
+            self.end_headers()
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            body = self.store.get(path, b"")
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = HTTPServer(("127.0.0.1", 0), _NameNode)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    old = os.environ.get("H2O_TPU_WEBHDFS")
+    os.environ["H2O_TPU_WEBHDFS"] = f"http://127.0.0.1:{srv.server_port}"
+    try:
+        h2o.persist.write_bytes("hdfs:///data/x.bin", b"payload")
+        assert _NameNode.store["/webhdfs/v1/data/x.bin"] == b"payload"
+        assert h2o.persist.read_bytes("hdfs:///data/x.bin") == b"payload"
+    finally:
+        os.environ.pop("H2O_TPU_WEBHDFS", None)
+        if old is not None:
+            os.environ["H2O_TPU_WEBHDFS"] = old
+        srv.shutdown()
+
+
+def test_hdfs_needs_namenode(mesh8):
+    old = os.environ.pop("H2O_TPU_WEBHDFS", None)
+    try:
+        with pytest.raises(ValueError, match="H2O_TPU_WEBHDFS"):
+            h2o.persist._read_bytes("hdfs:///x")
+    finally:
+        if old is not None:
+            os.environ["H2O_TPU_WEBHDFS"] = old
