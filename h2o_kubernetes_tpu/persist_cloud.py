@@ -59,8 +59,8 @@ def _http(method: str, url: str, data: bytes | None = None,
 
 # -- s3:// -------------------------------------------------------------------
 
-def _split_bucket_key(path: str, scheme: str) -> tuple[str, str]:
-    rest = path[len(scheme) + 3:]
+def _split_bucket_key(path: str) -> tuple[str, str]:
+    scheme, _, rest = path.partition("://")
     if "/" not in rest:
         raise ValueError(f"{path}: expected {scheme}://bucket/key")
     bucket, key = rest.split("/", 1)
@@ -95,7 +95,15 @@ def _sigv4_headers(method: str, host: str, canonical_uri: str,
     akid = os.environ.get("AWS_ACCESS_KEY_ID")
     secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
     payload_hash = hashlib.sha256(payload).hexdigest()
-    if not akid or not secret:
+    if bool(akid) != bool(secret):
+        # half-configured credentials (e.g. a failed secret mount) must
+        # not silently degrade to anonymous — the resulting 403 would
+        # point at bucket policy instead of the real misconfiguration
+        raise ValueError(
+            "AWS credentials half-configured: set BOTH "
+            "AWS_ACCESS_KEY_ID and AWS_SECRET_ACCESS_KEY (or neither "
+            "for anonymous access)")
+    if not akid:
         return {"x-amz-content-sha256": payload_hash}
     region = os.environ.get("AWS_REGION",
                             os.environ.get("AWS_DEFAULT_REGION",
@@ -133,14 +141,14 @@ def _sigv4_headers(method: str, host: str, canonical_uri: str,
 
 
 def s3_read(path: str) -> bytes:
-    bucket, key = _split_bucket_key(path, "s3")
+    bucket, key = _split_bucket_key(path)
     url, host, uri = _s3_url(bucket, key)
     return _http("GET", url, headers=_sigv4_headers("GET", host, uri,
                                                     b""))
 
 
 def s3_write(path: str, data: bytes) -> None:
-    bucket, key = _split_bucket_key(path, "s3")
+    bucket, key = _split_bucket_key(path)
     url, host, uri = _s3_url(bucket, key)
     _http("PUT", url, data=data,
           headers=_sigv4_headers("PUT", host, uri, data))
@@ -163,14 +171,14 @@ def _gs_headers() -> dict:
 
 
 def gs_read(path: str) -> bytes:
-    bucket, key = _split_bucket_key(path, "gs")
+    bucket, key = _split_bucket_key(path)
     obj = urllib.parse.quote(key, safe="")
     url = (f"{_gs_endpoint()}/storage/v1/b/{bucket}/o/{obj}?alt=media")
     return _http("GET", url, headers=_gs_headers())
 
 
 def gs_write(path: str, data: bytes) -> None:
-    bucket, key = _split_bucket_key(path, "gs")
+    bucket, key = _split_bucket_key(path)
     name = urllib.parse.quote(key, safe="")
     url = (f"{_gs_endpoint()}/upload/storage/v1/b/{bucket}/o"
            f"?uploadType=media&name={name}")

@@ -3,7 +3,11 @@
 Reference: h2o-core water/api (RequestServer + schemas3, SURVEY.md §2b
 C9): a Jetty server on :54321 where every client verb is a versioned
 endpoint — /3/Cloud, /3/ImportFiles, /3/Parse, /3/Frames,
-/3/ModelBuilders/{algo}, /3/Models, /3/Predictions, /3/Jobs.
+/3/ModelBuilders/{algo}, /3/Models, /3/Predictions, /3/Jobs,
+/99/AutoMLBuilder + /3/AutoML, /99/Grid, DELETE on frames/models,
+/3/Timeline, and the leader-only readiness probe
+/kubernetes/isLeaderNode (h2o-kubernetes [U] wires its readiness to
+this — only the clustered leader node answers 200).
 
 This build is Python-first (the client talks to the library directly),
 so the REST layer is a thin JSON adapter over the same registries the
@@ -24,8 +28,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 FRAMES: dict[str, object] = {}     # key -> Frame (DKV analog)
 MODELS: dict[str, object] = {}     # key -> Model
+AUTOML: dict[str, object] = {}     # project_name -> AutoML
+GRIDS: dict[str, object] = {}      # grid_id -> GridSearch
 _ID_LOCK = threading.Lock()
 _MODEL_SEQ = 0
+
+
+def _is_leader() -> bool:
+    """True on the clustered leader (process 0). The operator injects
+    H2O_TPU_PROCESS_ID into every pod (native/deployment/manifests.cc);
+    single-process clouds are their own leader."""
+    import os
+
+    return int(os.environ.get("H2O_TPU_PROCESS_ID", "0")) == 0
 
 _ALGOS = ("gbm", "drf", "glm", "deeplearning", "xgboost", "kmeans",
           "naivebayes", "pca", "isolationforest", "glrm", "coxph",
@@ -93,6 +108,39 @@ class _Handler(BaseHTTPRequestHandler):
                 from . import cluster_status
 
                 return self._json(cluster_status())
+            if path in ("/kubernetes/isLeaderNode", "/3/IsLeaderNode"):
+                # readiness must pass ONLY on the leader so the Service
+                # routes clients to one consistent node (reference
+                # /kubernetes/isLeaderNode, SURVEY.md §2b C2)
+                if _is_leader():
+                    return self._json({"leader": True})
+                return self._error(503, "not the leader node")
+            if path == "/3/Timeline":
+                from .diagnostics import timeline
+
+                return self._json({"events": timeline.events()})
+            if path.startswith("/3/AutoML/"):
+                key = path[len("/3/AutoML/"):]
+                if key not in AUTOML:
+                    return self._error(404, f"automl '{key}' not found")
+                aml = AUTOML[key]
+                lb = aml.leaderboard.as_list() if aml.leaderboard else []
+                leader = lb[0]["model_id"] if lb else None
+                return self._json({
+                    "project_name": key,
+                    "leader": {"name": leader},
+                    "leaderboard": lb,
+                    "sort_metric": aml.leaderboard.sort_metric
+                    if aml.leaderboard else None})
+            if path.startswith("/99/Grids/"):
+                key = path[len("/99/Grids/"):]
+                if key not in GRIDS:
+                    return self._error(404, f"grid '{key}' not found")
+                g = GRIDS[key]
+                return self._json({
+                    "grid_id": {"name": key},
+                    "model_ids": [{"name": m} for m in g.model_ids],
+                    "summary": g.get_grid()})
             if path == "/3/Jobs":
                 from .automl import jobs
 
@@ -149,6 +197,10 @@ class _Handler(BaseHTTPRequestHandler):
                     src.rsplit("/", 1)[-1]
                 FRAMES[key] = import_file(src)
                 return self._json(_frame_schema(key, FRAMES[key]))
+            if path in ("/3/AutoML", "/99/AutoMLBuilder"):
+                return self._build_automl(params)
+            if path.startswith("/99/Grid/"):
+                return self._build_grid(path[len("/99/Grid/"):], params)
             if path.startswith("/3/ModelBuilders/"):
                 algo = path[len("/3/ModelBuilders/"):]
                 if algo not in _ALGOS:
@@ -171,6 +223,135 @@ class _Handler(BaseHTTPRequestHandler):
             traceback.print_exc()
             return self._error(500, repr(e))
 
+    def do_DELETE(self):
+        try:
+            path = urllib.parse.urlparse(self.path).path.rstrip("/")
+            if path.startswith("/3/Frames/"):
+                key = path[len("/3/Frames/"):]
+                if FRAMES.pop(key, None) is None:
+                    return self._error(404, f"frame '{key}' not found")
+                return self._json({"frame_id": {"name": key},
+                                   "removed": True})
+            if path.startswith("/3/Models/"):
+                key = path[len("/3/Models/"):]
+                if MODELS.pop(key, None) is None:
+                    return self._error(404, f"model '{key}' not found")
+                return self._json({"model_id": {"name": key},
+                                   "removed": True})
+            if path == "/3/DKV":          # remove-all (h2o DELETE /3/DKV)
+                n = (len(FRAMES) + len(MODELS) + len(AUTOML)
+                     + len(GRIDS))
+                FRAMES.clear()
+                MODELS.clear()
+                AUTOML.clear()
+                GRIDS.clear()
+                return self._json({"removed": n})
+            return self._error(404, f"no route for DELETE {path}")
+        except Exception as e:       # noqa: BLE001
+            traceback.print_exc()
+            return self._error(500, repr(e))
+
+    @staticmethod
+    def _coerce(params: dict) -> dict:
+        """Form-encoded values arrive as strings — JSON-decode the
+        obvious scalars/lists ('50' -> 50, '[1,2]' -> [1,2])."""
+        kw = {}
+        for k, v in params.items():
+            if isinstance(v, str):
+                try:
+                    v = json.loads(v)
+                except (ValueError, TypeError):
+                    pass
+            kw[k] = v
+        return kw
+
+    def _run_job(self, job, fn, sync_timeout: float):
+        """Run fn on a worker thread under `job`, waiting up to
+        sync_timeout (the Job keeps running past the wait — poll
+        /3/Jobs, like the reference's async builds)."""
+        def run():
+            try:
+                fn()
+                job.done()
+            except Exception as e:     # noqa: BLE001
+                traceback.print_exc()
+                job.failed(repr(e))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(timeout=sync_timeout)
+
+    def _build_automl(self, params: dict):
+        from .automl import AutoML, Job
+
+        training = params.pop("training_frame", None)
+        if training not in FRAMES:
+            return self._error(404, f"frame '{training}' not found")
+        y = params.pop("response_column", params.pop("y", None))
+        if y is None:
+            return self._error(400, "missing 'response_column'")
+        sync_timeout = float(params.pop("_sync_timeout", 600))
+        # ids stay strings: _coerce would turn '2024' into int 2024 and
+        # the string-keyed GET routes could never find the registry entry
+        project = str(params.pop("project_name", "automl"))
+        kw = self._coerce(params)
+        kw["project_name"] = project
+        aml = AutoML(**kw)
+        AUTOML[project] = aml
+        # AutoML.train registers its own Job under the project name;
+        # the REST wrapper job tracks the HTTP build as a whole
+        job = Job(dest=f"{project}.rest",
+                  description=f"AutoML on {training}")
+        job.start()
+
+        def run():
+            aml.train(y=y, training_frame=FRAMES[training])
+            # publish every trained model into the DKV-analog registry
+            MODELS.update(aml.leaderboard.models)
+
+        self._run_job(job, run, sync_timeout)
+        return self._json({"job": {"dest": {"name": project},
+                                   "status": job.status,
+                                   "msg": job.msg},
+                           "project_name": project})
+
+    def _build_grid(self, algo: str, params: dict):
+        from .automl import Job
+        from .grid import GridSearch
+
+        if algo not in _ALGOS:
+            return self._error(404, f"unknown algo '{algo}'")
+        training = params.pop("training_frame", None)
+        if training not in FRAMES:
+            return self._error(404, f"frame '{training}' not found")
+        y = params.pop("response_column", params.pop("y", None))
+        sync_timeout = float(params.pop("_sync_timeout", 600))
+        grid_id = str(params.pop("grid_id", "") or f"grid_{algo}")
+        kw = self._coerce(params)
+        hyper = kw.pop("hyper_parameters", None)
+        if not isinstance(hyper, dict) or not hyper:
+            return self._error(400, "missing 'hyper_parameters' (JSON "
+                               "object of param -> list of values)")
+        criteria = kw.pop("search_criteria", None)
+        est = _algo_estimator(algo)(**kw)
+        gs = GridSearch(est, hyper, grid_id=grid_id,
+                        search_criteria=criteria)
+        GRIDS[grid_id] = gs
+        # GridSearch.train registers its own Job under grid_id
+        job = Job(dest=f"{grid_id}.rest",
+                  description=f"grid {algo} on {training}")
+        job.start()
+
+        def run():
+            gs.train(y=y, training_frame=FRAMES[training])
+            MODELS.update(gs.leaderboard.models)
+
+        self._run_job(job, run, sync_timeout)
+        return self._json({"job": {"dest": {"name": grid_id},
+                                   "status": job.status,
+                                   "msg": job.msg},
+                           "grid_id": {"name": grid_id}})
+
     def _build_model(self, algo: str, params: dict):
         from .automl import Job
 
@@ -186,36 +367,21 @@ class _Handler(BaseHTTPRequestHandler):
                 _MODEL_SEQ += 1
                 model_id = f"{algo}_{_MODEL_SEQ}"
         ignored = params.pop("ignored_columns", None)
-        # remaining params go to the estimator; numbers arrive as strings
-        # from form encoding — coerce the obvious ones
-        kw = {}
-        for k, v in params.items():
-            if isinstance(v, str):
-                try:
-                    v = json.loads(v)      # "50" -> 50, "true" -> True
-                except (ValueError, TypeError):
-                    pass
-            kw[k] = v
+        kw = self._coerce(params)
         job = Job(dest=model_id,
                   description=f"{algo} on {training}").start()
 
         def run():
-            try:
-                est = _algo_estimator(algo)(**kw)
-                if y is not None:
-                    model = est.train(y=y, training_frame=FRAMES[training],
-                                      ignored_columns=ignored)
-                else:
-                    model = est.train(training_frame=FRAMES[training],
-                                      ignored_columns=ignored)
-                MODELS[model_id] = model
-                job.done()
-            except Exception as e:     # noqa: BLE001
-                job.failed(repr(e))
+            est = _algo_estimator(algo)(**kw)
+            if y is not None:
+                model = est.train(y=y, training_frame=FRAMES[training],
+                                  ignored_columns=ignored)
+            else:
+                model = est.train(training_frame=FRAMES[training],
+                                  ignored_columns=ignored)
+            MODELS[model_id] = model
 
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
-        t.join(timeout=sync_timeout)
+        self._run_job(job, run, sync_timeout)
         return self._json({"job": {"dest": {"name": model_id},
                                    "status": job.status,
                                    "msg": job.msg}})

@@ -113,11 +113,14 @@ Json stateful_set(const H2OTpu& cr) {
   container["resources"] = Json(JsonObject{{"requests", requests},
                                            {"limits", limits}});
 
-  // leader-only readiness, like the reference's /kubernetes/isLeaderNode:
-  // clients routed through the service reach a formed cluster only
+  // leader-only readiness (the reference's /kubernetes/isLeaderNode,
+  // h2o-kubernetes [U]): the endpoint 503s on every non-leader process,
+  // so the Service routes clients only to the one consistent node —
+  // /3/Cloud would pass on ANY pod once its REST port is up
   Json probe = Json::object();
   probe["httpGet"] = Json(JsonObject{
-      {"path", Json("/3/Cloud")}, {"port", Json(kClientPort)}});
+      {"path", Json("/kubernetes/isLeaderNode")},
+      {"port", Json(kClientPort)}});
   probe["initialDelaySeconds"] = 10;
   probe["periodSeconds"] = 5;
   container["readinessProbe"] = probe;
