@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..frame import Frame
 from ..runtime.mesh import ROWS, global_mesh, n_row_shards
+from ..runtime.health import require_healthy
 from .base import Model, TrainData, resolve_xy
 from .datainfo import build_datainfo
 
@@ -316,6 +317,7 @@ class DeepLearning:
             return fn(net, opt_state, Xe, y_dev, data.w, key)
 
         for i in range(n_iters):
+            require_healthy()        # fail fast on a dead mesh (§5.3)
             key, ki = jax.random.split(key)
             net, opt_state = train_iter(net, opt_state, ki)
 

@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 
 from ..frame import Frame
+from ..runtime.health import require_healthy
 from ..runtime.mesh import global_mesh
 from .base import Model, TrainData, resolve_xy
 from .tree.binning import BinSpec, apply_bins, apply_bins_jit, fit_bins
@@ -462,6 +463,7 @@ class GBM:
             else 0
         t = start_t
         while t < p.ntrees:
+            require_healthy()        # fail fast on a dead mesh (§5.3)
             n = min(budget_chunk, p.ntrees - t)
             if score:
                 # stop at score boundaries, but never let the budget

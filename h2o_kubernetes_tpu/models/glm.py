@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..frame import Frame
 from ..runtime.mesh import COLS, ROWS, global_mesh
+from ..runtime.health import require_healthy
 from .base import Model, TrainData, resolve_xy
 from .datainfo import DataInfo, build_datainfo
 
@@ -442,6 +443,7 @@ class GLM:
         dev_prev = float(dev0)
         it = 0
         for it in range(1, p.max_iterations + 1):
+            require_healthy()   # fail fast on a dead mesh (§5.3)
             mu = _linkinv(fam, eta)            # eta reused from last solve
             wk, z = _irls_weights(fam, eta, mu, data.y)
             G, b = _gram_task(Xe, wk, z, data.w, mesh)
@@ -638,6 +640,7 @@ class GLM:
 
         prev, it = np.inf, 0
         for it in range(1, p.max_iterations + 1):
+            require_healthy()   # fail fast on a dead mesh (§5.3)
             B, state, value = step(B, state)
             v = float(value)
             if abs(prev - v) < p.objective_epsilon * (abs(prev) + 1e-10):
@@ -697,6 +700,7 @@ class GLM:
         prev = np.inf
         it = 0
         for it in range(1, p.max_iterations + 1):
+            require_healthy()   # fail fast on a dead mesh (§5.3)
             beta, state, value = step(beta, state)
             v = float(value)
             if abs(prev - v) < p.objective_epsilon * (abs(prev) + 1e-10):

@@ -33,6 +33,7 @@ from jax import lax
 
 from .. import metrics as M
 from ..frame import Frame
+from ..runtime.health import require_healthy
 from .base import resolve_xy
 from .gbm import GBM, GBMModel, _stacked_varimp
 from .tree.binning import apply_bins, apply_bins_jit, fit_bins
@@ -323,6 +324,7 @@ class XGBoost(GBM):
 
         mesh = global_mesh()
         for t in range(p.ntrees):
+            require_healthy()        # fail fast on a dead mesh (§5.3)
             key, kt = jax.random.split(key)
             margin, tree = _rank_round(
                 binned, margin, y_dense, maxdcg, layout.idx, layout.pos,

@@ -40,7 +40,13 @@ def _is_leader() -> bool:
     single-process clouds are their own leader."""
     import os
 
-    return int(os.environ.get("H2O_TPU_PROCESS_ID", "0")) == 0
+    raw = os.environ.get("H2O_TPU_PROCESS_ID") or "0"
+    try:
+        return int(raw) == 0
+    except ValueError:
+        # an unparseable pod index must read as not-leader (503), not
+        # crash the probe into a 500 on every pod
+        return False
 
 _ALGOS = ("gbm", "drf", "glm", "deeplearning", "xgboost", "kmeans",
           "naivebayes", "pca", "isolationforest", "glrm", "coxph",
@@ -325,6 +331,10 @@ class _Handler(BaseHTTPRequestHandler):
         if training not in FRAMES:
             return self._error(404, f"frame '{training}' not found")
         y = params.pop("response_column", params.pop("y", None))
+        if y is None:
+            # without it every combo fails silently into failed_params
+            # and the grid reports DONE with zero models
+            return self._error(400, "missing 'response_column'")
         sync_timeout = float(params.pop("_sync_timeout", 600))
         grid_id = str(params.pop("grid_id", "") or f"grid_{algo}")
         kw = self._coerce(params)

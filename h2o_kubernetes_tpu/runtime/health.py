@@ -8,9 +8,13 @@ dead ICI link, so the heartbeat is a tiny collective probe across the
 mesh executed under a deadline in a worker thread.
 
 Semantics mirror the reference — detection + fail-fast, no elasticity:
-once a probe fails, `healthy()` flips false, `require_healthy()` (run
-at every MRTask `doall`) raises `ClusterHealthError`, and
-`cluster_status()` reports unhealthy. Recovery is checkpoint-restart
+once a probe fails, `healthy()` flips false, `require_healthy()` raises
+`ClusterHealthError`, and `cluster_status()` reports unhealthy.
+`require_healthy()` guards every MRTask `doall`, train() entry
+(models/base.py resolve_xy), AND the hot driver loops that dispatch
+shard_map directly — GBM/DRF chunk boundaries, XGBoost rank rounds,
+GLM iterations, DL averaging rounds — so a dead mesh mid-train surfaces
+as a clean error, not a hang. Recovery is checkpoint-restart
 (persist/orbax + AutoML's resume manifest), not cloud re-formation.
 """
 
