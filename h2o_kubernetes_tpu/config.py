@@ -51,13 +51,34 @@ CONFIG: dict[str, Any] = {}
 
 
 def _load() -> None:
+    """Env tier. Validates with the SAME rules as set_config — a typo'd
+    H2O_TPU_NBINS must produce a clear message, not crash the package
+    import inside int()."""
     for key, default in _DEFAULTS.items():
         raw = os.environ.get(_ENV_KEYS[key])
         if raw is None:
             CONFIG.setdefault(key, default)
             continue
-        CONFIG[key] = type(default)(raw) if not isinstance(default, str) \
-            else raw
+        if not isinstance(default, str):
+            try:
+                raw = type(default)(raw)
+            except (ValueError, TypeError):
+                raise ValueError(
+                    f"bad {_ENV_KEYS[key]}={raw!r}: expected "
+                    f"{type(default).__name__}") from None
+        if key == "nbins" and not 4 <= raw <= 256:
+            raise ValueError(
+                f"bad {_ENV_KEYS[key]}={raw}: nbins must be in [4, 256]")
+        if key == "hist_impl" and raw not in ("auto", "pallas",
+                                              "segment"):
+            raise ValueError(
+                f"bad {_ENV_KEYS[key]}={raw!r}: must be "
+                "auto/pallas/segment")
+        if key == "log_level" and not isinstance(
+                getattr(logging, str(raw).upper(), None), int):
+            raise ValueError(
+                f"bad {_ENV_KEYS[key]}={raw!r}: unknown log level")
+        CONFIG[key] = raw
 
 
 def get_config(key: str) -> Any:

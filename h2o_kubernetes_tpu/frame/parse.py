@@ -329,11 +329,53 @@ def _looks_arff(path: str) -> bool:
     return False
 
 
-def _arff_unquote(tok: str) -> str:
-    tok = tok.strip()
-    if len(tok) >= 2 and tok[0] in "'\"" and tok[-1] == tok[0]:
-        return tok[1:-1]
-    return tok
+def _arff_split(line: str) -> list[str]:
+    """Split an ARFF record on commas honoring ARFF quoting: values may
+    be SINGLE- or double-quoted (ARFF convention is single quotes, which
+    the CSV splitter ignores — a domain like {'a,b','c'} or a quoted
+    data token containing a comma would mis-split), with backslash
+    escapes inside quotes. Quotes are removed and bare tokens stripped."""
+    out: list[str] = []
+    cur: list[str] = []
+    q: str | None = None
+    close_at: int | None = None   # cur length when the quote closed
+    i, n = 0, len(line)
+
+    def flush():
+        if close_at is None:
+            out.append("".join(cur).strip())
+        else:
+            # quoted fields keep inner spaces verbatim; whitespace
+            # AFTER the closing quote is separator padding, not content
+            out.append("".join(cur[:close_at])
+                       + "".join(cur[close_at:]).strip())
+
+    while i < n:
+        c = line[i]
+        if q is not None:
+            if c == "\\" and i + 1 < n:
+                cur.append(line[i + 1])
+                i += 2
+                continue
+            if c == q:
+                q = None
+                close_at = len(cur)
+            else:
+                cur.append(c)
+        elif c in "'\"" and not "".join(cur).strip():
+            # a quote only OPENS a field at its (whitespace-trimmed)
+            # start; mid-token apostrophes (don't) stay literal
+            cur = []                  # drop leading spaces before quote
+            q = c
+        elif c == ",":
+            flush()
+            cur = []
+            close_at = None
+        elif c not in "\r\n":
+            cur.append(c)
+        i += 1
+    flush()
+    return out
 
 
 def _import_arff(files: list[str], skipped: set[str]) -> Frame:
@@ -378,8 +420,7 @@ def _import_arff(files: list[str], skipped: set[str]) -> Frame:
                                     f"@attribute '{s}'")
                             aname, atype = parts
                         if atype.startswith("{"):
-                            dom = [_arff_unquote(t) for t in
-                                   _split_line(atype.strip("{}"), ",")]
+                            dom = _arff_split(atype.strip("{}"))
                             f_types.append(dom)
                         else:
                             t = atype.split()[0].lower()
@@ -415,8 +456,7 @@ def _import_arff(files: list[str], skipped: set[str]) -> Frame:
                         raise ValueError(
                             f"{fp}:{lineno}: sparse ARFF rows are not "
                             "supported")
-                    toks = [_arff_unquote(t)
-                            for t in _split_line(s, ",")]
+                    toks = _arff_split(s)
                     if len(toks) != len(names):
                         raise ValueError(
                             f"{fp}:{lineno}: {len(toks)} values, "

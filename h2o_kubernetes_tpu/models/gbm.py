@@ -199,11 +199,12 @@ class GBMModel(Model):
         return m
 
     def predict_leaf_node_assignment(self, frame: Frame,
-                                     type: str = "Node_ID") -> Frame:
+                                     type: str = "Path") -> Frame:
         """Per-row resting leaf per tree (h2o predict_leaf_node_assignment
         [U3]): one column per tree (`T1..Tk`, class-suffixed for
-        multinomial). `Node_ID` gives dense-heap indices; `Path` gives
-        the L/R descent string from the root (h2o's default)."""
+        multinomial). `Path` (the default, matching h2o) gives the L/R
+        descent string from the root; `Node_ID` gives dense-heap
+        indices."""
         from ..frame.frame import Vec
 
         if type not in ("Node_ID", "Path"):
@@ -242,6 +243,13 @@ class GBMModel(Model):
         if self.nclasses > 2:
             raise ValueError("predict_contributions supports binomial "
                              "and regression models only")
+        if np.isnan(np.asarray(self.trees.cover)).any():
+            # .any(), not .all(): checkpoint continuation from a
+            # pre-cover model mixes NaN-backfilled trees with real ones
+            raise ValueError(
+                "this model contains trees saved by a build without "
+                "per-node cover (pre-0.2); TreeSHAP needs it — retrain "
+                "with this build")
         from .tree.shap import ensemble_shap
 
         X = self._design_matrix(frame)

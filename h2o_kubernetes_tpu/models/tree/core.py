@@ -57,8 +57,11 @@ class Tree(NamedTuple):
     is_split: jax.Array     # bool  [N]
     value: jax.Array        # f32   [N] leaf value (valid where not split)
     gain: jax.Array         # f32   [N] split gain (varimp attribution)
-    cover: jax.Array        # f32   [N] training weight mass reaching the
-    #                         node (global, psum'd) — TreeSHAP's r_j
+    # f32 [N] training weight mass reaching the node (global, psum'd) —
+    # TreeSHAP's r_j. Defaulted so binary models pickled BEFORE this
+    # field existed (6-tuple Trees) still unpickle; load_model backfills
+    # the None (persist.py) and predict_contributions rejects it.
+    cover: jax.Array = None
 
 
 def _soft_thresh(g, alpha):

@@ -162,7 +162,15 @@ def load_model(path: str):
     data = _read_bytes(path)
     if not data.startswith(_MAGIC):
         raise ValueError(f"{path} is not an h2o_kubernetes_tpu model file")
-    return _HostUnpickler(io.BytesIO(data[len(_MAGIC):])).load()
+    model = _HostUnpickler(io.BytesIO(data[len(_MAGIC):])).load()
+    trees = getattr(model, "trees", None)
+    if trees is not None and getattr(trees, "cover", 1) is None:
+        # model was saved before Tree grew the cover field (r2): backfill
+        # a sentinel so predict/varimp work; predict_contributions
+        # detects the all-NaN cover and asks for a re-train
+        model.trees = trees._replace(
+            cover=np.full_like(np.asarray(trees.value), np.nan))
+    return model
 
 
 def export_file(frame, path: str, header: bool = True,

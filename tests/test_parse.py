@@ -299,7 +299,7 @@ def test_arff_basic(tmp_path):
 
     p = tmp_path / "w.arff"
     p.write_text(ARFF_DOC)
-    fr = h2o.import_file(str(p))
+    fr = import_file(str(p))
     assert fr.names == ["temp", "wind speed", "outlook", "note"]
     t = fr.vec("temp").to_numpy()
     assert np.isnan(t[1]) and abs(t[0] - 71.0) < 1e-5
@@ -317,7 +317,7 @@ def test_arff_content_sniff_without_extension(tmp_path):
 
     p = tmp_path / "noext.dat"
     p.write_text(ARFF_DOC)
-    fr = h2o.import_file(str(p))
+    fr = import_file(str(p))
     assert fr.shape == (3, 4)
 
 
@@ -363,3 +363,24 @@ def test_arff_unterminated_quote_diagnostic(tmp_path):
     p.write_text("@relation r\n@attribute 'wind speed numeric\n@data\n")
     with pytest.raises(ValueError, match="unterminated"):
         h2o.import_file(str(p))
+
+
+def test_arff_single_quoted_domains_and_values(tmp_path, mesh8):
+    """ARFF conventionally single-quotes; a domain like {'a,b','c'} or a
+    quoted data token with a comma must not mis-split (r2 ADVICE)."""
+    p = tmp_path / "q.arff"
+    p.write_text(
+        "@relation t\n"
+        "@attribute g {'a,b','c d',plain}\n"
+        "@attribute x numeric\n"
+        "@data\n"
+        "'a,b',1\n"
+        "'c d',2\n"
+        "plain,3\n"
+        "?,4\n")
+    fr = import_file(str(p))
+    v = fr.vec("g")
+    assert v.domain == ["a,b", "c d", "plain"]
+    codes = v.to_numpy().astype(int)
+    assert list(codes[:3]) == [0, 1, 2] and codes[3] < 0
+    np.testing.assert_allclose(fr.vec("x").to_numpy(), [1, 2, 3, 4])

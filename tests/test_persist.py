@@ -358,3 +358,25 @@ def test_mojo_frame_kind_mismatch_raises(tmp_path, mesh8):
     bad_fr = h2o.Frame(bad)
     with pytest.raises(ValueError, match="categorical at training"):
         mj.predict(bad_fr)
+
+
+def test_load_model_backfills_missing_cover(tmp_path, mesh8):
+    """Binary models saved before Tree grew `cover` (6-field pickles)
+    must still load: predict works, contributions ask for a retrain
+    (r2 ADVICE)."""
+    from h2o_kubernetes_tpu.models.tree.core import Tree
+
+    fr = _frame()
+    m = GBM(ntrees=4, max_depth=3, seed=7).train(y="y", training_frame=fr)
+    want = np.asarray(m.predict_raw(fr))
+    # simulate a pre-cover artifact: drop the cover field before saving
+    m.trees = Tree(m.trees.split_feat, m.trees.split_bin, m.trees.na_left,
+                   m.trees.is_split, m.trees.value, m.trees.gain)
+    assert m.trees.cover is None
+    path = h2o.save_model(m, str(tmp_path / "old.model"))
+    m2 = h2o.load_model(path)
+    assert np.isnan(np.asarray(m2.trees.cover)).all()
+    np.testing.assert_allclose(np.asarray(m2.predict_raw(fr)), want,
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="per-node cover"):
+        m2.predict_contributions(fr)

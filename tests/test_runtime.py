@@ -124,3 +124,21 @@ def test_bad_log_level_rejected_before_assignment():
     with pytest.raises(ValueError, match="log level"):
         C.set_config("log_level", "verbose")
     assert C.get_config("log_level") == before
+
+
+def test_env_config_validation(monkeypatch):
+    """A typo'd H2O_TPU_NBINS must give a clear error, not a bare
+    int() traceback at import (r2 ADVICE)."""
+    from h2o_kubernetes_tpu import config as C
+
+    monkeypatch.setenv("H2O_TPU_NBINS", "lots")
+    with pytest.raises(ValueError, match="bad H2O_TPU_NBINS"):
+        C._load()
+    monkeypatch.setenv("H2O_TPU_NBINS", "3")
+    with pytest.raises(ValueError, match=r"\[4, 256\]"):
+        C._load()
+    monkeypatch.setenv("H2O_TPU_NBINS", "64")
+    C._load()
+    assert C.CONFIG["nbins"] == 64
+    monkeypatch.delenv("H2O_TPU_NBINS")
+    C.CONFIG["nbins"] = 256          # restore the default for the suite

@@ -236,7 +236,7 @@ def test_leaf_node_assignment_consistent_with_predictions(frame):
 
     m = GBM(ntrees=4, max_depth=3, seed=3).train(
         y="y", training_frame=frame)
-    la = m.predict_leaf_node_assignment(frame)            # Node_ID
+    la = m.predict_leaf_node_assignment(frame, type="Node_ID")
     assert la.names == ["T1", "T2", "T3", "T4"]
     # rebuilding the margin from assigned leaves reproduces _margins
     vals = np.asarray(m.trees.value)                      # [T, N]
