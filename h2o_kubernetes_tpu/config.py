@@ -50,8 +50,24 @@ _ENV_KEYS = {
 CONFIG: dict[str, Any] = {}
 
 
+def _validate(key: str, value: Any) -> Any:
+    """ONE rule set for both tiers (env `_load` and programmatic
+    `set_config`); returns the coerced value or raises ValueError."""
+    if key == "nbins":
+        value = int(value)
+        if not 4 <= value <= 256:
+            raise ValueError("nbins must be in [4, 256]")
+    if key == "hist_impl" and value not in ("auto", "pallas", "segment"):
+        raise ValueError(f"hist_impl must be auto/pallas/segment, "
+                         f"got '{value}'")
+    if key == "log_level" and not isinstance(
+            getattr(logging, str(value).upper(), None), int):
+        raise ValueError(f"unknown log level '{value}'")
+    return value
+
+
 def _load() -> None:
-    """Env tier. Validates with the SAME rules as set_config — a typo'd
+    """Env tier. Shares _validate with set_config — a typo'd
     H2O_TPU_NBINS must produce a clear message, not crash the package
     import inside int()."""
     for key, default in _DEFAULTS.items():
@@ -59,26 +75,11 @@ def _load() -> None:
         if raw is None:
             CONFIG.setdefault(key, default)
             continue
-        if not isinstance(default, str):
-            try:
-                raw = type(default)(raw)
-            except (ValueError, TypeError):
-                raise ValueError(
-                    f"bad {_ENV_KEYS[key]}={raw!r}: expected "
-                    f"{type(default).__name__}") from None
-        if key == "nbins" and not 4 <= raw <= 256:
+        try:
+            CONFIG[key] = _validate(key, raw)
+        except (ValueError, TypeError) as e:
             raise ValueError(
-                f"bad {_ENV_KEYS[key]}={raw}: nbins must be in [4, 256]")
-        if key == "hist_impl" and raw not in ("auto", "pallas",
-                                              "segment"):
-            raise ValueError(
-                f"bad {_ENV_KEYS[key]}={raw!r}: must be "
-                "auto/pallas/segment")
-        if key == "log_level" and not isinstance(
-                getattr(logging, str(raw).upper(), None), int):
-            raise ValueError(
-                f"bad {_ENV_KEYS[key]}={raw!r}: unknown log level")
-        CONFIG[key] = raw
+                f"bad {_ENV_KEYS[key]}={raw!r}: {e}") from None
 
 
 def get_config(key: str) -> Any:
@@ -94,24 +95,12 @@ def set_config(key: str, value: Any) -> None:
     if key not in _DEFAULTS:
         raise KeyError(f"unknown config key '{key}' "
                        f"(known: {sorted(_DEFAULTS)})")
-    if key == "hist_impl" and value not in ("auto", "pallas", "segment"):
-        raise ValueError(f"hist_impl must be auto/pallas/segment, "
-                         f"got '{value}'")
-    if key == "nbins":
-        value = int(value)
-        if not 4 <= value <= 256:
-            raise ValueError("nbins must be in [4, 256]")
+    value = _validate(key, value)   # raises BEFORE assignment
+    CONFIG[key] = value
     if key == "log_level":
-        # validate BEFORE assignment so CONFIG never holds a bad level
-        level = getattr(logging, str(value).upper(), None)
-        if not isinstance(level, int):
-            raise ValueError(f"unknown log level '{value}'")
-        CONFIG[key] = value
         from .diagnostics import log
 
-        log.setLevel(level)
-        return
-    CONFIG[key] = value
+        log.setLevel(getattr(logging, str(value).upper()))
 
 
 _load()

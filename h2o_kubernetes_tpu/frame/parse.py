@@ -374,6 +374,10 @@ def _arff_split(line: str) -> list[str]:
         elif c not in "\r\n":
             cur.append(c)
         i += 1
+    if q is not None:
+        # silently closing would corrupt the token and swallow commas
+        raise ValueError(f"unterminated {q} quote in ARFF record: "
+                         f"{line[:80]!r}")
     flush()
     return out
 

@@ -143,7 +143,7 @@ std::string reconcile(ApiClient& api, const H2OTpu& cr) {
   return action.empty() ? "noop" : action;
 }
 
-void run_operator(ApiClient& api, long watch_timeout_s) {
+void run_operator(ApiClient& api, long watch_timeout_s, bool once) {
   ensure_crd(api);
   log_line("CRD ensured; entering watch loop");
   std::string all_path =
@@ -170,8 +170,10 @@ void run_operator(ApiClient& api, long watch_timeout_s) {
                      e.what());
           }
         }
+      if (once) return;  // single list+reconcile sweep (CI e2e)
       backoff_s = 1;
     } catch (const std::exception& e) {
+      if (once) throw;
       log_line(std::string("list error: ") + e.what() + "; backoff " +
                std::to_string(backoff_s) + "s");
       std::this_thread::sleep_for(std::chrono::seconds(backoff_s));

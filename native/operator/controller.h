@@ -21,6 +21,9 @@ std::string reconcile(ApiClient& api, const H2OTpu& cr);
 
 // list+watch loop; runs until the process is stopped. watch_timeout_s
 // bounds each watch window (the loop re-lists after every window).
-void run_operator(ApiClient& api, long watch_timeout_s = 300);
+// once=true performs a single list+reconcile sweep and returns (the
+// CI e2e drives this against a real control plane).
+void run_operator(ApiClient& api, long watch_timeout_s = 300,
+                  bool once = false);
 
 }  // namespace tpuk

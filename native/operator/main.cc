@@ -13,7 +13,7 @@ namespace {
 void usage() {
   std::fprintf(stderr,
                "usage: h2o-tpu-operator [--kubeconfig PATH]"
-               " [--server URL --token TOKEN [--insecure]]\n"
+               " [--server URL --token TOKEN [--insecure]] [--once]\n"
                "Defaults to $KUBECONFIG, ~/.kube/config, then in-cluster"
                " config.\n");
 }
@@ -23,6 +23,7 @@ void usage() {
 int main(int argc, char** argv) {
   std::string kubeconfig, server, token;
   bool insecure = false;
+  bool once = false;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&]() -> std::string {
@@ -33,6 +34,7 @@ int main(int argc, char** argv) {
     else if (a == "--server") server = next();
     else if (a == "--token") token = next();
     else if (a == "--insecure") insecure = true;
+    else if (a == "--once") once = true;
     else if (a == "-h" || a == "--help") { usage(); return 0; }
     else { usage(); return 2; }
   }
@@ -46,7 +48,7 @@ int main(int argc, char** argv) {
       cfg = tpuk::K8sConfig::resolve(kubeconfig);
     }
     auto api = tpuk::make_curl_client(cfg);
-    tpuk::run_operator(*api);
+    tpuk::run_operator(*api, 300, once);
   } catch (const std::exception& e) {
     std::fprintf(stderr, "h2o-tpu-operator: fatal: %s\n", e.what());
     return 1;

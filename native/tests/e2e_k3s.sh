@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Real-control-plane e2e for the native stack (SURVEY.md §4a: the
+# reference's CI runs deploy/undeploy against throwaway k3s, no API
+# mocks). Drives the tpuk CLI against a live apiserver and asserts the
+# Kubernetes RESOURCES exist and clean up. Pods cannot become Ready on
+# a TPU-less runner (TPU nodeselector + google.com/tpu limits), so
+# deploy runs with --timeout 0 and the assertions are resource-level.
+#
+# usage: e2e_k3s.sh <build-dir> <kubeconfig>
+set -euo pipefail
+
+BUILD=$(cd "${1:?build dir}" && pwd)   # absolute: we cd away below
+export KUBECONFIG=${2:?kubeconfig}
+TPUK="$BUILD/tpuk"
+KUBECTL="${KUBECTL:-sudo k3s kubectl}"
+NAME=e2e-test
+
+fail() { echo "E2E FAIL: $*" >&2; exit 1; }
+
+cd "$(mktemp -d)"
+
+# deploy: CRD ensured, CR + StatefulSet + headless Service created
+"$TPUK" deploy --name "$NAME" --cluster-size 2 --timeout 0 \
+    --kubeconfig "$KUBECONFIG"
+[ -f "$NAME.tpuk" ] || fail "descriptor file not written"
+
+$KUBECTL get crd h2otpus.tpu.h2o.ai >/dev/null || fail "CRD missing"
+$KUBECTL get h2otpu "$NAME" >/dev/null || fail "CR missing"
+$KUBECTL get statefulset "$NAME" >/dev/null || fail "StatefulSet missing"
+$KUBECTL get service "$NAME" >/dev/null || fail "Service missing"
+replicas=$($KUBECTL get statefulset "$NAME" -o jsonpath='{.spec.replicas}')
+[ "$replicas" = "2" ] || fail "expected 2 replicas, got $replicas"
+
+# status runs against the live apiserver
+"$TPUK" status --name "$NAME" --kubeconfig "$KUBECONFIG" || \
+    fail "status failed"
+
+# one operator reconcile pass: drift repair on a live control plane —
+# delete the StatefulSet, let the operator recreate it
+$KUBECTL delete statefulset "$NAME" --wait=true
+timeout 60 "$BUILD/h2o-tpu-operator" --once --kubeconfig "$KUBECONFIG" \
+    || fail "operator reconcile pass failed"
+$KUBECTL get statefulset "$NAME" >/dev/null || \
+    fail "operator did not repair the deleted StatefulSet"
+
+# undeploy: everything gone (CRD itself stays, like the reference)
+"$TPUK" undeploy -f "$NAME.tpuk" --kubeconfig "$KUBECONFIG"
+$KUBECTL get h2otpu "$NAME" >/dev/null 2>&1 && fail "CR not removed"
+$KUBECTL get statefulset "$NAME" >/dev/null 2>&1 && \
+    fail "StatefulSet not removed"
+$KUBECTL get service "$NAME" >/dev/null 2>&1 && fail "Service not removed"
+
+echo "E2E PASS"

@@ -1,0 +1,115 @@
+"""TPU kernel-compile gate — run at round start, BEFORE the bench.
+
+CPU CI can only exercise the Pallas kernels in interpret mode
+(`ops/histogram.py` sets `interpret=jax.default_backend() != "tpu"`),
+so a Mosaic-lowering regression lands green and is discovered on the
+bench chip at round's end.  This script closes that hole: on a TPU it
+
+1. pallas-compiles the FACTORIZED histogram kernel (interpret=False is
+   automatic on tpu) at a bench-like shape and asserts parity vs the
+   segment_sum reference path;
+2. same for the BIN-BLOCKED kernel (deep-tree shape past the
+   factorized VMEM cap);
+3. jit-compiles and runs the fused boost scan (binomial AND
+   multinomial) end to end on small shapes.
+
+Prints one JSON line {"gate": "pass"|"fail", ...}; exit code 0 on pass.
+On CPU it still runs (interpret-mode parity) and reports
+platform="cpu" so the ritual can tell the gate did not see a chip.
+
+Usage: python tools/kernel_gate.py  (H2O_TPU_PROBE_BUDGET honored)
+"""
+
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    from h2o_kubernetes_tpu.runtime.backend import ensure_live_backend
+
+    ensure_live_backend(budget=float(
+        os.environ.get("H2O_TPU_PROBE_BUDGET", "300")))
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from h2o_kubernetes_tpu.ops.histogram import (_FACT_MAX_NHI,
+                                                  _hist_segment,
+                                                  build_histogram)
+
+    platform = jax.default_backend()
+    rng = np.random.default_rng(0)
+    checks = []
+
+    def parity(name, rows, F, n_nodes, n_bins):
+        binned = jnp.asarray(
+            rng.integers(0, n_bins, size=(rows, F)).astype(np.uint8))
+        rel = jnp.asarray(np.where(
+            rng.uniform(size=rows) < 0.9,
+            rng.integers(0, n_nodes, size=rows), -1).astype(np.int32))
+        g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+        h = jnp.asarray(rng.uniform(0.01, 1, size=rows).astype(
+            np.float32))
+        w = jnp.asarray((rng.uniform(size=rows) < 0.95).astype(
+            np.float32))
+        got = jax.jit(build_histogram, static_argnums=(5, 6, 7))(
+            binned, rel, g, h, w, n_nodes, n_bins, "pallas")
+        live = (np.asarray(rel) >= 0) & (np.asarray(w) > 0)
+        vals = np.where(live[:, None],
+                        np.stack([np.asarray(g) * np.asarray(w),
+                                  np.asarray(h) * np.asarray(w),
+                                  np.asarray(w)], axis=1), 0.0)
+        want = _hist_segment(binned, jnp.where(jnp.asarray(live),
+                                               rel, -1),
+                             jnp.asarray(vals), n_nodes, n_bins)
+        err = float(jnp.max(jnp.abs(got - jnp.asarray(want))) /
+                    (jnp.max(jnp.abs(jnp.asarray(want))) + 1e-30))
+        ok = err < 1e-5
+        checks.append({"check": name, "ok": ok, "rel_err": err})
+        return ok
+
+    # 1. factorized kernel: node·bins within 128·_FACT_MAX_NHI
+    n_nodes_fact = 16
+    assert -(-n_nodes_fact * 256 // 128) <= _FACT_MAX_NHI
+    parity("fact_kernel", 100_000, 10, n_nodes_fact, 256)
+    # 2. bin-blocked kernel: force past the factorized cap
+    n_nodes_deep = (_FACT_MAX_NHI * 128 // 256) * 2
+    parity("binblock_kernel", 50_000, 4, n_nodes_deep, 256)
+
+    # 3. fused boost scans compile + run (binomial and multinomial)
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+
+    n = 4096
+    x = rng.normal(size=n).astype(np.float32)
+    y2 = np.where(x > 0, "p", "n")
+    fr2 = h2o.Frame.from_arrays({"x": x, "y": y2})
+    m2 = GBM(ntrees=3, max_depth=4, seed=0).train(
+        y="y", training_frame=fr2)
+    checks.append({"check": "boost_scan_binomial",
+                   "ok": len(m2.scoring_history) > 0})
+    y3 = np.where(x > 0.5, "a", np.where(x < -0.5, "b", "c"))
+    fr3 = h2o.Frame.from_arrays({"x": x, "y": y3})
+    m3 = GBM(ntrees=3, max_depth=3, seed=0).train(
+        y="y", training_frame=fr3)
+    checks.append({"check": "boost_scan_multinomial",
+                   "ok": m3.ntrees == 9})
+
+    ok = all(c["ok"] for c in checks)
+    print(json.dumps({"gate": "pass" if ok else "fail",
+                      "platform": platform, "checks": checks}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:     # the gate must report, not traceback-die
+        traceback.print_exc()
+        print(json.dumps({"gate": "fail", "error": repr(e)[:300]}))
+        sys.exit(1)
