@@ -424,7 +424,11 @@ def _import_arff(files: list[str], skipped: set[str]) -> Frame:
                                     f"@attribute '{s}'")
                             aname, atype = parts
                         if atype.startswith("{"):
-                            dom = _arff_split(atype.strip("{}"))
+                            try:
+                                dom = _arff_split(atype.strip("{}"))
+                            except ValueError as e:
+                                raise ValueError(
+                                    f"{fp}:{lineno}: {e}") from None
                             f_types.append(dom)
                         else:
                             t = atype.split()[0].lower()
@@ -460,7 +464,10 @@ def _import_arff(files: list[str], skipped: set[str]) -> Frame:
                         raise ValueError(
                             f"{fp}:{lineno}: sparse ARFF rows are not "
                             "supported")
-                    toks = _arff_split(s)
+                    try:
+                        toks = _arff_split(s)
+                    except ValueError as e:
+                        raise ValueError(f"{fp}:{lineno}: {e}") from None
                     if len(toks) != len(names):
                         raise ValueError(
                             f"{fp}:{lineno}: {len(toks)} values, "

@@ -162,6 +162,23 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
     for d in range(p.max_depth + 1):
         n_nodes = 2 ** d
         off = n_nodes - 1
+        if d == p.max_depth:
+            # final level: every node is a forced leaf, so per-node
+            # (G, H, C) totals suffice — building the full [n, F, B, 3]
+            # histogram here would be HALF the tree's matmul work (the
+            # deepest level's Nhi equals the sum of all shallower
+            # levels') for data _find_splits immediately collapses to
+            # totals. One single-bin histogram = one [3,T]x[T,128] pass.
+            zero_bin = jnp.zeros((binned.shape[0], 1),
+                                 dtype=binned.dtype)
+            tot = _build_histogram_op(zero_bin, rel, g, h, w, n_nodes,
+                                      1, impl=p.hist_impl)
+            tot = lax.psum(tot, ROWS)[:, 0, 0, :]       # [n_nodes, 3]
+            idx = off + jnp.arange(n_nodes)
+            value = value.at[idx].set(
+                _leaf_value(tot[:, 0], tot[:, 1], p))
+            cover = cover.at[idx].set(tot[:, 2])
+            break
         if d == 0:
             hist = _build_histogram_op(binned, rel, g, h, w, 1,
                                        p.n_bins, impl=p.hist_impl)
@@ -195,8 +212,6 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             feat_ok = feat_ok & (r <= kth)
         feat, bin_, na_l, can, val, g_best, cov = _find_splits(hist, p,
                                                                feat_ok)
-        if d == p.max_depth:                            # final level: leaves
-            can = jnp.zeros_like(can)
         idx = off + jnp.arange(n_nodes)
         split_feat = split_feat.at[idx].set(jnp.where(can, feat, -1))
         split_bin = split_bin.at[idx].set(bin_)
@@ -205,8 +220,6 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         value = value.at[idx].set(val)
         gain = gain.at[idx].set(jnp.where(can, g_best, 0.0))
         cover = cover.at[idx].set(cov)
-        if d == p.max_depth:
-            break
         hist_prev, can_prev = hist, can
         # descend rows: dead rows stay dead; rows in non-split nodes die
         live = rel >= 0

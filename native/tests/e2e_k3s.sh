@@ -1,10 +1,17 @@
 #!/usr/bin/env bash
 # Real-control-plane e2e for the native stack (SURVEY.md §4a: the
 # reference's CI runs deploy/undeploy against throwaway k3s, no API
-# mocks). Drives the tpuk CLI against a live apiserver and asserts the
-# Kubernetes RESOURCES exist and clean up. Pods cannot become Ready on
-# a TPU-less runner (TPU nodeselector + google.com/tpu limits), so
-# deploy runs with --timeout 0 and the assertions are resource-level.
+# mocks). Two tiers, matching the split of responsibilities:
+#
+#   CLI tier      tpuk deploy/status/undeploy manage the Service +
+#                 StatefulSet directly (like the reference CLI).
+#   Operator tier h2o-tpu-operator owns the CRD + H2OTpu CRs: ensure
+#                 CRD, reconcile CR -> svc/sts, drift repair, finalizer
+#                 teardown on CR deletion.
+#
+# Pods cannot become Ready on a TPU-less runner (TPU nodeselector +
+# google.com/tpu limits), so deploy runs with --timeout 0 and the
+# assertions are resource-level.
 #
 # usage: e2e_k3s.sh <build-dir> <kubeconfig>
 set -euo pipefail
@@ -12,42 +19,74 @@ set -euo pipefail
 BUILD=$(cd "${1:?build dir}" && pwd)   # absolute: we cd away below
 export KUBECONFIG=${2:?kubeconfig}
 TPUK="$BUILD/tpuk"
+OPERATOR="$BUILD/h2o-tpu-operator"
 KUBECTL="${KUBECTL:-sudo k3s kubectl}"
-NAME=e2e-test
 
 fail() { echo "E2E FAIL: $*" >&2; exit 1; }
 
 cd "$(mktemp -d)"
 
-# deploy: CRD ensured, CR + StatefulSet + headless Service created
+# ---- CLI tier: deploy -> status -> undeploy ------------------------------
+NAME=e2e-cli
 "$TPUK" deploy --name "$NAME" --cluster-size 2 --timeout 0 \
     --kubeconfig "$KUBECONFIG"
 [ -f "$NAME.tpuk" ] || fail "descriptor file not written"
-
-$KUBECTL get crd h2otpus.tpu.h2o.ai >/dev/null || fail "CRD missing"
-$KUBECTL get h2otpu "$NAME" >/dev/null || fail "CR missing"
 $KUBECTL get statefulset "$NAME" >/dev/null || fail "StatefulSet missing"
 $KUBECTL get service "$NAME" >/dev/null || fail "Service missing"
 replicas=$($KUBECTL get statefulset "$NAME" -o jsonpath='{.spec.replicas}')
 [ "$replicas" = "2" ] || fail "expected 2 replicas, got $replicas"
 
-# status runs against the live apiserver
 "$TPUK" status --name "$NAME" --kubeconfig "$KUBECONFIG" || \
     fail "status failed"
 
-# one operator reconcile pass: drift repair on a live control plane —
-# delete the StatefulSet, let the operator recreate it
-$KUBECTL delete statefulset "$NAME" --wait=true
-timeout 60 "$BUILD/h2o-tpu-operator" --once --kubeconfig "$KUBECONFIG" \
-    || fail "operator reconcile pass failed"
-$KUBECTL get statefulset "$NAME" >/dev/null || \
-    fail "operator did not repair the deleted StatefulSet"
-
-# undeploy: everything gone (CRD itself stays, like the reference)
 "$TPUK" undeploy -f "$NAME.tpuk" --kubeconfig "$KUBECONFIG"
-$KUBECTL get h2otpu "$NAME" >/dev/null 2>&1 && fail "CR not removed"
 $KUBECTL get statefulset "$NAME" >/dev/null 2>&1 && \
     fail "StatefulSet not removed"
 $KUBECTL get service "$NAME" >/dev/null 2>&1 && fail "Service not removed"
+
+# ---- operator tier: CRD + CR lifecycle -----------------------------------
+OPNAME=e2e-op
+# --once: ensure CRD + one list/reconcile sweep (no CRs yet)
+timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \
+    fail "operator --once (CRD ensure) failed"
+$KUBECTL get crd h2otpus.tpu.h2o.ai >/dev/null || fail "CRD missing"
+
+# extract the CR from the manifest bundle and apply it
+"$TPUK" manifest --name "$OPNAME" --cluster-size 1 > bundle.json
+python3 - <<'PY'
+import json
+b = json.load(open("bundle.json"))
+json.dump(b["customResource"], open("cr.json", "w"))
+PY
+$KUBECTL apply -f cr.json
+
+# reconcile: CR -> Service + StatefulSet (+ finalizer + status)
+timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \
+    fail "operator reconcile failed"
+$KUBECTL get statefulset "$OPNAME" >/dev/null || \
+    fail "operator did not create the StatefulSet"
+$KUBECTL get service "$OPNAME" >/dev/null || \
+    fail "operator did not create the Service"
+fin=$($KUBECTL get h2otpu "$OPNAME" -o jsonpath='{.metadata.finalizers[0]}')
+[ -n "$fin" ] || fail "operator did not add a finalizer"
+
+# drift repair on a live control plane: delete the StatefulSet, let the
+# operator recreate it
+$KUBECTL delete statefulset "$OPNAME" --wait=true
+timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \
+    fail "operator repair pass failed"
+$KUBECTL get statefulset "$OPNAME" >/dev/null || \
+    fail "operator did not repair the deleted StatefulSet"
+
+# CR deletion: teardown + finalizer release lets K8s GC complete
+$KUBECTL delete h2otpu "$OPNAME" --wait=false
+timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \
+    fail "operator teardown pass failed"
+$KUBECTL get h2otpu "$OPNAME" >/dev/null 2>&1 && \
+    fail "CR stuck (finalizer not released)"
+$KUBECTL get statefulset "$OPNAME" >/dev/null 2>&1 && \
+    fail "operator did not tear down the StatefulSet"
+$KUBECTL get service "$OPNAME" >/dev/null 2>&1 && \
+    fail "operator did not tear down the Service"
 
 echo "E2E PASS"

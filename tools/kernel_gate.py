@@ -80,6 +80,8 @@ def main() -> int:
     # 2. bin-blocked kernel: force past the factorized cap
     n_nodes_deep = (_FACT_MAX_NHI * 128 // 256) * 2
     parity("binblock_kernel", 50_000, 4, n_nodes_deep, 256)
+    # 2b. single-bin totals shape (the final-level leaf reduction)
+    parity("leaf_totals_kernel", 100_000, 1, 32, 1)
 
     # 3. fused boost scans compile + run (binomial and multinomial)
     import h2o_kubernetes_tpu as h2o
