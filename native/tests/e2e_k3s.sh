@@ -46,10 +46,18 @@ $KUBECTL get service "$NAME" >/dev/null 2>&1 && fail "Service not removed"
 
 # ---- operator tier: CRD + CR lifecycle -----------------------------------
 OPNAME=e2e-op
-# --once: ensure CRD + one list/reconcile sweep (no CRs yet)
-timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \
-    fail "operator --once (CRD ensure) failed"
+# --once: ensure CRD + one list/reconcile sweep (no CRs yet). The first
+# sweep can race CRD establishment on a fresh apiserver — retry once
+# after waiting for the Established condition.
+if ! timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG"; then
+    $KUBECTL wait --for condition=established --timeout=60s \
+        crd/h2otpus.tpu.h2o.ai || fail "CRD never established"
+    timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \
+        fail "operator --once (CRD ensure) failed"
+fi
 $KUBECTL get crd h2otpus.tpu.h2o.ai >/dev/null || fail "CRD missing"
+$KUBECTL wait --for condition=established --timeout=60s \
+    crd/h2otpus.tpu.h2o.ai || fail "CRD never established"
 
 # extract the CR from the manifest bundle and apply it
 "$TPUK" manifest --name "$OPNAME" --cluster-size 1 > bundle.json
