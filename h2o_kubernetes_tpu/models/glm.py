@@ -217,25 +217,28 @@ def _gram_task(Xe, wk, z, w, mesh):
     return G[:Pn, :Pn], b[:Pn]
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
+@functools.partial(jax.jit, static_argnums=(4,))
 def _softmax_irls_task(Xe, B, yw, k, mesh):
     """Per-class IRLS working (wk, z) from the multinomial softmax at
     the current [P, K] coefficients — the class-k block of the
     block-diagonal Fisher update (reference: GLM.java solves
-    multinomial under IRLSM by cycling classes, SURVEY.md §2b C11)."""
+    multinomial under IRLSM by cycling classes, SURVEY.md §2b C11).
+    `k` is TRACED (one compile serves every class — K static variants
+    would recompile the shard_map per class)."""
 
-    def body(xs, yws, b):
+    def body(xs, yws, b, kk):
         eta = xs @ b                               # [r, K]
-        pk = jax.nn.softmax(eta, axis=1)[:, k]
+        pk = jnp.take(jax.nn.softmax(eta, axis=1), kk, axis=1)
         pk = jnp.clip(pk, 1e-10, 1.0 - 1e-10)
         wk = jnp.clip(pk * (1.0 - pk), 1e-10, None)
-        yk = (yws[:, 0] == k).astype(jnp.float32)
-        z = eta[:, k] + (yk - pk) / wk
+        yk = (yws[:, 0] == kk).astype(jnp.float32)
+        z = jnp.take(eta, kk, axis=1) + (yk - pk) / wk
         return wk, z
 
     return jax.shard_map(body, mesh=mesh,
-                         in_specs=(P(ROWS), P(ROWS), P()),
-                         out_specs=(P(ROWS), P(ROWS)))(Xe, yw, B)
+                         in_specs=(P(ROWS), P(ROWS), P(), P()),
+                         out_specs=(P(ROWS), P(ROWS)))(
+        Xe, yw, B, jnp.asarray(k, dtype=jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -291,6 +294,18 @@ def _chol_solve(G, b, lam_l2):
     pen = jnp.ones(Pn).at[Pn - 1].set(0.0) * lam_l2
     A = G + jnp.diag(pen) + 1e-6 * jnp.eye(Pn)
     return jax.scipy.linalg.cho_solve(jax.scipy.linalg.cho_factor(A), b)
+
+
+def _solve_gram(G, b, beta0, lam_l1, lam_l2, solver: str):
+    """ONE solver-selection policy for every IRLS loop (binomial
+    _fit_beta and the multinomial per-class sweep): CD when requested,
+    ADMM when L1 is active, else the direct Cholesky solve. Host-side
+    dispatch — the solvers themselves are jitted."""
+    if solver == "COORDINATE_DESCENT":
+        return _cd_solve(G, b, beta0, lam_l1, lam_l2)
+    if lam_l1 > 0:
+        return _admm_solve(G, b, lam_l1, lam_l2)
+    return _chol_solve(G, b, lam_l2)
 
 
 @functools.partial(jax.jit, static_argnums=(5,))
@@ -471,12 +486,7 @@ class GLM:
             G, b = _gram_task(Xe, wk, z, data.w, mesh)
             G = G / n_obs
             b = b / n_obs
-            if p.solver == "COORDINATE_DESCENT":
-                beta_new = _cd_solve(G, b, beta, lam_l1, lam_l2)
-            elif lam_l1 > 0:
-                beta_new = _admm_solve(G, b, lam_l1, lam_l2)
-            else:
-                beta_new = _chol_solve(G, b, lam_l2)
+            beta_new = _solve_gram(G, b, beta, lam_l1, lam_l2, p.solver)
             dev_new, eta = _eta_dev_task(Xe, beta_new, yw, fam, mesh)
             dev = float(dev_new)
             db = float(jnp.max(jnp.abs(beta_new - beta)))
@@ -673,13 +683,9 @@ class GLM:
                     G, b = _gram_task(Xe, wk, z, data.w, mesh)
                     G = G / n_obs
                     b = b / n_obs
-                    if p.solver == "COORDINATE_DESCENT":
-                        bk = _cd_solve(G, b, B[:, k], lam_l1, lam_l2)
-                    elif lam_l1 > 0:
-                        bk = _admm_solve(G, b, lam_l1, lam_l2)
-                    else:
-                        bk = _chol_solve(G, b, lam_l2)
-                    B = B.at[:, k].set(bk)
+                    B = B.at[:, k].set(
+                        _solve_gram(G, b, B[:, k], lam_l1, lam_l2,
+                                    p.solver))
                 v = float(dev_fn(B))
                 if abs(prev - v) < p.objective_epsilon * \
                         (abs(prev) + 1e-10):

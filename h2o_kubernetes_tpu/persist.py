@@ -125,12 +125,53 @@ class _HostPickler(pickle.Pickler):
         return None
 
 
+# modules a model file may legitimately reference: this package, numpy
+# internals, and stdlib builders of plain containers. Everything else —
+# os, subprocess, builtins beyond the basics — is refused, so a
+# tampered model file cannot execute arbitrary code via a crafted
+# GLOBAL opcode (the classic pickle RCE).
+_SAFE_MODULE_PREFIXES = ("h2o_kubernetes_tpu.",)
+_SAFE_GLOBALS = {
+    ("builtins", "dict"), ("builtins", "list"), ("builtins", "tuple"),
+    ("builtins", "set"), ("builtins", "frozenset"), ("builtins", "int"),
+    ("builtins", "float"), ("builtins", "str"), ("builtins", "bytes"),
+    ("builtins", "bool"), ("builtins", "complex"), ("builtins", "slice"),
+    ("builtins", "bytearray"),
+    ("collections", "OrderedDict"), ("collections", "defaultdict"),
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("_codecs", "encode"),
+}
+
+
 class _HostUnpickler(pickle.Unpickler):
     def persistent_load(self, pid):
         tag, val = pid
         if tag == "jax_array":
             return val          # numpy; flows back to device on first use
         raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        if module.startswith(_SAFE_MODULE_PREFIXES):
+            obj = super().find_class(module, name)
+            # CLASSES defined in this package only: a bare module-prefix
+            # rule would also hand back re-exported imports (os, json)
+            # and package-level functions callable with attacker args
+            if isinstance(obj, type) and getattr(
+                    obj, "__module__", "").startswith(
+                    _SAFE_MODULE_PREFIXES):
+                return obj
+        raise pickle.UnpicklingError(
+            f"model file references {module}.{name}, which is outside "
+            "the allowed model-class set — refusing to load (possible "
+            "tampering; use MOJO artifacts for untrusted scoring)")
 
 
 def save_model(model, path: str, force: bool = True) -> str:
@@ -155,9 +196,12 @@ def load_model(path: str):
 
     Trust model: binary model files are pickle-based (like the
     reference's binary models, they are for same-owner save/restore
-    only) — loading executes code, so never load an untrusted file.
-    For artifacts that must cross a trust boundary use the MOJO path
-    (mojo.py), whose npz+JSON format is data-only.
+    only), but the loader REFUSES any class outside this package /
+    numpy container internals (`_HostUnpickler.find_class`), so a
+    tampered file cannot reach os/subprocess/arbitrary constructors.
+    Defense in depth, not a sandbox — for artifacts that must cross a
+    real trust boundary use the MOJO path (mojo.py), whose npz+JSON
+    format is data-only.
     """
     data = _read_bytes(path)
     if not data.startswith(_MAGIC):

@@ -50,6 +50,10 @@ OPNAME=e2e-op
 # sweep can race CRD establishment on a fresh apiserver — retry once
 # after waiting for the Established condition.
 if ! timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG"; then
+    # only the establishment race is retryable; if the CRD never got
+    # created, the operator itself failed — report that, not the wait
+    $KUBECTL get crd h2otpus.tpu.h2o.ai >/dev/null 2>&1 || \
+        fail "operator --once failed before creating the CRD"
     $KUBECTL wait --for condition=established --timeout=60s \
         crd/h2otpus.tpu.h2o.ai || fail "CRD never established"
     timeout 60 "$OPERATOR" --once --kubeconfig "$KUBECONFIG" || \

@@ -380,3 +380,36 @@ def test_load_model_backfills_missing_cover(tmp_path, mesh8):
                                rtol=1e-6)
     with pytest.raises(ValueError, match="per-node cover"):
         m2.predict_contributions(fr)
+
+
+def test_load_model_refuses_foreign_classes(tmp_path, mesh8):
+    """A tampered model file referencing classes outside the package
+    (the classic pickle-RCE shape) must be refused, not executed."""
+    import pickle
+
+    from h2o_kubernetes_tpu.persist import _MAGIC
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("echo pwned",))
+
+    p = tmp_path / "evil.model"
+    p.write_bytes(_MAGIC + pickle.dumps(Evil()))
+    with pytest.raises(pickle.UnpicklingError, match="outside the"):
+        h2o.load_model(str(p))
+    # bypass shape 2: reach a module RE-EXPORTED by a package module
+    # (persist.py imports os) via the package-prefix rule
+    raw = (b"\x80\x04c" + b"h2o_kubernetes_tpu.persist\nos\n" + b".")
+    p2 = tmp_path / "evil2.model"
+    p2.write_bytes(_MAGIC + raw)
+    with pytest.raises(pickle.UnpicklingError, match="outside the"):
+        h2o.load_model(str(p2))
+    # bypass shape 3: package-level FUNCTION with attacker args
+    raw3 = (b"\x80\x04c" + b"h2o_kubernetes_tpu.persist\nwrite_bytes\n"
+            + b".")
+    p3 = tmp_path / "evil3.model"
+    p3.write_bytes(_MAGIC + raw3)
+    with pytest.raises(pickle.UnpicklingError, match="outside the"):
+        h2o.load_model(str(p3))
