@@ -71,19 +71,35 @@ _jit_softmax = jax.jit(functools.partial(jax.nn.softmax, axis=1))
 _jit_exp = jax.jit(jnp.exp)
 _jit_min_pos = jax.jit(
     lambda y, w: jnp.nanmin(jnp.where(w > 0, y, jnp.inf)))
-# one dispatch + one transfer for the init-prior scalars (w·y sum and
-# w sum) — separate float() syncs each pay a full tunnel round trip
-_jit_init_sums = jax.jit(
-    lambda y, w: (jnp.sum(w), jnp.sum(y * w)))
 # max histogram work units (rows·F·nbins·2^depth summed over a chunk's
 # trees) per compiled dispatch — see the chunking comment in train()
 _DISPATCH_BUDGET = 3e12
 
-_jit_class_sums = jax.jit(
-    lambda y, w, K: jax.ops.segment_sum(
-        w, jnp.where(w > 0, jnp.nan_to_num(y), K).astype(jnp.int32),
-        num_segments=K + 1)[:K],
-    static_argnums=2)
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _init_margin(y, w, dist: str, K: int):
+    """(init score, starting margin) fully ON DEVICE — the round-2 path
+    transferred the prior sums to the host before the first boost
+    dispatch, a blocking tunnel round trip per train() that AutoML pays
+    per model. The host reads `init` back only after the boosting
+    chunks are enqueued. Pad/NA rows carry y=0, w=0 (resolve_xy)."""
+    w_sum = jnp.sum(w)
+    if dist == "bernoulli":
+        p1 = jnp.clip(jnp.sum(y * w) / w_sum, 1e-6, 1 - 1e-6)
+        init = jnp.log(p1 / (1 - p1))
+        return init, jnp.full_like(y, init)
+    if dist == "multinomial":
+        cls_w = jax.ops.segment_sum(
+            w, jnp.where(w > 0, y, K).astype(jnp.int32),
+            num_segments=K + 1)[:K]
+        init = jnp.log(jnp.clip(cls_w / w_sum, 1e-8, None)).astype(
+            jnp.float32)
+        return init, jnp.broadcast_to(init[None, :], (y.shape[0], K))
+    if dist in ("poisson", "gamma", "tweedie"):
+        init = jnp.log(jnp.clip(jnp.sum(y * w) / w_sum, 1e-8, None))
+        return init, jnp.full_like(y, init)
+    init = jnp.sum(y * w) / w_sum                      # gaussian mean
+    return init, jnp.full_like(y, init)
 
 
 def _margin_metrics(dist: str, margin, y, w, model=None) -> dict:
@@ -362,8 +378,6 @@ class GBM:
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 
-        w_sum, yw_sum = (float(v) for v in
-                         jax.device_get(_jit_init_sums(data.y, data.w)))
         if ckpt is not None:
             if ckpt.params.nbins != p.nbins or \
                     ckpt.params.max_depth != p.max_depth:
@@ -388,21 +402,6 @@ class GBM:
             init = np.zeros(K, dtype=np.float32) if K > 1 else 0.0
             margin = jnp.zeros((data.y.shape[0], K)) if K > 1 \
                 else jnp.zeros_like(data.y)
-        elif data.distribution == "bernoulli":
-            p1 = yw_sum / w_sum
-            p1 = min(max(p1, 1e-6), 1 - 1e-6)
-            init = np.log(p1 / (1 - p1))
-            margin = jnp.full_like(data.y, init)
-        elif data.distribution == "multinomial":
-            cls_w = np.asarray(_jit_class_sums(data.y, data.w, K))
-            init = np.log(np.maximum(cls_w / w_sum, 1e-8)).astype(
-                np.float32)
-            margin = jnp.broadcast_to(jnp.asarray(init)[None, :],
-                                      (data.y.shape[0], K))
-        elif data.distribution in ("poisson", "gamma", "tweedie"):
-            mu = yw_sum / w_sum
-            init = np.log(max(mu, 1e-8))
-            margin = jnp.full_like(data.y, init)
         elif data.distribution == "laplace":
             # L1 leaf steps are bounded by learn_rate, so fit in
             # median/MAD-scaled space: |y-f| is scale-equivariant and
@@ -424,8 +423,11 @@ class GBM:
                 data, y=(data.y - init) / margin_scale)
             margin = jnp.zeros_like(data.y)
         else:
-            init = yw_sum / w_sum
-            margin = jnp.full_like(data.y, init)
+            # bernoulli/multinomial/poisson/gamma/tweedie/gaussian:
+            # init + margin in one device dispatch, no host sync before
+            # the first boost chunk (init is read back at model build)
+            init, margin = _init_margin(data.y, data.w,
+                                        data.distribution, K)
 
         if ckpt is not None and data.distribution == "laplace":
             # continuation must reuse the checkpoint's robust scaling or
@@ -498,6 +500,18 @@ class GBM:
             lambda *xs: jnp.concatenate(xs), *chunks) \
             if len(chunks) > 1 else chunks[0]
 
+        if isinstance(init, jax.Array):
+            # read the device init back AFTER the boost chunks are
+            # enqueued (async dispatch: this blocks only on the tiny
+            # init computation, not on training)
+            init = jax.device_get(init)
+            init = init if init.ndim else float(init)
+            if not np.all(np.isfinite(np.atleast_1d(init))):
+                # 0/0 on device (every row weight zero / every response
+                # NA) must surface as an error, not a silently-NaN model
+                raise ValueError(
+                    "no rows with positive weight and a non-NA response "
+                    "— cannot fit a prior")
         model = self.model_cls(data, p, bin_spec, trees,
                                init_score=init, varimp=None)
         model.margin_scale = margin_scale

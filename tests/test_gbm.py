@@ -262,3 +262,13 @@ def test_gbm_laplace_zero_inflated_mad(mesh8):
         y="y", training_frame=fr)
     pred = np.asarray(m.predict_raw(fr))[:n]
     assert pred.std() > 50.0
+
+
+def test_zero_weight_frame_raises(mesh8):
+    """All-zero effective weight (every response NA) must raise, not
+    return a silently-NaN model."""
+    fr = Frame.from_arrays(
+        {"x": np.arange(64, dtype=np.float32),
+         "y": np.full(64, np.nan, dtype=np.float32)})
+    with pytest.raises(ValueError, match="positive weight"):
+        GBM(ntrees=2, max_depth=2, seed=0).train(y="y", training_frame=fr)
