@@ -28,19 +28,33 @@ NA_BIN_OFFSET = 1  # last bin is NA
 
 @dataclass
 class BinSpec:
-    """Host-side binning model: per-feature quantile edges."""
+    """Binning model: per-feature quantile edges.
+
+    `edges_dev` (the fast path, round 3) keeps the [F, B-2] edge matrix
+    ON DEVICE — `fit_bins` no longer round-trips the quantiles through
+    the host before the first training dispatch (AutoML/CV pay that
+    per fold-model). `edges` remains for models saved by older builds
+    (and pickles to host numpy either way via the persist layer)."""
 
     names: list[str]
-    edges: list[np.ndarray]          # per feature, ascending, len <= B-2
+    edges: list[np.ndarray] | None   # host per-feature edges (legacy)
     is_enum: list[bool]
     n_bins: int = 256                # total incl. NA bin
+    edges_dev: object = None         # [F, B-2] device matrix (+inf pad)
 
     @property
     def na_bin(self) -> int:
         return self.n_bins - 1
 
-    def edges_matrix(self) -> np.ndarray:
+    def edges_matrix(self):
         """[F, B-2] edge matrix padded with +inf (for device binning)."""
+        dev = getattr(self, "edges_dev", None)   # absent in old pickles
+        if dev is not None:
+            return dev
+        if self.edges is None:
+            raise ValueError(
+                "BinSpec has neither edges_dev nor host edges — exactly "
+                "one must be set (fit_bins sets edges_dev)")
         F = len(self.edges)
         width = self.n_bins - 2
         m = np.full((F, width), np.inf, dtype=np.float32)
@@ -66,11 +80,17 @@ def _device_quantiles(Xn: jax.Array, n_q: int) -> jax.Array:
 
 def fit_bins(frame, feature_names: list[str],
              n_bins: int = 256) -> BinSpec:
-    """Compute quantile edges per numeric feature (device-side)."""
+    """Compute quantile edges per numeric feature, fully device-side.
+
+    The edge matrix never visits the host: NaN quantiles (all-NA
+    columns) become +inf on device, and duplicate quantiles (heavily
+    tied columns) are kept — duplicated edges only produce empty bins,
+    which is semantically identical to the round-2 host-side
+    `np.unique` dedup (bin ids are labels; MOJO scoring uses the SAME
+    matrix, so artifacts stay consistent)."""
     if not 4 <= n_bins <= 256:
         raise ValueError(f"n_bins must be in [4, 256] (uint8 bin codes), "
                          f"got {n_bins}")
-    edges: list[np.ndarray | None] = []
     is_enum: list[bool] = []
     num_idx: list[int] = []
     num_cols = []
@@ -82,21 +102,22 @@ def fit_bins(frame, feature_names: list[str],
                 raise ValueError(
                     f"categorical '{name}' has {card} levels > {n_bins - 1}; "
                     "reduce cardinality or raise n_bins")
-            edges.append(np.arange(1, card, dtype=np.float32) - 0.5)
             is_enum.append(True)
             continue
-        num_idx.append(len(edges))
+        num_idx.append(len(is_enum))
         num_cols.append(v.as_float())
-        edges.append(None)
         is_enum.append(False)
+    F = len(feature_names)
+    # enum rows never consult edges (apply_bins clips the code), so the
+    # whole base can stay at the +inf padding
+    M = jnp.full((F, n_bins - 2), jnp.inf, dtype=jnp.float32)
     if num_cols:
-        Q = np.asarray(_device_quantiles(jnp.stack(num_cols, axis=1),
-                                         n_bins - 3))
-        for j, i in enumerate(num_idx):
-            q = Q[j][~np.isnan(Q[j])]
-            edges[i] = np.unique(q.astype(np.float32))
-    return BinSpec(names=list(feature_names), edges=edges, is_enum=is_enum,
-                   n_bins=n_bins)
+        Q = _device_quantiles(jnp.stack(num_cols, axis=1), n_bins - 3)
+        Q = jnp.where(jnp.isnan(Q), jnp.inf, Q.astype(jnp.float32))
+        M = M.at[jnp.asarray(num_idx, dtype=jnp.int32),
+                 : n_bins - 3].set(Q)
+    return BinSpec(names=list(feature_names), edges=None,
+                   is_enum=is_enum, n_bins=n_bins, edges_dev=M)
 
 
 def apply_bins(X: jax.Array, edges_matrix: jax.Array, enum_mask: jax.Array,
