@@ -286,6 +286,32 @@ class BoostParams(NamedTuple):
     sample_rate: float = 1.0
     col_sample_rate_per_tree: float = 1.0
     drf_mode: bool = False
+    quantile_alpha: float = 0.5     # quantile distribution's τ
+    huber_alpha: float = 0.9        # huber δ = this quantile of |resid|
+
+
+def _boost_grad_hess(bp: BoostParams, margin, y, w):
+    """Per-round (g, h) including the distributions whose gradients
+    need BoostParams state (quantile's τ, huber's per-round δ); plain
+    families delegate to _grad_hess.
+
+    huber re-derives δ every round as the huber_alpha quantile of the
+    CURRENT absolute residuals (hex/tree/gbm GBM.java recomputes δ per
+    scoring pass [U3]); under shard_map the quantile is computed per
+    shard and pmean'd over ROWS — a distributed approximation of the
+    global order statistic (exact would need an all-gather sort).
+    """
+    if bp.distribution == "quantile":
+        a = bp.quantile_alpha
+        g = jnp.where(margin < y, -a, 1.0 - a)
+        return g, jnp.ones_like(y)
+    if bp.distribution == "huber":
+        r = y - margin
+        absr = jnp.where(w > 0, jnp.abs(r), jnp.nan)
+        delta = lax.pmean(jnp.nanquantile(absr, bp.huber_alpha), ROWS)
+        g = jnp.where(jnp.abs(r) <= delta, -r, -delta * jnp.sign(r))
+        return g, jnp.ones_like(y)
+    return _grad_hess(bp.distribution, margin, y)
 
 
 def _round_sampling(bp: BoostParams, w, F: int, k_row, k_col):
@@ -328,7 +354,7 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
         if bp.drf_mode:
             g, h = -y, jnp.ones_like(y)
         else:
-            g, h = _grad_hess(bp.distribution, margin, y)
+            g, h = _boost_grad_hess(bp, margin, y, w)
         tree, leaf = _grow_tree_shard(binned, g, h, w_t, col_mask,
                                       k_tree, p)
         tree = tree._replace(value=bp.learn_rate * tree.value)
