@@ -4,8 +4,13 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 The reference publishes no numbers (BASELINE.json "published": {}), so
 vs_baseline is the ratio against the first number this harness ever
-recorded on this hardware (BENCH_BASELINE.json, written on first run) —
-i.e. round-over-round speedup; 1.0 on the first run.
+recorded on the SAME platform at the SAME shape (BENCH_BASELINE.json
+keys entries by "<platform>:<rows>x<trees>").  A run with no matching
+baseline emits ``vs_baseline: null`` — a CPU fallback round can never
+again report a >1 ratio against an on-chip baseline (the round-3
+scoreboard defect).  Every run also emits ``last_tpu_value``: the most
+recent on-chip measurement on record, so the scoreboard always carries
+the real number even when the chip is down.
 
 North-star metric (BASELINE.json:2): GBM rows/sec/chip. We measure
 steady-state boosting throughput (binning + per-tree grow + margin
@@ -71,24 +76,42 @@ def main() -> None:
     dt = time.perf_counter() - t0
     rows_per_sec_per_chip = rows * ntrees / dt / n_chips
 
+    platform = jax.default_backend()
+    shape_key = f"{platform}:{rows}x{ntrees}"
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_BASELINE.json")
+    store = {"metric": METRIC, "baselines": {}, "last_tpu": None}
     if os.path.exists(base_path):
         with open(base_path) as f:
-            base = json.load(f)["value"]
-    elif on_tpu:
-        base = rows_per_sec_per_chip
-        with open(base_path, "w") as f:
-            json.dump({"metric": METRIC, "value": base}, f)
+            raw = json.load(f)
+        if "baselines" in raw:
+            store = raw
+        else:
+            # legacy single-value file: that number was the round-1
+            # on-chip capture at the TPU default shape (1M rows x 10)
+            store["baselines"] = {"tpu:1000000x10": {"value": raw["value"]}}
+    entry = store["baselines"].get(shape_key)
+    if entry is None:
+        store["baselines"][shape_key] = {"value": rows_per_sec_per_chip}
+        base = None  # first run at this platform+shape: no ratio yet
     else:
-        base = rows_per_sec_per_chip
+        base = entry["value"]
+    if on_tpu:
+        store["last_tpu"] = {"value": rows_per_sec_per_chip,
+                             "rows": rows, "trees": ntrees}
+    with open(base_path, "w") as f:
+        json.dump(store, f, indent=1)
 
     print(json.dumps({
         "metric": METRIC,
         "value": round(rows_per_sec_per_chip, 1),
         "unit": UNIT,
-        "vs_baseline": round(rows_per_sec_per_chip / base, 3),
-        "platform": jax.default_backend(),
+        "vs_baseline": (round(rows_per_sec_per_chip / base, 3)
+                        if base else None),
+        "baseline_key": shape_key if base else None,
+        "last_tpu_value": (round(store["last_tpu"]["value"], 1)
+                           if store["last_tpu"] else None),
+        "platform": platform,
         "rows": rows,
         "trees": ntrees,
         "seconds": round(dt, 3),

@@ -456,9 +456,8 @@ class Frame:
         if not self._vecs:
             raise ValueError("valid_mask() on an empty Frame")
         v = next(iter(self._vecs.values()))
-        idx = jnp.arange(v.padded_len)
-        mask = (idx < v.nrows).astype(jnp.float32)
-        return jax.device_put(mask, meshlib.row_sharding())
+        mask = (np.arange(v.padded_len) < v.nrows).astype(np.float32)
+        return shard_rows(mask)   # multi-host-safe placement
 
     def to_pandas(self):
         import pandas as pd

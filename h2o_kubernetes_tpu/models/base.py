@@ -40,6 +40,7 @@ class TrainData:
     response_domain: list[str] | None
     distribution: str            # gaussian | bernoulli | multinomial | ...
     feature_domains: dict[str, list[str]] = field(default_factory=dict)
+    offset: jax.Array | None = None   # [padded] float32, 0 on padding/NA
 
 
 def _feature_names(frame: Frame, x: Sequence[str] | None,
@@ -58,7 +59,8 @@ def _feature_names(frame: Frame, x: Sequence[str] | None,
 def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
                ignored: Sequence[str] | None = None,
                weights_column: str | None = None,
-               distribution: str = "auto") -> TrainData:
+               distribution: str = "auto",
+               offset_column: str | None = None) -> TrainData:
     from ..runtime.health import require_healthy
 
     require_healthy()   # fail fast before training on a broken cloud
@@ -68,6 +70,16 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
     ignored.add(y)
     if weights_column:
         ignored.add(weights_column)
+    if offset_column:
+        # offset is a fixed per-row margin term, never a feature
+        # (hex/ModelBuilder offset_column handling [U3])
+        if offset_column not in frame:
+            raise ValueError(
+                f"offset column '{offset_column}' not in frame")
+        if frame.vec(offset_column).is_enum():
+            raise ValueError(
+                f"offset column '{offset_column}' must be numeric")
+        ignored.add(offset_column)
     names = _feature_names(frame, x, ignored)
     yv = frame.vec(y)
     nclasses, domain = 1, None
@@ -96,10 +108,17 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
     # such rows during ModelBuilder init)
     w = jnp.where(jnp.isnan(y_arr), 0.0, w)
     y_arr = jnp.nan_to_num(y_arr)
+    off = None
+    if offset_column:
+        off = frame.vec(offset_column).as_float()
+        # NA offset rows cannot contribute a defined margin — dropped
+        # like NA responses
+        w = jnp.where(jnp.isnan(off), 0.0, w)
+        off = jnp.nan_to_num(off)
     fdoms = {n: list(frame.vec(n).domain) for n in names
              if frame.vec(n).is_enum()}
     return TrainData(names, X, y_arr, w, frame.nrows, nclasses, domain,
-                     distribution, fdoms)
+                     distribution, fdoms, off)
 
 
 def resolve_x(frame: Frame, x: Sequence[str] | None = None,
@@ -137,6 +156,7 @@ class Model:
         self.scoring_history: list[dict[str, Any]] = []
         self.cv = None                    # CVResult when trained with nfolds
         self.validation_metrics: dict[str, float] | None = None
+        self.offset_column: str | None = None   # set by offset-aware trains
 
     # -- h2o-py-style CV accessors (H2OEstimator.cross_validation_*) -------
 
@@ -194,6 +214,21 @@ class Model:
     def predict_raw(self, frame: Frame) -> np.ndarray:
         """[n, K] class probabilities, or [n] regression predictions."""
         X = self._design_matrix(frame)
+        if getattr(self, "offset_column", None):
+            # a model trained with an offset needs it at scoring time
+            # too (hex/Model.adaptTestForTrain errors likewise [U3])
+            if self.offset_column not in frame:
+                raise ValueError(
+                    f"this model was trained with offset_column="
+                    f"'{self.offset_column}' which is missing from the "
+                    "scoring frame")
+            # NA offsets propagate: a row with no defined base margin
+            # has no defined prediction (training likewise drops such
+            # rows via w=0) — coercing to 0 would return a confident
+            # number for a row the model cannot score
+            off = frame.vec(self.offset_column).as_float()
+            out = np.asarray(self._score_matrix(X, offset=off))
+            return out[: frame.nrows]
         out = np.asarray(self._score_matrix(X))[: frame.nrows]
         return out
 
@@ -229,6 +264,17 @@ class Model:
         # one design-matrix build; each grid step overwrites a single
         # column on device instead of re-sharding the whole frame
         X = self._design_matrix(frame)
+        off = None
+        if getattr(self, "offset_column", None):
+            # PD means must average the model as it actually predicts —
+            # scoring at offset 0 would disagree with predict() on the
+            # same frame
+            if self.offset_column not in frame:
+                raise ValueError(
+                    f"this model was trained with offset_column="
+                    f"'{self.offset_column}' which is missing from the "
+                    "frame")
+            off = frame.vec(self.offset_column).as_float()
         for col in cols:
             if col not in self.feature_names:
                 raise ValueError(
@@ -254,8 +300,10 @@ class Model:
                 labels = None
             means, sds, sems = [], [], []
             for gv in grid:
-                pred = np.asarray(self._score_matrix(
-                    _set_col_jit(X, j, float(gv))))[:n]
+                Xg = _set_col_jit(X, j, float(gv))
+                pred = np.asarray(
+                    self._score_matrix(Xg, offset=off)
+                    if off is not None else self._score_matrix(Xg))[:n]
                 resp = pred[:, 1] if self.nclasses == 2 else pred
                 means.append(float(np.mean(resp)))
                 sds.append(float(np.std(resp, ddof=1))

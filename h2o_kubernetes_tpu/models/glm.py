@@ -243,14 +243,17 @@ def _softmax_irls_task(Xe, B, yw, k, mesh):
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
 def _eta_dev_task(Xe, beta, yw, fam, mesh):
-    """Per-shard eta + deviance psum → (dev, eta). yw: [R,2] (y, w).
+    """Per-shard eta + deviance psum → (dev, eta).
 
-    Returning eta (row-sharded) lets the IRLS loop reuse this matmul for
-    the next iteration's working weights instead of recomputing Xe@beta.
+    yw: [R,3] (y, w, offset). The returned eta is the TOTAL linear
+    predictor Xe@beta + offset (row-sharded), which the IRLS loop
+    reuses for the next iteration's working weights instead of
+    recomputing the matmul; the fixed offset term rides along
+    (hex/glm GLMTask applies the row offset to eta identically [U3]).
     """
 
     def body(xs, yws, b):
-        eta = xs @ b
+        eta = xs @ b + yws[:, 2]
         mu = _linkinv(fam, eta)
         dev = _family_deviance(fam, yws[:, 0], mu, yws[:, 1])
         return lax.psum(dev, ROWS), eta
@@ -258,6 +261,13 @@ def _eta_dev_task(Xe, beta, yw, fam, mesh):
     return jax.shard_map(body, mesh=mesh,
                          in_specs=(P(ROWS), P(ROWS), P()),
                          out_specs=(P(), P(ROWS)))(Xe, yw, beta)
+
+
+def _ywo(data: TrainData) -> jax.Array:
+    """[R,3] (y, w, offset) stack shared by every GLM task."""
+    off = data.offset if data.offset is not None \
+        else jnp.zeros_like(data.y)
+    return jnp.stack([data.y, data.w, off], axis=1)
 
 
 def _soft(x, k):
@@ -388,9 +398,12 @@ class GLMModel(Model):
         return dict(zip(self.dinfo.coef_names,
                         np.asarray(self.beta, dtype=np.float64)))
 
-    def _score_matrix(self, X: jax.Array) -> jax.Array:
+    def _score_matrix(self, X: jax.Array,
+                      offset: jax.Array | None = None) -> jax.Array:
         Xe = self.dinfo.expand(X)
         eta = Xe @ self.beta
+        if offset is not None:
+            eta = eta + offset
         if self.params.family == "multinomial":
             return jax.nn.softmax(eta, axis=1)
         mu = _linkinv(_famspec(self.params), eta)
@@ -405,6 +418,8 @@ class GLMModel(Model):
         XᵀWX⁻¹·φ at the fitted β (hex/glm computePValues [U3]),
         de-standardized through the same affine map as coef()."""
         eta = Xe @ self.beta
+        if data.offset is not None:
+            eta = eta + data.offset
         mu = _linkinv(fam, eta)
         wk, _ = _irls_weights(fam, eta, mu, data.y)
         G, _ = _gram_task(Xe, wk, jnp.zeros_like(eta), data.w, mesh)
@@ -475,7 +490,7 @@ class GLM:
         lam_l2 = lam * (1 - p.alpha)
         n_obs = float(jnp.sum(data.w))
         beta = beta0
-        yw = jnp.stack([data.y, data.w], axis=1)
+        yw = _ywo(data)
         dev0, eta = _eta_dev_task(Xe, beta, yw, fam, mesh)
         dev_prev = float(dev0)
         it = 0
@@ -483,6 +498,11 @@ class GLM:
             require_healthy()   # fail fast on a dead mesh (§5.3)
             mu = _linkinv(fam, eta)            # eta reused from last solve
             wk, z = _irls_weights(fam, eta, mu, data.y)
+            # eta (and hence z) carries the fixed offset; the Gram
+            # solves for the LINEAR part only, so the working response
+            # is z - offset (the reference subtracts the offset from z
+            # in GLMIterationTask the same way)
+            z = z - yw[:, 2]
             G, b = _gram_task(Xe, wk, z, data.w, mesh)
             G = G / n_obs
             b = b / n_obs
@@ -505,8 +525,15 @@ class GLM:
               x: Sequence[str] | None = None,
               ignored_columns: Sequence[str] | None = None,
               weights_column: str | None = None,
-              validation_frame: Frame | None = None) -> GLMModel:
+              validation_frame: Frame | None = None,
+              offset_column: str | None = None) -> GLMModel:
         p = self.params
+        if offset_column and p.family == "multinomial":
+            # a shared per-row offset added to every class eta is
+            # softmax-invariant — accepting it would silently train an
+            # identical model
+            raise ValueError(
+                "offset_column is not supported for multinomial")
         if self.cv_args.fold_column:
             ignored_columns = list(ignored_columns or []) + \
                 [self.cv_args.fold_column]
@@ -541,7 +568,7 @@ class GLM:
                     "tweedie": "gaussian", "negativebinomial": "poisson",
                     }.get(p.family, p.family)
         data = resolve_xy(training_frame, y, x, ignored_columns,
-                          weights_column, fam_dist)
+                          weights_column, fam_dist, offset_column)
         if p.family == "binomial" and data.nclasses != 2:
             raise ValueError("binomial family needs a 2-class response")
         if p.family == "multinomial" and data.nclasses < 2:
@@ -570,7 +597,7 @@ class GLM:
             return self._train_multinomial(
                 y, training_frame, x, ignored_columns, weights_column,
                 validation_frame, data, dinfo, Xe, mesh)
-        yw = jnp.stack([data.y, data.w], axis=1)
+        yw = _ywo(data)
 
         # null deviance (intercept-only model: intercept = link(ȳ))
         ybar = float(jnp.sum(data.y * data.w)) / n_obs
@@ -579,13 +606,26 @@ class GLM:
         elif fam.link in ("log", "inverse"):
             ybar = max(ybar, 1e-10)
         b0 = float(_linkfun(fam, jnp.float32(ybar)))
+        if data.offset is not None:
+            # with an offset link(ȳ) is no longer the intercept MLE —
+            # fit the intercept-only model through the same IRLS
+            # machinery on a ones design (cheap: Gram is 1x1).
+            # shard_rows, not jnp.ones: the design must be placed like
+            # Xe or the shard_map can't shard it on a multi-host mesh
+            from ..runtime.mrtask import shard_rows
+
+            ones = shard_rows(np.ones((Xe.shape[0], 1), np.float32),
+                              mesh=mesh)
+            b_null, _, _ = self._fit_beta(
+                ones, data, dinfo, 0.0, jnp.asarray([b0]), mesh)
+            b0 = float(b_null[0])
         beta_null = jnp.zeros(Pn).at[Pn - 1].set(b0)
         null_dev = float(_eta_dev_task(Xe, beta_null, yw, fam,
                                          mesh)[0])
 
         if p.lambda_search:
             # λ_max: smallest λ zeroing all coefs (from null-model gradient)
-            eta0 = Xe @ beta_null
+            eta0 = Xe @ beta_null + yw[:, 2]
             mu0 = _linkinv(fam, eta0)
             grad = np.asarray(jnp.abs(
                 Xe.T @ ((mu0 - data.y) * data.w))) / n_obs
@@ -612,6 +652,7 @@ class GLM:
 
         model = GLMModel(data, p, dinfo, beta, lam_used, null_dev, dev,
                          iters)
+        model.offset_column = offset_column
         if p.compute_p_values:
             model._fit_inference(Xe, data, fam, mesh)
         from .cv import finalize_train
@@ -619,7 +660,8 @@ class GLM:
         return finalize_train(
             self, model, y, training_frame,
             {"x": x, "ignored_columns": ignored_columns,
-             "weights_column": weights_column},
+             "weights_column": weights_column,
+             "offset_column": offset_column},
             validation_frame)
 
     def _train_multinomial(self, y, training_frame, x, ignored_columns,
@@ -735,11 +777,11 @@ class GLM:
         lam_l1 = lam * p.alpha
         Pn = dinfo.n_expanded
         pen_mask = jnp.ones(Pn).at[Pn - 1].set(0.0)
-        yw = jnp.stack([data.y, data.w], axis=1)
+        yw = _ywo(data)
 
         def obj(beta):
             def body(xs, yws, b):
-                eta = xs @ b
+                eta = xs @ b + yws[:, 2]
                 mu = _linkinv(fam, eta)
                 return lax.psum(
                     _family_deviance(fam, yws[:, 0], mu, yws[:, 1]),

@@ -46,6 +46,7 @@ class TreeParams(NamedTuple):
     mtries: int = -1                # per-node feature subsampling (DRF); -1=all
     min_child_weight: float = 0.0   # min hessian mass per child (XGBoost)
     hist_impl: str = "auto"         # auto | segment | pallas (ops/histogram)
+    unit_hess: bool = False         # h ≡ 1 loss: 2-channel histograms
 
 
 class Tree(NamedTuple):
@@ -79,6 +80,7 @@ def _gain_term(G, H, p: TreeParams):
 # histogram accumulation lives in ops/histogram.py (segment_sum on CPU,
 # the Pallas one-hot-matmul kernel on TPU)
 from ...ops.histogram import build_histogram as _build_histogram_op
+from ...ops.histogram import expand_unit_hess as _expand_unit_hess
 from ...ops.histogram import resolve_impl as _resolve_impl
 
 
@@ -172,8 +174,12 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             zero_bin = jnp.zeros((binned.shape[0], 1),
                                  dtype=binned.dtype)
             tot = _build_histogram_op(zero_bin, rel, g, h, w, n_nodes,
-                                      1, impl=p.hist_impl)
-            tot = lax.psum(tot, ROWS)[:, 0, 0, :]       # [n_nodes, 3]
+                                      1, impl=p.hist_impl,
+                                      unit_hess=p.unit_hess)
+            tot = lax.psum(tot, ROWS)                   # 2- or 3-channel
+            if p.unit_hess:
+                tot = _expand_unit_hess(tot)
+            tot = tot[:, 0, 0, :]                       # [n_nodes, 3]
             idx = off + jnp.arange(n_nodes)
             value = value.at[idx].set(
                 _leaf_value(tot[:, 0], tot[:, 1], p))
@@ -181,8 +187,11 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             break
         if d == 0:
             hist = _build_histogram_op(binned, rel, g, h, w, 1,
-                                       p.n_bins, impl=p.hist_impl)
+                                       p.n_bins, impl=p.hist_impl,
+                                       unit_hess=p.unit_hess)
             hist = lax.psum(hist, ROWS)                 # MRTask reduce
+            if p.unit_hess:
+                hist = _expand_unit_hess(hist)
         else:
             # sibling subtraction (the XGBoost/LightGBM trick): histogram
             # only LEFT children, derive right = parent - left. Halves
@@ -194,8 +203,11 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             left_rel = jnp.where((rel >= 0) & (rel % 2 == 0), rel // 2, -1)
             hist_l = _build_histogram_op(binned, left_rel, g, h, w,
                                          n_nodes // 2, p.n_bins,
-                                         impl=p.hist_impl)
+                                         impl=p.hist_impl,
+                                         unit_hess=p.unit_hess)
             hist_l = lax.psum(hist_l, ROWS)
+            if p.unit_hess:
+                hist_l = _expand_unit_hess(hist_l)
             parent = jnp.where(can_prev[:, None, None, None], hist_prev,
                                0.0)
             hist_l = jnp.where(can_prev[:, None, None, None], hist_l, 0.0)

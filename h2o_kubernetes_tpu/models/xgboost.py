@@ -55,10 +55,11 @@ class XGBoostModel(GBMModel):
     algo = "xgboost"
     _group_column: str | None = None
 
-    def _score_matrix(self, X: jax.Array) -> jax.Array:
+    def _score_matrix(self, X: jax.Array,
+                      offset: jax.Array | None = None) -> jax.Array:
         if self.distribution.startswith("rank:"):
-            return self._margins(X)          # raw ranking scores
-        return super()._score_matrix(X)
+            return self._margins(X, offset)  # raw ranking scores
+        return super()._score_matrix(X, offset)
 
     def model_performance(self, frame: Frame, y: str,
                           group_column: str | None = None,
@@ -278,8 +279,14 @@ class XGBoost(GBM):
     def _train_rank(self, y: str, frame: Frame, x, group_column: str,
                     ignored_columns: Sequence[str] | None = None,
                     weights_column: str | None = None,
-                    validation_frame: Frame | None = None) -> XGBoostModel:
+                    validation_frame: Frame | None = None,
+                    offset_column: str | None = None) -> XGBoostModel:
         p = self.params
+        if offset_column:
+            # a base margin is meaningful for pointwise objectives only;
+            # LambdaMART gradients come from pairwise score differences
+            raise ValueError(
+                "offset_column is not supported for rank:* objectives")
         ignored = list(ignored_columns or []) + [group_column]
         data = resolve_xy(frame, y, x, ignored, weights_column,
                           distribution="gaussian")

@@ -59,6 +59,9 @@ def export_mojo(model, path) -> str:
         "nclasses": getattr(model, "nclasses", 1),
         "response_domain": getattr(model, "response_domain", None),
         "distribution": getattr(model, "distribution", None),
+        # offset-trained models need the per-row offset at scoring time
+        # too — omitting it would silently shift every MOJO prediction
+        "offset_column": getattr(model, "offset_column", None),
     }
     arrays: dict[str, np.ndarray] = {}
     if algo in ("gbm", "drf", "xgboost"):
@@ -267,12 +270,13 @@ class MojoModel:
             raise ValueError(
                 "targetencoder artifacts score via transform(), not "
                 "predict()")
+        off = self._offset(data)
         X = self._matrix(data) if not isinstance(data, np.ndarray) \
             else data.astype(np.float32)
         if self.algo in ("gbm", "drf", "xgboost"):
-            return self._predict_trees(X)
+            return self._predict_trees(X, off)
         if self.algo == "glm":
-            return self._predict_glm(X)
+            return self._predict_glm(X, off)
         if self.algo == "kmeans":
             return self._predict_kmeans(X)
         if self.algo == "deeplearning":
@@ -288,6 +292,28 @@ class MojoModel:
         if self.algo == "glrm":
             return self._solve_u_glrm(X)
         raise ValueError(self.algo)
+
+    def _offset(self, data) -> np.ndarray | None:
+        """Per-row offset for offset-trained artifacts (same contract
+        as the in-process Model.predict_raw: the column must be
+        supplied at scoring time; NA offsets propagate as NaN)."""
+        oc = self.meta.get("offset_column")
+        if not oc:
+            return None
+        if isinstance(data, np.ndarray):
+            raise ValueError(
+                f"this artifact was trained with offset_column='{oc}'; "
+                "score with a dict/Frame including that column, not a "
+                "bare matrix")
+        if hasattr(data, "vec") and hasattr(data, "names"):
+            if oc not in data.names:
+                raise ValueError(f"offset column '{oc}' missing from "
+                                 "the scoring frame")
+            return data.vec(oc).to_numpy().astype(np.float64)
+        if oc not in data:
+            raise ValueError(f"offset column '{oc}' missing from the "
+                             "scoring data")
+        return np.asarray(data[oc], dtype=np.float64)
 
     def _predict_se(self, data):
         """Run every base MOJO, assemble the level-one columns exactly
@@ -490,7 +516,7 @@ class MojoModel:
             out[:, f] = b
         return out
 
-    def _predict_trees(self, X):
+    def _predict_trees(self, X, off=None):
         m = self.meta
         binned = self._bin(X)
         sf = self.arrays["tree_split_feat"]      # [T, N]
@@ -521,6 +547,8 @@ class MojoModel:
         if m["drf_mode"]:
             totals = totals / (T // K)
         probsum = totals + init[None, :]
+        if off is not None:
+            probsum = probsum + off[:, None]
         d = m["distribution"]
         if d == "bernoulli":
             mgn = probsum[:, 0]
@@ -537,12 +565,16 @@ class MojoModel:
             return np.exp(probsum[:, 0])
         scale = m.get("margin_scale", 1.0)
         if scale != 1.0:
+            # laplace robust scaling never combines with an offset
+            # (GBM.train rejects it), so off is None here
             return init[0] + scale * totals[:, 0]
         return probsum[:, 0]
 
-    def _predict_glm(self, X):
+    def _predict_glm(self, X, off=None):
         Xe = self._expand(X)
         eta = Xe @ self.arrays["beta"]
+        if off is not None:
+            eta = eta + off
         fam = self.meta["family"]
         if fam == "multinomial":
             z = np.exp(eta - eta.max(axis=1, keepdims=True))

@@ -31,26 +31,26 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-# HIGHEST: the MXU's default bf16 multiply loses ~0.4% on the gradient
-# sums; the 3-pass f32 emulation keeps parity with the segment path and
-# is FREE here — the [3, T] LHS fills 3/128 of the systolic array, so
-# the kernel is bound by array occupancy, not by pass count (measured
-# 125ms either way on v5e for the 1M-row level-5 build).
-_PREC = jax.lax.Precision.HIGHEST
+# Precision: a plain bf16 multiply loses ~0.4% on the gradient sums, so
+# both kernels reproduce f32 products with THREE explicit bf16 mantissa
+# terms of the values against the exactly-representable 0/1 one-hot —
+# the same arithmetic HIGHEST would emulate, minus the wasted passes on
+# the one-hot operand (it is already bf16-exact).
 ROW_TILE = 1024  # 1-D s32 operands carry XLA layout T(1024): the row
 #                  block must match it or Mosaic rejects the layouts
 
 
 def _hist_segment(binned, rel, vals, n_nodes: int, n_bins: int):
-    """[r,F] bins + [r] rel + [r,3] vals -> [n_nodes, F, B, 3]."""
+    """[r,F] bins + [r] rel + [r,C] vals -> [n_nodes, F, B, C]."""
     live = rel >= 0
     seg_node = jnp.where(live, rel, n_nodes)
+    C = vals.shape[1]
 
     def per_feature(bins_f):
         seg = seg_node * n_bins + bins_f.astype(jnp.int32)
         out = jax.ops.segment_sum(
             vals, seg, num_segments=(n_nodes + 1) * n_bins)
-        return out[: n_nodes * n_bins].reshape(n_nodes, n_bins, 3)
+        return out[: n_nodes * n_bins].reshape(n_nodes, n_bins, C)
 
     return jax.vmap(per_feature, in_axes=1, out_axes=1)(binned)
 
@@ -65,7 +65,7 @@ def _bin_block(n_nodes: int, n_bins: int) -> int:
 
 
 def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
-                      n_hi):
+                      n_hi, n_ch):
     """Factorized one-hot histogram matmul (the fast path).
 
     seg = rel·B + bin is split as seg = hi·128 + lo.  The LHS packs the
@@ -93,24 +93,32 @@ def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
     # hi one-hot, transposed: [n_hi, T].  Dead rows (rel=-1) have hi < 0
     # and match no slot; their vals are zeroed upstream anyway.
     iota_hi = lax.broadcasted_iota(jnp.int32, (n_hi, T), 0)
-    oh_hi = (iota_hi == hi[None, :]).astype(jnp.float32)
-    vals_t = vals_ref[:].T                           # [3, T]
-    A = jnp.concatenate([oh_hi * vals_t[c][None, :] for c in range(3)],
-                        axis=0)                      # [3*n_hi, T]
+    oh_hi = (iota_hi == hi[None, :]).astype(jnp.bfloat16)
+    vals_t = vals_ref[:].T                           # [n_ch, T]
     iota_lo = lax.broadcasted_iota(jnp.int32, (T, 128), 1)
     B = (iota_lo == lo[:, None]).astype(jnp.bfloat16)
 
-    a1 = A.astype(jnp.bfloat16)
-    r1 = A - a1.astype(jnp.float32)
-    a2 = r1.astype(jnp.bfloat16)
-    a3 = (r1 - a2.astype(jnp.float32)).astype(jnp.bfloat16)
+    # f32-precision via 3 bf16 mantissa terms, split on the TINY
+    # [n_ch, T] values and masked by the 0/1 one-hot IN bf16 —
+    # bit-identical to splitting the big masked A (0/1 masking commutes
+    # with rounding) but skips materializing a [n_ch*n_hi, T] f32 A
+    # plus two subtract passes over it: the A-build drops from ~6
+    # f32-width VPU passes to 3 bf16-width multiplies (round-4
+    # VPU-bound remainder attack, PROFILE.md "what's next").
+    v1 = vals_t.astype(jnp.bfloat16)
+    r1 = vals_t - v1.astype(jnp.float32)
+    v2 = r1.astype(jnp.bfloat16)
+    v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
     dn = (((1,), (0,)), ((), ()))
 
-    def dg(a):
+    def dg(vk):                                      # [n_ch,T] bf16 term
+        a = jnp.concatenate(
+            [oh_hi * vk[c][None, :] for c in range(n_ch)],
+            axis=0)                                  # [n_ch*n_hi, T]
         return lax.dot_general(a, B, dimension_numbers=dn,
                                preferred_element_type=jnp.float32)
 
-    out_ref[0] += dg(a1) + dg(a2) + dg(a3)           # [3*n_hi, 128]
+    out_ref[0] += dg(v1) + dg(v2) + dg(v3)           # [n_ch*n_hi, 128]
 
 
 # VMEM cap for the factorized kernel's working set: A f32 [3*n_hi, T]
@@ -123,6 +131,7 @@ _FACT_MAX_NHI = 256
 
 def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int):
     r, F = binned.shape
+    C = vals.shape[1]
     nB = n_nodes * n_bins
     n_hi = -(-nB // 128)                             # ceil
     pad = (-r) % ROW_TILE
@@ -138,22 +147,23 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int):
     grid = (F, rblocks)
     vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
     out = pl.pallas_call(
-        functools.partial(_hist_fact_kernel, n_bins=n_bins, n_hi=n_hi),
-        out_shape=jax.ShapeDtypeStruct((F, 3 * n_hi, 128), jnp.float32,
+        functools.partial(_hist_fact_kernel, n_bins=n_bins, n_hi=n_hi,
+                          n_ch=C),
+        out_shape=jax.ShapeDtypeStruct((F, C * n_hi, 128), jnp.float32,
                                        vma=vma),
         grid=grid,
         in_specs=[
             pl.BlockSpec((ROW_TILE,),
                          lambda f, rt, rb=rblocks: (f * rb + rt,)),
             pl.BlockSpec((ROW_TILE,), lambda f, rt: (rt,)),
-            pl.BlockSpec((ROW_TILE, 3), lambda f, rt: (rt, 0)),
+            pl.BlockSpec((ROW_TILE, C), lambda f, rt: (rt, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 3 * n_hi, 128), lambda f, rt: (f, 0, 0)),
+        out_specs=pl.BlockSpec((1, C * n_hi, 128), lambda f, rt: (f, 0, 0)),
         interpret=jax.default_backend() != "tpu",
     )(binned_flat, rel32, vals)
-    # [F, 3*n_hi, 128] -> [F, 3, n_hi*128] -> [n, F, B, 3]
-    out = out.reshape(F, 3, n_hi * 128)[:, :, :nB]
-    return out.reshape(F, 3, n_nodes, n_bins).transpose(2, 0, 3, 1)
+    # [F, C*n_hi, 128] -> [F, C, n_hi*128] -> [n, F, B, C]
+    out = out.reshape(F, C, n_hi * 128)[:, :, :nB]
+    return out.reshape(F, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
 
 
 def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
@@ -172,17 +182,28 @@ def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
     # dead rows (rel=-1) give seg in [-n_bins, -1], which can never equal
     # a non-negative iota slot — no explicit liveness mask needed (a bool
     # [:, None] broadcast is also unsupported by Mosaic for non-32-bit)
-    onehot = (seg[:, None] - base) == iota
+    onehot = ((seg[:, None] - base) == iota).astype(jnp.bfloat16)
     vals_t = vals_ref[:].T                           # [3, T]
-    out_ref[0] += lax.dot_general(
-        vals_t, onehot.astype(jnp.float32),
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        precision=_PREC,
-        preferred_element_type=jnp.float32)          # [3, NBT] on the MXU
+    # same f32-precision recipe as the factorized kernel: the one-hot
+    # RHS is 0/1 (bf16-exact) and the [3, T] values split into three
+    # bf16 mantissa terms — 3 explicit bf16 passes replace the implicit
+    # ~6-pass f32 HIGHEST emulation on BOTH operands
+    v1 = vals_t.astype(jnp.bfloat16)
+    r1 = vals_t - v1.astype(jnp.float32)
+    v2 = r1.astype(jnp.bfloat16)
+    v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
+    dn = (((1,), (0,)), ((), ()))
+
+    def dg(vk):
+        return lax.dot_general(vk, onehot, dimension_numbers=dn,
+                               preferred_element_type=jnp.float32)
+
+    out_ref[0] += dg(v1) + dg(v2) + dg(v3)           # [C, NBT] on the MXU
 
 
 def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
     r, F = binned.shape
+    C = vals.shape[1]
     nB = n_nodes * n_bins
     if -(-nB // 128) <= _FACT_MAX_NHI:
         return _hist_pallas_fact(binned, rel, vals, n_nodes, n_bins)
@@ -211,19 +232,19 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
     vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
     out = pl.pallas_call(
         functools.partial(_hist_kernel, n_bins=n_bins, nbt=nbt),
-        out_shape=jax.ShapeDtypeStruct((F, 3, nB), jnp.float32, vma=vma),
+        out_shape=jax.ShapeDtypeStruct((F, C, nB), jnp.float32, vma=vma),
         grid=grid,
         in_specs=[
             pl.BlockSpec((ROW_TILE,),
                          lambda f, nb, rt, rb=rblocks: (f * rb + rt,)),
             pl.BlockSpec((ROW_TILE,), lambda f, nb, rt: (rt,)),
-            pl.BlockSpec((ROW_TILE, 3), lambda f, nb, rt: (rt, 0)),
+            pl.BlockSpec((ROW_TILE, C), lambda f, nb, rt: (rt, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 3, nbt), lambda f, nb, rt: (f, 0, nb)),
+        out_specs=pl.BlockSpec((1, C, nbt), lambda f, nb, rt: (f, 0, nb)),
         interpret=jax.default_backend() != "tpu",
     )(binned_flat, rel32, vals)
-    # [F, 3, n*B] -> [n, F, B, 3]
-    return out.reshape(F, 3, n_nodes, n_bins).transpose(2, 0, 3, 1)
+    # [F, C, n*B] -> [n, F, B, C]
+    return out.reshape(F, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
 
 
 def resolve_impl(impl: str) -> str:
@@ -246,18 +267,38 @@ def resolve_impl(impl: str) -> str:
 
 
 def build_histogram(binned, rel, g, h, w, n_nodes: int, n_bins: int,
-                    impl: str = "auto"):
+                    impl: str = "auto", unit_hess: bool = False):
     """Per-shard histogram [n_nodes, F, B, 3] of (Σgw, Σhw, Σw).
 
     binned: [r, F] uint8 bin codes; rel: [r] int32 node id (-1 dead);
     w: [r] row weight (0 for padding/unsampled rows).
+
+    ``unit_hess``: the caller asserts h ≡ 1 (gaussian/laplace/quantile/
+    huber losses and DRF), so Σhw == Σw and the kernels accumulate TWO
+    channels [Σgw, Σw] instead of three — 1/3 fewer MXU passes and a
+    1/3 smaller psum payload at every tree level. The result is then
+    [..., 2]; callers expand back to [..., 3] AFTER their psum with
+    ``expand_unit_hess`` (expanding earlier would forfeit the psum
+    saving).
     """
     live = (rel >= 0) & (w > 0)
     rel = jnp.where(live, rel, -1)
+    impl = resolve_impl(impl)
     # where() (not just *w) so NaN g/h in dead rows can't poison sums
+    if unit_hess:
+        vals = jnp.where(live[:, None],
+                         jnp.stack([g * w, w], axis=1), 0.0)
+        fn = _hist_pallas if impl == "pallas" else _hist_segment
+        return fn(binned, rel, vals, n_nodes, n_bins)
     vals = jnp.where(live[:, None],
                      jnp.stack([g * w, h * w, w], axis=1), 0.0)
-    impl = resolve_impl(impl)
     if impl == "pallas":
         return _hist_pallas(binned, rel, vals, n_nodes, n_bins)
     return _hist_segment(binned, rel, vals, n_nodes, n_bins)
+
+
+def expand_unit_hess(hist2):
+    """[..., 2] (Σgw, Σw) → [..., 3] (Σgw, Σhw=Σw, Σw) — the H channel
+    of a unit-hessian histogram IS the weight channel."""
+    return jnp.concatenate(
+        [hist2[..., 0:1], hist2[..., 1:2], hist2[..., 1:2]], axis=-1)

@@ -34,19 +34,58 @@ _ID_LOCK = threading.Lock()
 _MODEL_SEQ = 0
 
 
+def _runtime_process_index() -> int | None:
+    """jax.process_index() IF the distributed runtime is up, else None.
+
+    Deliberately inspects the distributed client state instead of
+    calling jax.process_index(): that call initializes the backends,
+    and the readiness probe must never be the thing that hangs on a
+    recovering TPU client init."""
+    try:
+        from jax._src import distributed
+
+        if distributed.global_state.client is None:
+            return None
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return None
+
+
 def _is_leader() -> bool:
     """True on the clustered leader (process 0). The operator injects
     H2O_TPU_PROCESS_ID into every pod (native/deployment/manifests.cc);
-    single-process clouds are their own leader."""
+    single-process clouds are their own leader.
+
+    When the distributed runtime is actually up, the env var claim is
+    CROSS-CHECKED against jax.process_index(): a mislabeled pod (env
+    says 0, runtime disagrees — or vice versa) must fail readiness
+    rather than route client traffic to a non-leader (the reference's
+    /kubernetes/isLeaderNode answers from cluster state, not pod
+    metadata; h2o-k8s [U3])."""
     import os
 
     raw = os.environ.get("H2O_TPU_PROCESS_ID") or "0"
     try:
-        return int(raw) == 0
+        env_leader = int(raw) == 0
     except ValueError:
         # an unparseable pod index must read as not-leader (503), not
         # crash the probe into a 500 on every pod
         return False
+    rt = _runtime_process_index()
+    if rt is not None:
+        rt_leader = rt == 0
+        if rt_leader != env_leader:
+            from .diagnostics import log, timeline
+
+            msg = (f"H2O_TPU_PROCESS_ID={raw!r} but "
+                   f"jax.process_index()={rt}")
+            timeline.record("leader_mismatch", msg)
+            log.error("leader identity mismatch: %s", msg)
+            return False
+        return rt_leader
+    return env_leader
 
 _ALGOS = ("gbm", "drf", "glm", "deeplearning", "xgboost", "kmeans",
           "naivebayes", "pca", "isolationforest", "glrm", "coxph",

@@ -42,17 +42,20 @@ def _probe() -> float:
     """One heartbeat: psum a scalar across the whole mesh."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from jax.sharding import PartitionSpec as P
 
     from .mesh import ROWS, global_mesh
+    from .mrtask import shard_rows
 
     mesh = global_mesh()
     fn = jax.jit(jax.shard_map(
         lambda x: jax.lax.psum(jnp.sum(x), ROWS), mesh=mesh,
         in_specs=P(ROWS), out_specs=P()))
-    arr = jax.device_put(
-        jnp.ones(mesh.shape[ROWS]),
-        jax.sharding.NamedSharding(mesh, P(ROWS)))
+    # shard_rows handles the multi-host mesh (make_array_from_callback)
+    # — the probe must work exactly where it matters most, on a DCN
+    # cluster with non-addressable devices
+    arr = shard_rows(np.ones(mesh.shape[ROWS], np.float32), mesh=mesh)
     return float(fn(arr))
 
 

@@ -95,4 +95,13 @@ def shard_rows(x, mesh: Mesh | None = None, pad_value=None) -> jax.Array:
         pad = np.full((m - n,) + tuple(x.shape[1:]), pad_value, dtype=x.dtype)
         x = np.concatenate([np.asarray(x), pad], axis=0)
     from jax.sharding import NamedSharding
-    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(ROWS)))
+
+    sharding = NamedSharding(mesh, P(ROWS))
+    if not sharding.is_fully_addressable:
+        # multi-host (DCN) mesh: device_put cannot target devices owned
+        # by other processes; every process holds the same host array
+        # and contributes its local shards (multi-controller SPMD)
+        xnp = np.asarray(x)
+        return jax.make_array_from_callback(
+            xnp.shape, sharding, lambda idx: xnp[idx])
+    return jax.device_put(jnp.asarray(x), sharding)

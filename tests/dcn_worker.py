@@ -1,9 +1,23 @@
-"""Worker process for the 2-process DCN test (run by test_distributed.py).
+"""Worker process for the 2-process DCN tests (run by test_distributed.py).
 
 Forms a 2-process JAX distributed cluster over localhost (the DCN path
 of SURVEY.md §5.8 — the operator-injected H2O_TPU_* contract), builds a
-GLOBAL 8-device mesh (2 hosts x 4 local CPU devices), and runs one
-MRTask doall whose psum crosses the process boundary.
+GLOBAL 8-device mesh (2 hosts x 4 local CPU devices), and runs the
+requested workload MODE:
+
+  psum — one MRTask doall whose psum crosses the process boundary
+  gbm  — a FULL fused-scan GBM train (sharded boost dispatches whose
+         histogram psums ride the process boundary every level) +
+         cross-process-identical AUC
+  glm  — a full binomial IRLSM fit (distributed Gram psum per
+         iteration) + coefficient recovery
+  drop — process 1 exits after cluster formation; process 0 must
+         detect the dead mesh via the heartbeat probe and fail fast
+         with ClusterHealthError instead of training into a hang
+
+The reference proves multi-node behavior with real multi-JVM localhost
+clouds (SURVEY.md §4b); these are the same trick for the DCN runtime —
+no mocked collectives, a real 2-process cluster per test.
 """
 
 import os
@@ -13,6 +27,7 @@ import sys
 
 def main() -> None:
     port, pid = sys.argv[1], int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "psum"
     flags = os.environ.get("XLA_FLAGS", "")
     flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
     os.environ["XLA_FLAGS"] = (
@@ -38,18 +53,85 @@ def main() -> None:
 
     mesh = make_mesh()                                 # 8-way ROWS
     set_global_mesh(mesh)
-    n = 64
-    data = np.arange(n, dtype=np.float32)
-    sharding = NamedSharding(mesh, P("rows"))
-    arr = jax.make_array_from_callback(
-        (n,), sharding, lambda idx: data[idx])
 
-    res = doall(lambda x: {"s": jnp.sum(x), "mx": jnp.max(x)},
-                arr, reduce={"s": "sum", "mx": "max"}, mesh=mesh)
-    s, mx = float(res["s"]), float(res["mx"])
-    assert s == float(data.sum()), (s, data.sum())
-    assert mx == float(n - 1), mx
-    print(f"DCN_OK pid={pid} sum={s}", flush=True)
+    if mode == "psum":
+        n = 64
+        data = np.arange(n, dtype=np.float32)
+        sharding = NamedSharding(mesh, P("rows"))
+        arr = jax.make_array_from_callback(
+            (n,), sharding, lambda idx: data[idx])
+
+        res = doall(lambda x: {"s": jnp.sum(x), "mx": jnp.max(x)},
+                    arr, reduce={"s": "sum", "mx": "max"}, mesh=mesh)
+        s, mx = float(res["s"]), float(res["mx"])
+        assert s == float(data.sum()), (s, data.sum())
+        assert mx == float(n - 1), mx
+        print(f"DCN_OK pid={pid} sum={s}", flush=True)
+        return
+
+    # the model workloads build the SAME host data on every process
+    # (single-controller-style SPMD: identical program, identical
+    # inputs, device shards split by the global sharding)
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM, GLM
+
+    rng = np.random.default_rng(0)
+    n = 2000
+    x1 = rng.normal(size=n).astype(np.float32)
+    x2 = rng.normal(size=n).astype(np.float32)
+
+    if mode == "gbm":
+        yb = np.where(1.2 * x1 - 0.8 * x2 +
+                      rng.normal(scale=0.5, size=n) > 0, "p", "n")
+        fr = h2o.Frame.from_arrays({"x1": x1, "x2": x2, "y": yb})
+        m = GBM(ntrees=4, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        auc = float(m.scoring_history[-1]["train_auc"])
+        assert auc > 0.85, auc
+        # both processes must see the identical reduced model
+        print(f"DCN_GBM_OK pid={pid} auc={auc:.6f}", flush=True)
+        return
+
+    if mode == "glm":
+        pr = 1.0 / (1.0 + np.exp(-(0.8 * x1 - 1.5 * x2 + 0.3)))
+        yb = np.where(rng.uniform(size=n) < pr, "p", "n")
+        fr = h2o.Frame.from_arrays({"x1": x1, "x2": x2, "y": yb})
+        m = GLM(family="binomial", lambda_=0.0).train(
+            y="y", training_frame=fr)
+        coef = m.coef()
+        assert abs(coef["x1"] - 0.8) < 0.2, coef
+        assert abs(coef["x2"] + 1.5) < 0.3, coef
+        assert m.null_deviance > m.residual_deviance
+        print(f"DCN_GLM_OK pid={pid} x1={coef['x1']:.6f}", flush=True)
+        return
+
+    if mode == "drop":
+        from h2o_kubernetes_tpu.runtime import health
+
+        # prove the cloud works first (one real cross-process train)
+        yb = np.where(x1 > 0, "p", "n")
+        fr = h2o.Frame.from_arrays({"x1": x1, "x2": x2, "y": yb})
+        GBM(ntrees=2, max_depth=2, seed=1).train(
+            y="y", training_frame=fr)
+        if pid == 1:
+            # die without goodbye — the locked cloud has lost a member
+            print("DCN_DROP_EXITING pid=1", flush=True)
+            os._exit(17)
+        import time
+
+        time.sleep(5.0)              # let process 1 actually die
+        ok = health.heartbeat(timeout=20.0)
+        assert not ok, "heartbeat still passing after a member died"
+        try:
+            GBM(ntrees=2, max_depth=2, seed=1).train(
+                y="y", training_frame=fr)
+            raise AssertionError("train on a dead mesh did not fail")
+        except health.ClusterHealthError as e:
+            print(f"DCN_DROP_OK pid=0 err={e}", flush=True)
+        # exit without waiting on the dead runtime's shutdown barrier
+        os._exit(0)
+
+    raise SystemExit(f"unknown mode {mode!r}")
 
 
 if __name__ == "__main__":

@@ -26,6 +26,7 @@ def test_drf_binary(mesh8):
     assert perf["auc"] > sk_auc - 0.035  # parity band vs sklearn RF
 
 
+@pytest.mark.slow
 def test_drf_regression(mesh8):
     rng = np.random.default_rng(2)
     n = 3000
@@ -38,6 +39,7 @@ def test_drf_regression(mesh8):
     assert perf["r2"] > 0.85
 
 
+@pytest.mark.slow
 def test_drf_multiclass_probs_sum_to_one(mesh8):
     rng = np.random.default_rng(4)
     n = 2000

@@ -344,6 +344,7 @@ def test_glm_gamma_rejects_nonpositive_response(mesh8):
         GLM(family="gamma").train(y="y", training_frame=fr)
 
 
+@pytest.mark.slow
 def test_glm_multinomial_irlsm_vs_lbfgs(mesh8):
     """Multinomial under IRLSM (cyclic per-class Fisher scoring, the
     reference's shape) must land on the same solution the L-BFGS path

@@ -50,6 +50,7 @@ def _toy_frame(n=300, seed=5):
     return h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
 
 
+@pytest.mark.slow
 def test_automl_resume_from_manifest(tmp_path, mesh8):
     fr = _toy_frame()
     kw = dict(nfolds=2, seed=3, project_name="resume_t",

@@ -72,6 +72,62 @@ def test_factorized_vs_blocked_agree(monkeypatch):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("impl", ["segment", "pallas"])
+def test_unit_hess_two_channel_matches_three(impl):
+    """h ≡ 1: the 2-channel accumulation (expanded back to 3) must
+    equal the full 3-channel build with h = ones."""
+    from h2o_kubernetes_tpu.ops.histogram import expand_unit_hess
+
+    binned, rel, g, _, w = _random_case(900, 4, 8, 32, seed=11)
+    ones = jnp.ones_like(w)
+    ref = build_histogram(binned, rel, g, ones, w, 8, 32, impl=impl)
+    got2 = build_histogram(binned, rel, g, ones, w, 8, 32, impl=impl,
+                           unit_hess=True)
+    assert got2.shape == (8, 4, 32, 2)
+    got = expand_unit_hess(got2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gaussian_gbm_unit_hess_matches_full_channels(mesh8):
+    """End to end: a gaussian GBM (unit_hess path) must predict the
+    same as a build forced through the 3-channel kernels."""
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.models.tree import core as C
+
+    rng = np.random.default_rng(12)
+    n = 600
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.sin(2 * x) + rng.normal(scale=0.2, size=n)
+    fr = h2o.Frame.from_arrays({"x": x, "y": y})
+    m2 = GBM(ntrees=3, max_depth=3, nbins=32, seed=0).train(
+        y="y", training_frame=fr)
+    orig = C.TreeParams.__new__.__defaults__
+    m3 = None
+    try:
+        # forcing unit_hess=False exercises the 3-channel path on the
+        # same data (TreeParams is a NamedTuple: patch the default)
+        import h2o_kubernetes_tpu.models.gbm as G
+
+        real_tp = C.TreeParams
+
+        def no_unit(*a, **kw):
+            kw["unit_hess"] = False
+            return real_tp(*a, **kw)
+
+        G.TreeParams = no_unit
+        m3 = GBM(ntrees=3, max_depth=3, nbins=32, seed=0).train(
+            y="y", training_frame=fr)
+    finally:
+        import h2o_kubernetes_tpu.models.gbm as G
+
+        G.TreeParams = C.TreeParams
+        del orig
+    np.testing.assert_allclose(m2.predict_raw(fr), m3.predict_raw(fr),
+                               rtol=1e-6)
+
+
 def test_totals_preserved():
     binned, rel, g, h, w = _random_case(700, 3, 8, 32, seed=1)
     hist = build_histogram(binned, rel, g, h, w, 8, 32, impl="pallas")

@@ -21,6 +21,7 @@ def _frame(n=400, seed=21):
     return h2o.Frame.from_arrays({"x0": x0, "x1": x1, "g": g, "y": y})
 
 
+@pytest.mark.slow
 def test_stackedensemble_mojo_matches(tmp_path, mesh8):
     fr = _frame(500, seed=3)
     common = dict(nfolds=3, fold_assignment="modulo",

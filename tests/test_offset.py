@@ -1,0 +1,283 @@
+"""offset_column for GLM and GBM.
+
+Reference: hex/ModelBuilder offset_column + hex/glm/GLM offset handling
+[U3] — the offset is a fixed per-row term added to the linear predictor
+(GLM eta / GBM margin), supplied at train AND scoring time.
+
+With no statsmodels in the image, parity comes from a hand-rolled numpy
+IRLS reference (poisson) plus exact invariance properties:
+ - gaussian: offset o  ==  fit of (y - o), predictions shifted back
+ - any family: a CONSTANT offset c shifts only the intercept, by -c
+ - bernoulli GBM: a constant offset is absorbed by the init prior, so
+   predictions are unchanged
+"""
+
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu import Frame
+from h2o_kubernetes_tpu.models import DRF, GBM, GLM
+
+
+def _poisson_irls_offset(X, y, off, n_iter=50):
+    """Textbook Fisher scoring for poisson log-link with offset —
+    the parity reference (statsmodels is not in this image)."""
+    Xd = np.column_stack([X, np.ones(len(y))])
+    beta = np.zeros(Xd.shape[1])
+    beta[-1] = np.log(max(y.mean(), 1e-8))
+    for _ in range(n_iter):
+        eta = Xd @ beta + off
+        mu = np.exp(np.clip(eta, -30, 30))
+        z = eta + (y - mu) / mu - off
+        W = mu
+        G = Xd.T @ (W[:, None] * Xd)
+        b = Xd.T @ (W * z)
+        beta_new = np.linalg.solve(G, b)
+        if np.max(np.abs(beta_new - beta)) < 1e-10:
+            beta = beta_new
+            break
+        beta = beta_new
+    return beta
+
+
+def test_glm_poisson_offset_matches_numpy_irls(mesh8):
+    rng = np.random.default_rng(0)
+    n = 4000
+    x = rng.normal(size=n)
+    exposure = rng.uniform(0.5, 3.0, size=n)      # actuarial exposure
+    off = np.log(exposure)
+    y = rng.poisson(exposure * np.exp(0.6 * x + 0.4)).astype(float)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    m = GLM(family="poisson", lambda_=0.0).train(
+        y="y", training_frame=fr, offset_column="off")
+    want = _poisson_irls_offset(x[:, None], y, off)
+    coef = m.coef()
+    np.testing.assert_allclose(coef["x"], want[0], rtol=1e-4)
+    np.testing.assert_allclose(coef["Intercept"], want[1], rtol=1e-4)
+    # and the offset actually matters: coefficients differ from the
+    # no-offset fit
+    m0 = GLM(family="poisson", lambda_=0.0).train(
+        y="y", training_frame=fr, ignored_columns=["off"])
+    assert abs(m0.coef()["Intercept"] - coef["Intercept"]) > 1e-3
+
+
+def test_glm_gaussian_offset_equals_shifted_response(mesh8):
+    rng = np.random.default_rng(1)
+    n = 3000
+    x = rng.normal(size=n)
+    off = rng.normal(size=n)
+    y = 1.5 * x + 2.0 + off + rng.normal(scale=0.3, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y,
+                            "y_shift": y - off})
+    m = GLM(family="gaussian", lambda_=0.0).train(
+        y="y", training_frame=fr, offset_column="off",
+        ignored_columns=["y_shift"])
+    m2 = GLM(family="gaussian", lambda_=0.0).train(
+        y="y_shift", training_frame=fr, ignored_columns=["y", "off"])
+    np.testing.assert_allclose(m.coef()["x"], m2.coef()["x"], rtol=1e-5)
+    np.testing.assert_allclose(m.coef()["Intercept"],
+                               m2.coef()["Intercept"], atol=1e-4)
+    # predictions include the offset
+    pred = m.predict_raw(fr)
+    pred2 = m2.predict_raw(fr)
+    np.testing.assert_allclose(pred, pred2 + off, atol=1e-3)
+
+
+def test_glm_binomial_constant_offset_shifts_intercept(mesh8):
+    rng = np.random.default_rng(2)
+    n = 4000
+    x = rng.normal(size=n)
+    pr = 1 / (1 + np.exp(-(1.2 * x - 0.5)))
+    y = np.array(["n", "p"])[(rng.uniform(size=n) < pr).astype(int)]
+    c = 0.7
+    fr = Frame.from_arrays({"x": x, "off": np.full(n, c), "y": y})
+    m = GLM(family="binomial", lambda_=0.0).train(
+        y="y", training_frame=fr, offset_column="off")
+    m0 = GLM(family="binomial", lambda_=0.0).train(
+        y="y", training_frame=fr, ignored_columns=["off"])
+    np.testing.assert_allclose(m.coef()["x"], m0.coef()["x"], rtol=1e-4)
+    np.testing.assert_allclose(m.coef()["Intercept"],
+                               m0.coef()["Intercept"] - c, atol=1e-4)
+    # null deviance uses the offset-aware intercept MLE: with a
+    # constant offset it must equal the no-offset null deviance
+    np.testing.assert_allclose(m.null_deviance, m0.null_deviance,
+                               rtol=1e-5)
+
+
+def test_glm_offset_validation(mesh8):
+    rng = np.random.default_rng(3)
+    n = 200
+    fr = Frame.from_arrays({
+        "x": rng.normal(size=n),
+        "g": np.array(["a", "b"])[rng.integers(0, 2, size=n)],
+        "y": rng.normal(size=n)})
+    with pytest.raises(ValueError, match="not in frame"):
+        GLM(family="gaussian").train(y="y", training_frame=fr,
+                                     offset_column="nope")
+    with pytest.raises(ValueError, match="numeric"):
+        GLM(family="gaussian").train(y="y", training_frame=fr,
+                                     offset_column="g")
+    y3 = np.array(["a", "b", "c"])[rng.integers(0, 3, size=n)]
+    fr3 = Frame.from_arrays({"x": rng.normal(size=n),
+                             "off": rng.normal(size=n), "y": y3})
+    with pytest.raises(ValueError, match="multinomial"):
+        GLM(family="multinomial").train(y="y", training_frame=fr3,
+                                        offset_column="off")
+
+
+def test_gbm_gaussian_offset_equals_shifted_response(mesh8):
+    rng = np.random.default_rng(4)
+    n = 3000
+    x = rng.normal(size=n)
+    off = rng.normal(size=n)
+    y = np.sin(2 * x) + off + rng.normal(scale=0.2, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y,
+                            "y_shift": y - off})
+    m = GBM(ntrees=10, max_depth=3, seed=7).train(
+        y="y", training_frame=fr, offset_column="off",
+        ignored_columns=["y_shift"])
+    m2 = GBM(ntrees=10, max_depth=3, seed=7).train(
+        y="y_shift", training_frame=fr, ignored_columns=["y", "off"])
+    pred = m.predict_raw(fr)
+    pred2 = m2.predict_raw(fr)
+    np.testing.assert_allclose(pred, pred2 + off, atol=1e-4)
+
+
+def test_gbm_bernoulli_constant_offset_absorbed_by_init(mesh8):
+    rng = np.random.default_rng(5)
+    n = 3000
+    x = rng.normal(size=n)
+    pr = 1 / (1 + np.exp(-1.5 * x))
+    y = np.array(["n", "p"])[(rng.uniform(size=n) < pr).astype(int)]
+    fr = Frame.from_arrays({"x": x, "off": np.full(n, 1.3), "y": y})
+    m = GBM(ntrees=8, max_depth=3, seed=0).train(
+        y="y", training_frame=fr, offset_column="off")
+    m0 = GBM(ntrees=8, max_depth=3, seed=0).train(
+        y="y", training_frame=fr, ignored_columns=["off"])
+    # margin = init + c + trees == (init0) + trees: identical probs
+    np.testing.assert_allclose(m.predict_raw(fr), m0.predict_raw(fr),
+                               atol=2e-4)
+    np.testing.assert_allclose(m.init_score + 1.3, m0.init_score,
+                               atol=2e-4)
+
+
+def test_gbm_poisson_offset_exposure(mesh8):
+    rng = np.random.default_rng(6)
+    n = 4000
+    x = rng.normal(size=n)
+    exposure = rng.uniform(0.5, 4.0, size=n)
+    off = np.log(exposure)
+    y = rng.poisson(exposure * np.exp(0.5 * x)).astype(float)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    m = GBM(ntrees=20, max_depth=3, distribution="poisson",
+            seed=0).train(y="y", training_frame=fr, offset_column="off")
+    m0 = GBM(ntrees=20, max_depth=3, distribution="poisson",
+             seed=0).train(y="y", training_frame=fr,
+                           ignored_columns=["off"])
+    # offset model predicts counts including exposure; its per-exposure
+    # rate error must beat the no-offset model's
+    rate = np.exp(0.5 * x)
+    err = np.abs(m.predict_raw(fr) / exposure - rate).mean()
+    err0 = np.abs(m0.predict_raw(fr) / exposure - rate).mean()
+    assert err < err0
+
+
+def test_gbm_offset_scoring_requires_column(mesh8):
+    rng = np.random.default_rng(7)
+    n = 500
+    x = rng.normal(size=n)
+    off = rng.normal(size=n)
+    y = x + off + rng.normal(scale=0.1, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    m = GBM(ntrees=3, max_depth=2).train(
+        y="y", training_frame=fr, offset_column="off")
+    bare = Frame.from_arrays({"x": x})
+    with pytest.raises(ValueError, match="offset"):
+        m.predict_raw(bare)
+    with pytest.raises(ValueError, match="offset"):
+        m.predict_contributions(fr)
+
+
+def test_offset_unsupported_modes(mesh8):
+    rng = np.random.default_rng(8)
+    n = 300
+    x = rng.normal(size=n)
+    off = rng.normal(size=n)
+    fr3 = Frame.from_arrays({
+        "x": x, "off": off,
+        "y": np.array(["a", "b", "c"])[rng.integers(0, 3, size=n)]})
+    with pytest.raises(ValueError, match="multinomial"):
+        GBM(ntrees=2).train(y="y", training_frame=fr3,
+                            offset_column="off")
+    frr = Frame.from_arrays({"x": x, "off": off,
+                             "y": rng.normal(size=n)})
+    with pytest.raises(ValueError, match="DRF"):
+        DRF(ntrees=2).train(y="y", training_frame=frr,
+                            offset_column="off")
+
+
+def test_offset_mojo_and_xgboost_scoring(mesh8, tmp_path):
+    """The exported artifact must score WITH the offset (it would
+    otherwise silently shift every prediction), and the XGBoost model
+    class must accept the offset kwarg at predict time."""
+    from h2o_kubernetes_tpu.models import XGBoost
+    from h2o_kubernetes_tpu.mojo import export_mojo, import_mojo
+
+    rng = np.random.default_rng(10)
+    n = 800
+    x = rng.normal(size=n)
+    off = rng.normal(scale=0.5, size=n)
+    y = x + off + rng.normal(scale=0.1, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    for est in (GBM(ntrees=5, max_depth=3),
+                GLM(family="gaussian", lambda_=0.0),
+                XGBoost(ntrees=5, max_depth=3)):
+        m = est.train(y="y", training_frame=fr, offset_column="off")
+        want = m.predict_raw(fr)
+        p = str(tmp_path / f"{m.algo}.mojo")
+        export_mojo(m, p)
+        mojo = import_mojo(p)
+        got = mojo.predict({"x": x, "off": off})
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        with pytest.raises(ValueError, match="offset"):
+            mojo.predict({"x": x})
+
+
+def test_offset_na_propagates_and_partial_plot(mesh8):
+    rng = np.random.default_rng(11)
+    n = 400
+    x = rng.normal(size=n)
+    off = rng.normal(size=n)
+    y = x + off + rng.normal(scale=0.1, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    m = GBM(ntrees=4, max_depth=2).train(
+        y="y", training_frame=fr, offset_column="off")
+    off_na = off.copy()
+    off_na[::10] = np.nan
+    fr_na = Frame.from_arrays({"x": x, "off": off_na})
+    pred = m.predict_raw(fr_na)
+    # rows without a defined base margin have no defined prediction
+    assert np.isnan(pred[::10]).all()
+    assert not np.isnan(pred[1::10]).any()
+    # partial_plot must score at the frame's offsets, consistent with
+    # predict(): with offsets halved, the PD mean must shift too
+    pd1 = m.partial_plot(fr, ["x"], nbins=5)[0]
+    fr2 = Frame.from_arrays({"x": x, "off": off - 1.0})
+    pd2 = m.partial_plot(fr2, ["x"], nbins=5)[0]
+    m1 = np.asarray(pd1.vec("mean_response").as_float())[:5]
+    m2 = np.asarray(pd2.vec("mean_response").as_float())[:5]
+    np.testing.assert_allclose(m1 - 1.0, m2, atol=1e-4)
+
+
+def test_glm_offset_with_cv(mesh8):
+    # the offset must ride through fold training and holdout scoring
+    rng = np.random.default_rng(9)
+    n = 1200
+    x = rng.normal(size=n)
+    off = rng.normal(scale=0.5, size=n)
+    y = 1.0 * x + off + rng.normal(scale=0.3, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    m = GLM(family="gaussian", lambda_=0.0, nfolds=3).train(
+        y="y", training_frame=fr, offset_column="off")
+    assert m.cv is not None
+    assert m.cross_validation_metrics()["r2"] > 0.5

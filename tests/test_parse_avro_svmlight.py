@@ -1,0 +1,234 @@
+"""Avro + SVMLight ingest round-trips (h2o-parsers analogs [U3]).
+
+The Avro files are written by an inline stdlib encoder (zig-zag varints
++ container framing) so the reader is exercised against independently
+constructed bytes, not its own output.
+"""
+
+import json
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from h2o_kubernetes_tpu.frame.parse import import_file
+
+
+# -- minimal avro writer ------------------------------------------------------
+
+def _zz(n: int) -> bytes:
+    u = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _avro_str(s: str) -> bytes:
+    b = s.encode()
+    return _zz(len(b)) + b
+
+
+def _write_avro(path, schema: dict, rows: list[dict], codec="null"):
+    body = bytearray()
+    for rec in rows:
+        for fld in schema["fields"]:
+            body += _encode_value(fld["type"], rec[fld["name"]])
+    blk = bytes(body)
+    if codec == "deflate":
+        c = zlib.compressobj(wbits=-15)
+        blk = c.compress(blk) + c.flush()
+    sync = b"S" * 16
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    out = bytearray(b"Obj\x01")
+    out += _zz(len(meta))
+    for k, v in meta.items():
+        out += _avro_str(k) + _zz(len(v)) + v
+    out += _zz(0)
+    out += sync
+    out += _zz(len(rows)) + _zz(len(blk)) + blk + sync
+    with open(path, "wb") as f:
+        f.write(out)
+
+
+def _encode_value(ftype, v) -> bytes:
+    if isinstance(ftype, list):                      # nullable union
+        if v is None:
+            return _zz(ftype.index("null"))
+        branch = [b for b in ftype if b != "null"][0]
+        return _zz(ftype.index(branch)) + _encode_value(branch, v)
+    if isinstance(ftype, dict):
+        if ftype["type"] == "enum":
+            return _zz(ftype["symbols"].index(v))
+        if ftype.get("logicalType"):
+            return _zz(int(v))
+        return _encode_value(ftype["type"], v)
+    if ftype in ("int", "long"):
+        return _zz(int(v))
+    if ftype == "double":
+        return struct.pack("<d", v)
+    if ftype == "float":
+        return struct.pack("<f", v)
+    if ftype == "boolean":
+        return b"\x01" if v else b"\x00"
+    if ftype == "string":
+        return _avro_str(v)
+    raise AssertionError(ftype)
+
+
+_SCHEMA = {
+    "type": "record", "name": "r", "fields": [
+        {"name": "xd", "type": "double"},
+        {"name": "xi", "type": "long"},
+        {"name": "flag", "type": "boolean"},
+        {"name": "cat", "type": {"type": "enum", "name": "c",
+                                 "symbols": ["low", "mid", "high"]}},
+        {"name": "s", "type": "string"},
+        {"name": "maybe", "type": ["null", "double"]},
+        {"name": "ts", "type": {"type": "long",
+                                "logicalType": "timestamp-millis"}},
+    ]}
+
+
+def _rows(n=50, seed=0):
+    rng = np.random.default_rng(seed)
+    syms = ["low", "mid", "high"]
+    return [{"xd": float(rng.normal()),
+             "xi": int(rng.integers(-5, 100)),
+             "flag": bool(rng.integers(0, 2)),
+             "cat": syms[int(rng.integers(0, 3))],
+             "s": f"tok{int(rng.integers(0, 4))}",
+             "maybe": None if i % 7 == 0 else float(i),
+             "ts": 1_700_000_000_000 + i * 1000}
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_roundtrip(tmp_path, codec, mesh8):
+    rows = _rows()
+    p = tmp_path / "t.avro"
+    _write_avro(p, _SCHEMA, rows, codec=codec)
+    fr = import_file(str(p))
+    assert fr.nrows == len(rows)
+    assert fr.names == ["xd", "xi", "flag", "cat", "s", "maybe", "ts"]
+    np.testing.assert_allclose(
+        np.asarray(fr.vec("xd").as_float())[: fr.nrows],
+        [r["xd"] for r in rows], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(fr.vec("xi").as_float())[: fr.nrows],
+        [r["xi"] for r in rows])
+    np.testing.assert_allclose(
+        np.asarray(fr.vec("flag").as_float())[: fr.nrows],
+        [float(r["flag"]) for r in rows])
+    v = fr.vec("cat")
+    assert v.is_enum() and v.domain == ["low", "mid", "high"]
+    got = [v.domain[c] for c in v.to_numpy()[: fr.nrows]]
+    assert got == [r["cat"] for r in rows]
+    # nullable union: None -> NA
+    m = np.asarray(fr.vec("maybe").as_float())[: fr.nrows]
+    for i, r in enumerate(rows):
+        if r["maybe"] is None:
+            assert np.isnan(m[i])
+        else:
+            assert m[i] == r["maybe"]
+    assert fr.vec("ts").kind == "time"
+
+
+def test_avro_multifile_and_schema_mismatch(tmp_path, mesh8):
+    _write_avro(tmp_path / "a1.avro", _SCHEMA, _rows(20, seed=1))
+    _write_avro(tmp_path / "a2.avro", _SCHEMA, _rows(30, seed=2))
+    fr = import_file(str(tmp_path / "a*.avro"))
+    assert fr.nrows == 50
+    other = dict(_SCHEMA)
+    other["fields"] = _SCHEMA["fields"][:3]
+    _write_avro(tmp_path / "b1.avro", _SCHEMA, _rows(5))
+    _write_avro(tmp_path / "b2.avro", other,
+                [{k: r[k] for k in ("xd", "xi", "flag")}
+                 for r in _rows(5)])
+    with pytest.raises(ValueError, match="schema differs"):
+        import_file([str(tmp_path / "b1.avro"),
+                     str(tmp_path / "b2.avro")])
+
+
+def test_svmlight_roundtrip(tmp_path, mesh8):
+    p = tmp_path / "t.svm"
+    p.write_text(
+        "1 1:0.5 3:2.0 # trailing comment\n"
+        "0 2:1.5\n"
+        "-1 1:-1.0 2:0.25 3:3.5\n"
+        "\n")
+    fr = import_file(str(p))
+    assert fr.names == ["C1", "C2", "C3", "C4"]
+    assert fr.nrows == 3
+    lab = np.asarray(fr.vec("C1").as_float())[:3]
+    np.testing.assert_allclose(lab, [1, 0, -1])
+    X = np.stack([np.asarray(fr.vec(f"C{j}").as_float())[:3]
+                  for j in (2, 3, 4)], axis=1)
+    want = np.array([[0.5, 0.0, 2.0],
+                     [0.0, 1.5, 0.0],
+                     [-1.0, 0.25, 3.5]])
+    np.testing.assert_allclose(X, want)   # absent entries are 0, not NA
+
+
+def test_svmlight_qid_and_sniff(tmp_path, mesh8):
+    # extension-free file must be detected by content, qid kept
+    p = tmp_path / "ranktrain"
+    p.write_text("2 qid:1 1:1.0\n1 qid:1 2:2.0\n0 qid:2 1:0.5 2:0.5\n")
+    fr = import_file(str(p))
+    assert "qid" in fr.names
+    np.testing.assert_allclose(
+        np.asarray(fr.vec("qid").as_float())[:3], [1, 1, 2])
+
+
+def test_svmlight_rejects_disorder(tmp_path, mesh8):
+    p = tmp_path / "bad.svm"
+    p.write_text("1 3:1.0 2:0.5\n")
+    with pytest.raises(ValueError, match="non-increasing"):
+        import_file(str(p))
+
+
+def test_avro_rejects_type_mismatch_across_files(tmp_path, mesh8):
+    # same field NAMES but different types: decoding file2 with file1's
+    # schema would read varints as doubles — must refuse
+    s1 = {"type": "record", "name": "r",
+          "fields": [{"name": "x", "type": "long"}]}
+    s2 = {"type": "record", "name": "r",
+          "fields": [{"name": "x", "type": "double"}]}
+    _write_avro(tmp_path / "c1.avro", s1, [{"x": 1}, {"x": 2}])
+    _write_avro(tmp_path / "c2.avro", s2, [{"x": 1.5}])
+    with pytest.raises(ValueError, match="schema differs"):
+        import_file([str(tmp_path / "c1.avro"),
+                     str(tmp_path / "c2.avro")])
+
+
+def test_avro_truncated_file_errors_cleanly(tmp_path, mesh8):
+    p = tmp_path / "t.avro"
+    _write_avro(p, _SCHEMA, _rows(10))
+    blob = p.read_bytes()
+    p.write_bytes(blob[: len(blob) - 7])    # chop mid-block
+    with pytest.raises(ValueError, match="truncated|sync"):
+        import_file(str(p))
+
+
+def test_svmlight_dense_budget(tmp_path, monkeypatch, mesh8):
+    p = tmp_path / "wide.svm"
+    p.write_text("1 1:1.0 1000000:2.0\n")
+    monkeypatch.setenv("H2O_TPU_SVMLIGHT_DENSE_BUDGET", "1000")
+    with pytest.raises(ValueError, match="densify"):
+        import_file(str(p))
+
+
+def test_svmlight_sniff_does_not_eat_csv(tmp_path, mesh8):
+    # a CSV with colon-bearing strings must stay CSV
+    p = tmp_path / "t.csv"
+    p.write_text("a,b\n1,x:1\n2,y:2\n")
+    fr = import_file(str(p))
+    assert fr.names == ["a", "b"]
+    assert fr.vec("b").is_enum()

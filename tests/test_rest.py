@@ -131,6 +131,46 @@ def test_leader_readiness(server, monkeypatch):
     assert e.value.code == 503      # non-leader pods must NOT go Ready
 
 
+def test_leader_env_runtime_crosscheck(monkeypatch):
+    """A pod whose env CLAIMS leadership but whose runtime process
+    index disagrees (or vice versa) must fail readiness + log the
+    mismatch — pod metadata alone cannot make a non-leader Ready."""
+    from h2o_kubernetes_tpu import rest
+    from h2o_kubernetes_tpu.diagnostics import timeline
+
+    # runtime not initialized: env alone decides (single-process cloud)
+    monkeypatch.setattr(rest, "_runtime_process_index", lambda: None)
+    monkeypatch.setenv("H2O_TPU_PROCESS_ID", "0")
+    assert rest._is_leader() is True
+
+    # env says leader, runtime says process 3: spoofed pod -> 503 path
+    monkeypatch.setattr(rest, "_runtime_process_index", lambda: 3)
+    assert rest._is_leader() is False
+    assert any(e["kind"] == "leader_mismatch"
+               for e in timeline.events())
+
+    # env says non-leader but runtime IS process 0: also a mismatch
+    monkeypatch.setenv("H2O_TPU_PROCESS_ID", "1")
+    monkeypatch.setattr(rest, "_runtime_process_index", lambda: 0)
+    assert rest._is_leader() is False
+
+    # agreement on leadership passes
+    monkeypatch.setenv("H2O_TPU_PROCESS_ID", "0")
+    assert rest._is_leader() is True
+    # agreement on NON-leadership still 503s
+    monkeypatch.setenv("H2O_TPU_PROCESS_ID", "2")
+    monkeypatch.setattr(rest, "_runtime_process_index", lambda: 2)
+    assert rest._is_leader() is False
+
+
+def test_runtime_process_index_without_distributed():
+    # in-process truth: no jax.distributed here, so the probe must
+    # report None (and never initialize a backend to find out)
+    from h2o_kubernetes_tpu import rest
+
+    assert rest._runtime_process_index() is None
+
+
 def test_timeline(server):
     from h2o_kubernetes_tpu.diagnostics import timeline
 

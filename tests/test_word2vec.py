@@ -19,6 +19,7 @@ def _synthetic_corpus(n_sent=800, seed=0):
     return Frame.from_arrays({"words": np.array(words, dtype=object)})
 
 
+@pytest.mark.slow
 def test_word2vec_topic_clustering(mesh8):
     fr = _synthetic_corpus()
     m = Word2Vec(vec_size=16, epochs=30, min_word_freq=5, seed=1).train(fr)

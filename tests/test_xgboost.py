@@ -66,6 +66,7 @@ def test_regression_squarederror(mesh8):
     assert perf["r2"] > 0.95
 
 
+@pytest.mark.slow
 def test_min_child_weight_regularizes(mesh8):
     """High hessian floor must forbid tiny leaves (fewer splits)."""
     fr = _binary_frame(n=600, seed=3)

@@ -1,0 +1,146 @@
+"""AutoML wall-clock scaling evidence (Airlines-10M config shape).
+
+The north star (`BASELINE.json` config #5) is AutoML wall-clock on an
+Airlines-10M-shaped table. This harness produces the round's evidence
+either way:
+
+- on a live TPU (``--rows 10000000 --max-models 12``, run by
+  tools/tpu_watch.py after a bench capture): the on-chip wall-clock +
+  leaderboard the north star is phrased in;
+- on the CPU mesh (default): a rows-scaling curve with XLA
+  **compile-count accounting** — the count must NOT grow with
+  max_models (no per-model recompiles; dispatch-budget chunking and
+  shared jitted trainers mean every same-shaped model reuses the same
+  executables).
+
+Prints one JSON line per shape + a trailing summary line, and writes
+``AUTOML_SCALE_r04.json`` (CPU) / ``AUTOML_TPU_r04.json`` (TPU) at the
+repo root.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class _CompileCounter(logging.Handler):
+    """Counts XLA compiles via jax's log_compiles events."""
+
+    def __init__(self):
+        super().__init__()
+        self.count = 0
+
+    def emit(self, record):
+        if "Compiling" in record.getMessage():
+            self.count += 1
+
+
+def make_table(rows: int, seed: int = 0):
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+
+    rng = np.random.default_rng(seed)
+    F = 10
+    X = {f"x{i}": rng.normal(size=rows).astype(np.float32)
+         for i in range(F - 2)}
+    X["carrier"] = np.array(["AA", "UA", "DL", "WN", "B6", "AS", "NK",
+                             "F9"])[rng.integers(0, 8, size=rows)]
+    X["dep_delay"] = rng.exponential(10.0, size=rows).astype(np.float32)
+    logit = (1.2 * X["x0"] - 0.8 * X["x1"] + 0.05 * X["dep_delay"]
+             - 1.0 + rng.normal(scale=0.5, size=rows))
+    X["y"] = np.where(logit > 0, "late", "ontime")
+    return h2o.Frame.from_arrays(X)
+
+
+def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
+    import jax
+
+    from h2o_kubernetes_tpu.automl import AutoML
+
+    counter = _CompileCounter()
+    logger = logging.getLogger("jax._src.interpreters.pxla")
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(counter)
+    logger.addHandler(counter)
+    try:
+        fr = make_table(rows)
+        t0 = time.perf_counter()
+        aml = AutoML(max_models=max_models, nfolds=nfolds, seed=1,
+                     project_name=f"scale_{rows}")
+        aml.train(y="y", training_frame=fr)
+        wall = time.perf_counter() - t0
+        lb = aml.leaderboard.as_list()
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        logging.getLogger("jax").removeHandler(counter)
+        logger.removeHandler(counter)
+    out = {
+        "rows": rows,
+        "max_models": max_models,
+        "nfolds": nfolds,
+        "models_trained": len(lb),
+        "wall_seconds": round(wall, 1),
+        "xla_compiles": counter.count,
+        "leader": lb[0]["model_id"] if lb else None,
+        "leader_auc": round(lb[0].get("auc", float("nan")), 5)
+        if lb else None,
+        "platform": jax.default_backend(),
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, nargs="+", default=None,
+                    help="row counts (default: 1M 2M 4M cpu curve)")
+    ap.add_argument("--max-models", type=int, default=6)
+    ap.add_argument("--nfolds", type=int, default=3)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    from h2o_kubernetes_tpu.runtime.backend import ensure_live_backend
+
+    ensure_live_backend()
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    rows_list = args.rows or ([10_000_000] if on_tpu
+                              else [1_000_000, 2_000_000, 4_000_000])
+    results = [run_shape(r, args.max_models, args.nfolds)
+               for r in rows_list]
+    # per-model recompile check: compiles must not scale with models —
+    # compare against a HALF-max_models run at the smallest shape
+    recompile_check = None
+    if len(results) >= 1 and args.max_models >= 4:
+        half = run_shape(rows_list[0], max(args.max_models // 2, 2),
+                         args.nfolds)
+        # tolerance: the half run still compiles the shared trainers
+        recompile_check = {
+            "full_models": results[0]["models_trained"],
+            "full_compiles": results[0]["xla_compiles"],
+            "half_models": half["models_trained"],
+            "half_compiles": half["xla_compiles"],
+            "per_model_recompiles": results[0]["xla_compiles"]
+            - half["xla_compiles"],
+        }
+    summary = {"curve": results, "recompile_check": recompile_check,
+               "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    out = args.out or os.path.join(
+        REPO, "AUTOML_TPU_r04.json" if on_tpu else "AUTOML_SCALE_r04.json")
+    with open(out, "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({"automl_scale": "done", "file": out,
+                      "shapes": len(results)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,8 @@ def main() -> int:
 
     from h2o_kubernetes_tpu.ops.histogram import (_FACT_MAX_NHI,
                                                   _hist_segment,
-                                                  build_histogram)
+                                                  build_histogram,
+                                                  expand_unit_hess)
 
     platform = jax.default_backend()
     rng = np.random.default_rng(0)
@@ -82,6 +83,29 @@ def main() -> int:
     parity("binblock_kernel", 50_000, 4, n_nodes_deep, 256)
     # 2b. single-bin totals shape (the final-level leaf reduction)
     parity("leaf_totals_kernel", 100_000, 1, 32, 1)
+
+    # 2c. unit-hessian 2-channel kernel (gaussian/DRF fast path): must
+    # compile on Mosaic and match the 3-channel build with h = 1
+    rows_u, F_u, n_u, B_u = 100_000, 10, 16, 256
+    binned_u = jnp.asarray(
+        rng.integers(0, B_u, size=(rows_u, F_u)).astype(np.uint8))
+    rel_u = jnp.asarray(rng.integers(0, n_u, size=rows_u).astype(
+        np.int32))
+    g_u = jnp.asarray(rng.normal(size=rows_u).astype(np.float32))
+    w_u = jnp.asarray((rng.uniform(size=rows_u) < 0.95).astype(
+        np.float32))
+    ones_u = jnp.ones_like(w_u)
+    want_u = jax.jit(build_histogram, static_argnums=(5, 6, 7))(
+        binned_u, rel_u, g_u, ones_u, w_u, n_u, B_u, "pallas")
+    got_u = expand_unit_hess(jax.jit(
+        build_histogram, static_argnums=(5, 6, 7),
+        static_argnames=("unit_hess",))(
+        binned_u, rel_u, g_u, ones_u, w_u, n_u, B_u, "pallas",
+        unit_hess=True))
+    err_u = float(jnp.max(jnp.abs(got_u - want_u)) /
+                  (jnp.max(jnp.abs(want_u)) + 1e-30))
+    checks.append({"check": "unit_hess_kernel", "ok": err_u < 1e-5,
+                   "rel_err": err_u})
 
     # 3. fused boost scans compile + run (binomial and multinomial)
     import h2o_kubernetes_tpu as h2o
