@@ -515,12 +515,25 @@ def _import_avro(files: list[str], skipped: set[str]) -> Frame:
                               for v in vals], dtype=np.int32)
             vecs[name] = Vec.from_numpy(codes, name, domain=dom)
         else:                                  # str/bytes -> interned enum
-            toks = ["" if v is None else
-                    (v.decode("utf-8", errors="replace")
-                     if isinstance(v, bytes) else str(v))
-                    for v in vals]
-            nas = {""} if any(v is None for v in vals) else set()
-            vecs[name] = _materialize(toks, "enum", name, nas)
+            # intern directly: None must become NA without hijacking a
+            # genuine empty-string level (union [null, string] columns
+            # routinely carry both)
+            lut: dict[str, int] = {}
+            codes = np.empty(len(vals), dtype=np.int32)
+            for i, v in enumerate(vals):
+                if v is None:
+                    codes[i] = NA_ENUM
+                    continue
+                tok = (v.decode("utf-8", errors="replace")
+                       if isinstance(v, bytes) else str(v))
+                codes[i] = lut.setdefault(tok, len(lut))
+            dom = sorted(lut)
+            order = {tok: i for i, tok in enumerate(dom)}
+            remap = np.empty(len(lut) + 1, dtype=np.int32)
+            remap[-1] = NA_ENUM
+            for tok, old in lut.items():
+                remap[old] = order[tok]
+            vecs[name] = Vec.from_numpy(remap[codes], name, domain=dom)
     return Frame(vecs)
 
 
@@ -532,36 +545,53 @@ def _avro_enum_symbols(ftype) -> list[str]:
 
 # -- SVMLight (water/parser/SVMLightParser analog [U3]) ----------------------
 
+def _svmlight_line_ok(s: str) -> int:
+    """-1 if the line does not conform; else its idx:val pair count."""
+    toks = s.split()
+    if len(toks) < 2 or _try_float(toks[0]) is None:
+        return -1
+    pairs = toks[1:]
+    if pairs and pairs[0].startswith("qid:"):
+        pairs = pairs[1:]
+    if not pairs:
+        return -1
+    last = 0
+    for p in pairs:
+        idx, _, val = p.partition(":")
+        if not idx.isdigit() or _try_float(val) is None:
+            return -1
+        if int(idx) <= last:
+            return -1
+        last = int(idx)
+    return len(pairs)
+
+
 def _looks_svmlight(path: str) -> bool:
-    """Content sniff: first non-comment line is `label [qid:q] i:v ...`
-    with at least one index:value pair and strictly increasing indices
-    (the reference's SVMLight guess requires ordered indices too)."""
+    """Content sniff for EXTENSIONLESS files: every previewed
+    non-comment line must be `label [qid:q] i:v ...` with strictly
+    increasing indices, AND at least one line must carry >= 2 pairs.
+    The second condition keeps generic space-separated data whose rows
+    happen to look like `3 08:30` (count + clock time) out of the
+    svmlight parser — a real one-pair-per-row svmlight file is still
+    importable via its .svm/.svmlight extension."""
     try:
         with _open_text(path) as f:
+            seen = 0
+            max_pairs = 0
             for ln in f:
                 s = ln.split("#", 1)[0].strip()
                 if not s:
                     continue
-                toks = s.split()
-                if len(toks) < 2 or _try_float(toks[0]) is None:
+                n = _svmlight_line_ok(s)
+                if n < 0:
                     return False
-                pairs = toks[1:]
-                if pairs and pairs[0].startswith("qid:"):
-                    pairs = pairs[1:]
-                if not pairs:
-                    return False
-                last = 0
-                for p in pairs:
-                    idx, _, val = p.partition(":")
-                    if not idx.isdigit() or _try_float(val) is None:
-                        return False
-                    if int(idx) <= last:
-                        return False
-                    last = int(idx)
-                return True
+                max_pairs = max(max_pairs, n)
+                seen += 1
+                if seen >= 32:
+                    break
+            return seen > 0 and max_pairs >= 2
     except OSError:
         return False
-    return False
 
 
 def _import_svmlight(files: list[str], skipped: set[str]) -> Frame:

@@ -81,6 +81,16 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
                 f"offset column '{offset_column}' must be numeric")
         ignored.add(offset_column)
     names = _feature_names(frame, x, ignored)
+    # an EXPLICIT x list bypasses the ignored set by design (the user
+    # named those columns) — but the special columns must never be
+    # features: y leaks the label, and a weights/offset column used as
+    # both feature and fixed term double-counts silently
+    special = {y} | {c for c in (weights_column, offset_column) if c}
+    clash = special.intersection(names)
+    if clash:
+        raise ValueError(
+            f"column(s) {sorted(clash)} are the response/weights/offset "
+            "and cannot also be features (remove them from x)")
     yv = frame.vec(y)
     nclasses, domain = 1, None
     if yv.is_enum():

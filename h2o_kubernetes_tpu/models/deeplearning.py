@@ -121,7 +121,8 @@ class DeepLearningModel(Model):
         self.net = net_params
         self.loss_kind = loss_kind
 
-    def _score_matrix(self, X: jax.Array) -> jax.Array:
+    def _score_matrix(self, X: jax.Array,
+                      offset: jax.Array | None = None) -> jax.Array:
         Xe = self.dinfo.expand(X)[:, :-1]     # drop intercept col (bias
         act = _act(self.params.activation)    # lives in the layers)
         out = _forward(self.net, Xe, act)
@@ -129,6 +130,10 @@ class DeepLearningModel(Model):
             return jax.nn.softmax(out, axis=1)
         if self.params.autoencoder:
             return out
+        if offset is not None:
+            # regression offset: the net was fit to y - offset (MSE is
+            # shift-equivariant), so predictions add it back
+            return out[:, 0] + offset
         return out[:, 0]
 
     def predict(self, frame: Frame) -> Frame:
@@ -181,11 +186,15 @@ class DeepLearning:
               x: Sequence[str] | None = None,
               ignored_columns: Sequence[str] | None = None,
               weights_column: str | None = None,
-              validation_frame: Frame | None = None) -> DeepLearningModel:
+              validation_frame: Frame | None = None,
+              offset_column: str | None = None) -> DeepLearningModel:
         p = self.params
         if p.autoencoder and self.cv_args.enabled:
             raise ValueError(
                 "cross-validation is not supported for autoencoders")
+        if offset_column and p.autoencoder:
+            raise ValueError(
+                "offset_column is not supported for autoencoders")
         if self.cv_args.fold_column:
             ignored_columns = list(ignored_columns or []) + \
                 [self.cv_args.fold_column]
@@ -204,7 +213,13 @@ class DeepLearning:
                               weights_column, "gaussian")
         else:
             data = resolve_xy(training_frame, y, x, ignored_columns,
-                              weights_column, p.distribution)
+                              weights_column, p.distribution,
+                              offset_column)
+        if offset_column and data.nclasses >= 2:
+            # a shared per-row offset on every softmax logit is
+            # invariant — only the regression (mse) head can honor it
+            raise ValueError("offset_column is only supported for "
+                             "regression DeepLearning")
 
         if p.checkpoint is not None:
             ck = p.checkpoint
@@ -216,6 +231,15 @@ class DeepLearning:
                 raise ValueError(
                     "checkpoint model was trained on different features/"
                     "domains")
+            if (getattr(ck, "offset_column", None) or None) != \
+                    (offset_column or None):
+                # continuing a no-offset net against y - off (or vice
+                # versa) silently shifts every prediction (same gate as
+                # GBM's checkpoint offset check)
+                raise ValueError(
+                    "checkpoint offset_column mismatch: "
+                    f"{getattr(ck, 'offset_column', None)!r} vs "
+                    f"{offset_column!r}")
             # reuse the checkpoint's standardization stats: recomputing
             # them on the continuation frame would silently rescale every
             # input the restored weights were fit to
@@ -277,6 +301,10 @@ class DeepLearning:
         act = _act(p.activation)
         hid_drop = p.hidden_dropout_ratios
         y_dev = Xe if p.autoencoder else data.y     # AE reconstructs input
+        if data.offset is not None and not p.autoencoder:
+            # fit the net to y - offset: exactly equivalent for the
+            # shift-equivariant mse loss; scoring adds the offset back
+            y_dev = y_dev - data.offset
 
         grad_fn = jax.grad(_loss_fn)
 
@@ -322,6 +350,7 @@ class DeepLearning:
             net, opt_state = train_iter(net, opt_state, ki)
 
         model = DeepLearningModel(data, p, dinfo, net, loss_kind)
+        model.offset_column = offset_column
         if p.autoencoder:
             model.nclasses = 1
             model.cv = None
@@ -336,5 +365,6 @@ class DeepLearning:
         return finalize_train(
             self, model, y, training_frame,
             {"x": x, "ignored_columns": ignored_columns,
-             "weights_column": weights_column},
+             "weights_column": weights_column,
+             "offset_column": offset_column},
             validation_frame)

@@ -280,7 +280,7 @@ class MojoModel:
         if self.algo == "kmeans":
             return self._predict_kmeans(X)
         if self.algo == "deeplearning":
-            return self._predict_deeplearning(X)
+            return self._predict_deeplearning(X, off)
         if self.algo == "naivebayes":
             return self._predict_naivebayes(X)
         if self.algo == "pca":
@@ -594,7 +594,7 @@ class MojoModel:
             return np.stack([1 - mu, mu], axis=1)
         return mu
 
-    def _predict_deeplearning(self, X):
+    def _predict_deeplearning(self, X, off=None):
         m = self.meta
         h = self._expand(X)[:, :-1]          # bias lives in the layers
         act = np.tanh if m["activation"] == "tanh" else \
@@ -609,6 +609,8 @@ class MojoModel:
             return z / z.sum(axis=1, keepdims=True)
         if m["autoencoder"]:
             return out
+        if off is not None:     # regression net was fit to y - offset
+            return out[:, 0] + off
         return out[:, 0]
 
     def _predict_naivebayes(self, X):

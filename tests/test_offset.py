@@ -269,6 +269,47 @@ def test_offset_na_propagates_and_partial_plot(mesh8):
     np.testing.assert_allclose(m1 - 1.0, m2, atol=1e-4)
 
 
+def test_dl_regression_offset(mesh8, tmp_path):
+    """DL regression with offset: the net fits y - offset (exact for
+    the shift-equivariant mse loss) and scoring adds it back; the
+    softmax/autoencoder heads refuse it."""
+    from h2o_kubernetes_tpu.models import DeepLearning
+    from h2o_kubernetes_tpu.mojo import export_mojo, import_mojo
+
+    rng = np.random.default_rng(12)
+    n = 1200
+    x = rng.normal(size=n)
+    off = rng.normal(scale=2.0, size=n)     # big offsets: must matter
+    y = np.sin(2 * x) + off + rng.normal(scale=0.1, size=n)
+    fr = Frame.from_arrays({"x": x, "off": off, "y": y})
+    # modest epochs + large train_samples_per_iteration: few collective
+    # dispatches (every extra averaging round is another chance for the
+    # known XLA:CPU rendezvous stall on a loaded 1-core box)
+    kw = dict(hidden=(16,), epochs=8, mini_batch_size=64,
+              train_samples_per_iteration=4 * n, seed=0)
+    m = DeepLearning(**kw).train(
+        y="y", training_frame=fr, offset_column="off")
+    pred = m.predict_raw(fr)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    m0 = DeepLearning(**kw).train(
+        y="y", training_frame=fr, ignored_columns=["off"])
+    rmse0 = float(np.sqrt(np.mean((m0.predict_raw(fr) - y) ** 2)))
+    # the offset carries sd=2.0 of the response; a net that can't see
+    # it is stuck near that floor while the offset model fits the rest
+    assert rmse < rmse0 * 0.7, (rmse, rmse0)
+    # the mojo round-trips the offset too
+    p = str(tmp_path / "dl.mojo")
+    export_mojo(m, p)
+    got = import_mojo(p).predict({"x": x, "off": off})
+    np.testing.assert_allclose(got, pred, atol=1e-4)
+
+    yb = np.array(["a", "b"])[(x > 0).astype(int)]
+    frb = Frame.from_arrays({"x": x, "off": off, "y": yb})
+    with pytest.raises(ValueError, match="regression"):
+        DeepLearning(hidden=(8,), epochs=1).train(
+            y="y", training_frame=frb, offset_column="off")
+
+
 def test_glm_offset_with_cv(mesh8):
     # the offset must ride through fold training and holdout scoring
     rng = np.random.default_rng(9)

@@ -65,10 +65,10 @@ def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
     from h2o_kubernetes_tpu.automl import AutoML
 
     counter = _CompileCounter()
-    logger = logging.getLogger("jax._src.interpreters.pxla")
+    # ONLY the root 'jax' logger: records from jax submodules propagate
+    # up the hierarchy, so attaching to a child too would double-count
     jax.config.update("jax_log_compiles", True)
     logging.getLogger("jax").addHandler(counter)
-    logger.addHandler(counter)
     try:
         fr = make_table(rows)
         t0 = time.perf_counter()
@@ -80,7 +80,6 @@ def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
     finally:
         jax.config.update("jax_log_compiles", False)
         logging.getLogger("jax").removeHandler(counter)
-        logger.removeHandler(counter)
     out = {
         "rows": rows,
         "max_models": max_models,
