@@ -527,13 +527,7 @@ def _import_avro(files: list[str], skipped: set[str]) -> Frame:
                 tok = (v.decode("utf-8", errors="replace")
                        if isinstance(v, bytes) else str(v))
                 codes[i] = lut.setdefault(tok, len(lut))
-            dom = sorted(lut)
-            order = {tok: i for i, tok in enumerate(dom)}
-            remap = np.empty(len(lut) + 1, dtype=np.int32)
-            remap[-1] = NA_ENUM
-            for tok, old in lut.items():
-                remap[old] = order[tok]
-            vecs[name] = Vec.from_numpy(remap[codes], name, domain=dom)
+            vecs[name] = _lut_to_vec(codes, lut, name)
     return Frame(vecs)
 
 
@@ -988,6 +982,13 @@ def _materialize(vals: list[str], typ: str, name: str,
             codes[i] = NA_ENUM
         else:
             codes[i] = lut.setdefault(tok, len(lut))
+    return _lut_to_vec(codes, lut, name)
+
+
+def _lut_to_vec(codes: np.ndarray, lut: dict[str, int], name: str) -> Vec:
+    """First-seen intern codes (-1 = NA) → Vec with a SORTED domain —
+    the one remap implementation shared by the CSV/ARFF and Avro
+    interning paths."""
     domain = sorted(lut)
     order = {tok: i for i, tok in enumerate(domain)}
     remap = np.empty(len(lut) + 1, dtype=np.int32)

@@ -138,7 +138,7 @@ class Aggregator:
         dinfo = build_datainfo(data, training_frame,
                                standardize=p.transform == "STANDARDIZE",
                                drop_first=False)
-        Xe = np.asarray(jax.jit(dinfo.expand)(data.X))[
+        Xe = np.asarray(dinfo.expand(data.X))[
             : training_frame.nrows, :-1]
         n, F = Xe.shape
         target = min(p.target_num_exemplars, n)

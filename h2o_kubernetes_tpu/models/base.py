@@ -47,6 +47,16 @@ def _feature_names(frame: Frame, x: Sequence[str] | None,
                    ignored: set[str]) -> list[str]:
     """Resolve + validate feature columns (shared by resolve_xy/resolve_x)."""
     names = list(x) if x else [n for n in frame.names if n not in ignored]
+    if x:
+        # an explicit x must not smuggle back a column the caller set
+        # aside: the response leaks the label, a weights/offset column
+        # double-counts, a fold column encodes holdout membership
+        clash = ignored.intersection(names)
+        if clash:
+            raise ValueError(
+                f"column(s) {sorted(clash)} are the response/weights/"
+                "offset/fold or ignored_columns and cannot also be "
+                "features (remove them from x)")
     for n in names:
         if n not in frame:
             raise ValueError(f"feature column '{n}' not in frame")
@@ -81,16 +91,6 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
                 f"offset column '{offset_column}' must be numeric")
         ignored.add(offset_column)
     names = _feature_names(frame, x, ignored)
-    # an EXPLICIT x list bypasses the ignored set by design (the user
-    # named those columns) — but the special columns must never be
-    # features: y leaks the label, and a weights/offset column used as
-    # both feature and fixed term double-counts silently
-    special = {y} | {c for c in (weights_column, offset_column) if c}
-    clash = special.intersection(names)
-    if clash:
-        raise ValueError(
-            f"column(s) {sorted(clash)} are the response/weights/offset "
-            "and cannot also be features (remove them from x)")
     yv = frame.vec(y)
     nclasses, domain = 1, None
     if yv.is_enum():

@@ -188,7 +188,7 @@ class CoxPH:
         Xraw = np.asarray(data.X)[:n]
         ok = ~(np.isnan(t) | np.isnan(e) | np.isnan(Xraw).any(axis=1))
         t, e = t[ok], e[ok]
-        Xe = np.asarray(jax.jit(dinfo.expand)(
+        Xe = np.asarray(dinfo.expand(
             jnp.asarray(Xraw[ok])))[:, :-1].astype(np.float64)
         X = Xe
         coef_names = dinfo.coef_names[:-1]

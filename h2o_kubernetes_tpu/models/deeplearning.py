@@ -247,7 +247,7 @@ class DeepLearning:
         else:
             dinfo = build_datainfo(data, training_frame, p.standardize,
                                    drop_first=False)
-        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]   # bias is in layers
+        Xe = dinfo.expand(data.X)[:, :-1]   # bias is in layers
         Pn = Xe.shape[1]
         K = data.nclasses
         if p.autoencoder:

@@ -589,7 +589,7 @@ class GLM:
                              "response")
         dinfo = build_datainfo(data, training_frame, p.standardize,
                                drop_first=not p.use_all_factor_levels)
-        Xe = jax.jit(dinfo.expand)(data.X)
+        Xe = dinfo.expand(data.X)
         Pn = dinfo.n_expanded
         n_obs = float(jnp.sum(data.w))
 

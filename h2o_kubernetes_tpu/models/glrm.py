@@ -190,7 +190,7 @@ class GLRM:
         if not demean:
             dinfo.means = np.zeros_like(dinfo.means)
         mesh = global_mesh()
-        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]     # drop intercept
+        Xe = dinfo.expand(data.X)[:, :-1]     # drop intercept
         n = training_frame.nrows
         # the loss mask comes from the RAW matrix: expand() mean-imputes
         # NaN, but GLRM's whole point is that missing cells drop out of

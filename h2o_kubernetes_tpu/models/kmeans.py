@@ -168,7 +168,7 @@ class KMeans:
         data = resolve_x(training_frame, x, ignored)
         dinfo = build_datainfo(data, training_frame, p.standardize,
                                drop_first=False)
-        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]   # no intercept col
+        Xe = dinfo.expand(data.X)[:, :-1]   # no intercept col
         rng = np.random.default_rng(p.seed)
 
         Xe_np = np.asarray(Xe)

@@ -115,7 +115,7 @@ class PCA:
                                drop_first=not p.use_all_factor_levels)
         if not demean:
             dinfo.means = np.zeros_like(dinfo.means)
-        Xe = jax.jit(dinfo.expand)(data.X)[:, :-1]
+        Xe = dinfo.expand(data.X)[:, :-1]
         F = Xe.shape[1]
         if p.k > F:
             raise ValueError(f"k={p.k} > {F} expanded features")

@@ -310,6 +310,25 @@ def test_dl_regression_offset(mesh8, tmp_path):
             y="y", training_frame=frb, offset_column="off")
 
 
+def test_special_columns_cannot_also_be_features(mesh8):
+    rng = np.random.default_rng(0)
+    n = 200
+    fr = Frame.from_arrays({"x": rng.normal(size=n),
+                            "off": rng.normal(size=n),
+                            "fold": rng.integers(0, 3, size=n).astype(
+                                np.float32),
+                            "y": rng.normal(size=n)})
+    with pytest.raises(ValueError, match="cannot also be features"):
+        GBM(ntrees=2).train(y="y", training_frame=fr,
+                            x=["x", "off"], offset_column="off")
+    with pytest.raises(ValueError, match="cannot also be features"):
+        GBM(ntrees=2).train(y="y", training_frame=fr, x=["x", "y"])
+    # the CV fold column is set aside the same way
+    with pytest.raises(ValueError, match="cannot also be features"):
+        GBM(ntrees=2, nfolds=0, fold_column="fold").train(
+            y="y", training_frame=fr, x=["x", "fold"])
+
+
 def test_glm_offset_with_cv(mesh8):
     # the offset must ride through fold training and holdout scoring
     rng = np.random.default_rng(9)
