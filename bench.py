@@ -98,7 +98,9 @@ def main() -> None:
         base = entry["value"]
     if on_tpu:
         store["last_tpu"] = {"value": rows_per_sec_per_chip,
-                             "rows": rows, "trees": ntrees}
+                             "rows": rows, "trees": ntrees,
+                             "recorded": time.strftime(
+                                 "%Y-%m-%dT%H:%M:%S")}
     with open(base_path, "w") as f:
         json.dump(store, f, indent=1)
 

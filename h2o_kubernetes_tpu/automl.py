@@ -233,10 +233,18 @@ class AutoML:
         self.leaderboard: Leaderboard | None = None
         self.job: Job | None = None
         self._models_by_family: dict[str, list] = {}
+        # reference parity: H2O AutoML keeps an event_log frame
+        # (ai/h2o/automl/EventLog [U3]); here a list of
+        # (timestamp, message) — every step outcome INCLUDING swallowed
+        # per-model failures lands here, so a 1-model leaderboard is
+        # always explainable after the fact
+        self.event_log: list[tuple[str, str]] = []
 
     # -- internals ----------------------------------------------------------
 
     def _log(self, msg: str):
+        self.event_log.append(
+            (time.strftime("%Y-%m-%dT%H:%M:%S"), msg))
         if self.verbosity:
             print(f"[AutoML {self.project_name}] {msg}")
 
@@ -334,7 +342,7 @@ class AutoML:
                 self.job.failed(repr(e))
                 raise
             except Exception as e:       # a failed step never kills the run
-                self._log(f"{name} failed: {e}")
+                self._log(f"{name} failed: {e!r}")
             n_done += 1
             self.job.update(min(0.8, n_done / max(budget or 20, 1)))
 
@@ -354,7 +362,7 @@ class AutoML:
                 self.job.failed(repr(e))
                 raise
             except Exception as e:
-                self._log(f"grid {fam} failed: {e}")
+                self._log(f"grid {fam} failed: {e!r}")
             n_done += 1
             self.job.update(min(0.9, n_done / max(budget or 20, 1)))
 
@@ -473,7 +481,7 @@ class AutoML:
                 self._log(f"StackedEnsemble_{tag}: "
                           f"{metric}={metrics.get(metric, float('nan')):.5f}")
             except Exception as e:
-                self._log(f"StackedEnsemble_{tag} failed: {e}")
+                self._log(f"StackedEnsemble_{tag} failed: {e!r}")
 
     # -- results ------------------------------------------------------------
 

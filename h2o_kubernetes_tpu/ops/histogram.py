@@ -29,6 +29,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.custom_batching import custom_vmap
 from jax.experimental import pallas as pl
 
 # Precision: a plain bf16 multiply loses ~0.4% on the gradient sums, so
@@ -36,8 +37,23 @@ from jax.experimental import pallas as pl
 # terms of the values against the exactly-representable 0/1 one-hot —
 # the same arithmetic HIGHEST would emulate, minus the wasted passes on
 # the one-hot operand (it is already bf16-exact).
-ROW_TILE = 1024  # 1-D s32 operands carry XLA layout T(1024): the row
-#                  block must match it or Mosaic rejects the layouts
+ROW_TILE = 1024     # bin-blocked kernel's row tile (its [T, nbt] one-hot
+#                     is VMEM-bounded: 4 MB bf16 at T=1024, nbt=2048)
+
+
+def _fact_row_tile(n_hi: int, rows: int) -> int:
+    """Row tile for the factorized kernel. Wider tiles amortize
+    per-grid-step overhead (the bench shape runs ~250 steps/level at
+    4096 instead of ~1000), but the [3·C·n_hi, T] A operand scales with
+    T — stay at 1024 when n_hi is large (VMEM ~16 MB/core) or the rows
+    wouldn't fill a wide tile anyway."""
+    return 4096 if n_hi <= 64 and rows >= 8192 else 1024
+
+
+# out-block VMEM budget for the fused-feature kernel: features are
+# processed in groups of `fg` per grid step so [fg, C·n_hi, 128] f32
+# stays resident; past this budget F is split into 8-aligned groups
+_OUT_BUDGET = 3 << 20
 
 
 def _hist_segment(binned, rel, vals, n_nodes: int, n_bins: int):
@@ -57,15 +73,18 @@ def _hist_segment(binned, rel, vals, n_nodes: int, n_bins: int):
 
 def _bin_block(n_nodes: int, n_bins: int) -> int:
     """Bin-block width: B times the largest power-of-2 node group that
-    keeps the one-hot tile around ~2k lanes (VMEM-bounded)."""
+    keeps the one-hot tile around ~2k lanes (VMEM-bounded). The group
+    must divide n_nodes so the grid tiles evenly — n_nodes is 2^d for
+    plain trees but K·2^d under the flattened class batching."""
     k = 1
-    while k * 2 <= n_nodes and (k * 2) * n_bins <= 2048:
+    while k * 2 <= n_nodes and (k * 2) * n_bins <= 2048 \
+            and n_nodes % (k * 2) == 0:
         k *= 2
     return k * n_bins
 
 
 def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
-                      n_hi, n_ch):
+                      n_hi, n_ch, fg):
     """Factorized one-hot histogram matmul (the fast path).
 
     seg = rel·B + bin is split as seg = hi·128 + lo.  The LHS packs the
@@ -78,91 +97,135 @@ def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
     split into three bf16 terms (hi/mid/lo mantissa) so the f32 products
     match the segment path to ~2^-24; B is 0/1 and thus exact in bf16.
     """
-    rt = pl.program_id(1)
+    # grid (feature_groups, n_copies, row_blocks): one step covers a
+    # whole FEATURE GROUP of fg features for its row block — the
+    # row-stream operands (rel, vals, mantissa split) load and compute
+    # ONCE per row block instead of once per (feature, row block), and
+    # the grid shrinks F× (per-step sequencing overhead, not FLOPs, was
+    # the round-2/3 bench bottleneck).
+    first = (pl.program_id(1) == 0) & (pl.program_id(2) == 0)
 
-    @pl.when(rt == 0)
+    @pl.when(first)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bins = binned_ref[:]                             # [T]
     rel = rel_ref[:]                                 # [T]
-    seg = rel * n_bins + bins
-    hi = lax.shift_right_arithmetic(seg, 7)          # floor(seg/128)
-    lo = seg - hi * 128                              # seg mod 128, >= 0
-    T = bins.shape[0]
-    # hi one-hot, transposed: [n_hi, T].  Dead rows (rel=-1) have hi < 0
-    # and match no slot; their vals are zeroed upstream anyway.
-    iota_hi = lax.broadcasted_iota(jnp.int32, (n_hi, T), 0)
-    oh_hi = (iota_hi == hi[None, :]).astype(jnp.bfloat16)
+    rel_base = rel * n_bins
+    T = rel.shape[0]
     vals_t = vals_ref[:].T                           # [n_ch, T]
-    iota_lo = lax.broadcasted_iota(jnp.int32, (T, 128), 1)
-    B = (iota_lo == lo[:, None]).astype(jnp.bfloat16)
-
     # f32-precision via 3 bf16 mantissa terms, split on the TINY
     # [n_ch, T] values and masked by the 0/1 one-hot IN bf16 —
     # bit-identical to splitting the big masked A (0/1 masking commutes
     # with rounding) but skips materializing a [n_ch*n_hi, T] f32 A
     # plus two subtract passes over it: the A-build drops from ~6
-    # f32-width VPU passes to 3 bf16-width multiplies (round-4
-    # VPU-bound remainder attack, PROFILE.md "what's next").
+    # f32-width VPU passes to 3 bf16-width multiplies.
     v1 = vals_t.astype(jnp.bfloat16)
     r1 = vals_t - v1.astype(jnp.float32)
     v2 = r1.astype(jnp.bfloat16)
     v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
+    V = jnp.concatenate([v1, v2, v3], axis=0)        # [3·n_ch, T] bf16
+    iota_hi = lax.broadcasted_iota(jnp.int32, (n_hi, T), 0)
+    iota_lo = lax.broadcasted_iota(jnp.int32, (T, 128), 1)
     dn = (((1,), (0,)), ((), ()))
 
-    def dg(vk):                                      # [n_ch,T] bf16 term
+    for j in range(fg):                              # static unroll
+        bins = binned_ref[0, j, :]                   # [T]
+        seg = rel_base + bins
+        hi = lax.shift_right_arithmetic(seg, 7)      # floor(seg/128)
+        lo = seg - hi * 128                          # seg mod 128, >= 0
+        # hi one-hot, transposed [n_hi, T]. Dead rows (rel=-1) have
+        # hi < 0 and match no slot; their vals are zeroed upstream.
+        oh_hi = (iota_hi == hi[None, :]).astype(jnp.bfloat16)
+        B = (iota_lo == lo[:, None]).astype(jnp.bfloat16)
+        # ONE matmul with all 3 mantissa terms stacked into M — the
+        # MXU's row occupancy triples (3·n_ch·n_hi rows instead of 3
+        # passes of n_ch·n_hi); the per-term partial sums recombine
+        # with one cheap VPU add over [n_ch·n_hi, 128]. Same bf16
+        # products, same f32 accumulation.
         a = jnp.concatenate(
-            [oh_hi * vk[c][None, :] for c in range(n_ch)],
-            axis=0)                                  # [n_ch*n_hi, T]
-        return lax.dot_general(a, B, dimension_numbers=dn,
-                               preferred_element_type=jnp.float32)
+            [oh_hi * V[k][None, :] for k in range(3 * n_ch)],
+            axis=0)                                  # [3·n_ch·n_hi, T]
+        acc = lax.dot_general(a, B, dimension_numbers=dn,
+                              preferred_element_type=jnp.float32)
+        acc = acc.reshape(3, n_ch * n_hi, 128)
+        out_ref[0, j] += acc[0] + acc[1] + acc[2]    # [n_ch·n_hi, 128]
 
-    out_ref[0] += dg(v1) + dg(v2) + dg(v3)           # [n_ch*n_hi, 128]
 
-
-# VMEM cap for the factorized kernel's working set: A f32 [3*n_hi, T]
-# plus its three bf16 split terms and the hi one-hot is ~22 B per A
-# element — n_hi=256 is ~9 MB, safely inside v5e VMEM alongside the
-# [3*n_hi, 128] accumulator. Deeper trees (n_nodes*n_bins > 2^15) take
-# the bin-blocked kernel below.
+# VMEM cap for the factorized kernel's working set. With the stacked-
+# term matmul the peak is the bf16 A [3·n_ch·n_hi, T] (4.7 MB at
+# n_hi=256, C=3, T=1024 — _fact_row_tile drops to 1024 past n_hi=64)
+# plus the [n_hi, T] hi one-hot, the [T, 128] lo one-hot, the f32
+# [3·n_ch·n_hi, 128] dot result (1.2 MB) and the resident out block
+# (_OUT_BUDGET) — ~10 MB worst case against ~16 MB/core VMEM. TIGHT:
+# the on-chip kernel gate compiles exactly this cap shape as
+# `fact_kernel_cap`; if it fails there, lower this cap. Deeper trees
+# (n_nodes·n_bins > 2^15) take the bin-blocked kernel below.
 _FACT_MAX_NHI = 256
 
 
-def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int):
+def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
+                      binned_tile: int = 1, row_tile: int | None = None):
+    """``binned_tile`` > 1: rel/vals carry ``binned_tile`` consecutive
+    copies of the row range (the flattened class batch) while binned is
+    stored ONCE — the grid index map re-reads the same bin blocks per
+    copy instead of materializing K copies in HBM. Such callers must
+    pre-align each copy's rows and pass the ``row_tile`` they aligned
+    to (one decision, not two that must agree)."""
     r, F = binned.shape
     C = vals.shape[1]
     nB = n_nodes * n_bins
     n_hi = -(-nB // 128)                             # ceil
-    pad = (-r) % ROW_TILE
+    rt_size = row_tile or _fact_row_tile(n_hi, r)
+    pad = (-r) % rt_size
     if pad:
+        assert binned_tile == 1     # tiled callers pre-align rows
         binned = jnp.pad(binned, ((0, pad), (0, 0)))
         rel = jnp.pad(rel, (0, pad), constant_values=-1)
         vals = jnp.pad(vals, ((0, pad), (0, 0)))
     rp = r + pad
-    binned_flat = binned.T.astype(jnp.int32).reshape(F * rp)
+    rbb = rp // rt_size                 # row blocks per binned copy
+    # feature grouping: each grid step holds [fg, C·n_hi, 128] f32 of
+    # output resident; wide tables split into 8-aligned groups (padded
+    # feature columns histogram into junk rows that are sliced away).
+    # fg is also capped at 64 outright — the kernel statically unrolls
+    # fg matmuls per grid step, and the row-stream-reuse win saturates
+    # long before the Mosaic program size blows up
+    per_f = C * n_hi * 128 * 4
+    fg_cap = min(F, 64, max(1, _OUT_BUDGET // per_f))
+    if fg_cap >= F:
+        fg, F_pad = F, F
+    else:
+        fg = max(8, fg_cap // 8 * 8)
+        F_pad = -(-F // fg) * fg
+        binned = jnp.pad(binned, ((0, 0), (0, F_pad - F)))
+    n_fg = F_pad // fg
+    # [rp, F_pad] -> [row_block, F_pad, rt]: a (1, fg, rt) block is a
+    # row block's bins for one feature group
+    binned3 = binned.astype(jnp.int32).T.reshape(
+        F_pad, rbb, rt_size).transpose(1, 0, 2)
     rel32 = rel.astype(jnp.int32)
-    rblocks = rp // ROW_TILE
-
-    grid = (F, rblocks)
     vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
+    grid = (n_fg, binned_tile, rbb)
     out = pl.pallas_call(
         functools.partial(_hist_fact_kernel, n_bins=n_bins, n_hi=n_hi,
-                          n_ch=C),
-        out_shape=jax.ShapeDtypeStruct((F, C * n_hi, 128), jnp.float32,
-                                       vma=vma),
+                          n_ch=C, fg=fg),
+        out_shape=jax.ShapeDtypeStruct((n_fg, fg, C * n_hi, 128),
+                                       jnp.float32, vma=vma),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((ROW_TILE,),
-                         lambda f, rt, rb=rblocks: (f * rb + rt,)),
-            pl.BlockSpec((ROW_TILE,), lambda f, rt: (rt,)),
-            pl.BlockSpec((ROW_TILE, C), lambda f, rt: (rt, 0)),
+            pl.BlockSpec((1, fg, rt_size),
+                         lambda g, k, rt: (rt, g, 0)),
+            pl.BlockSpec((rt_size,),
+                         lambda g, k, rt, rb=rbb: (k * rb + rt,)),
+            pl.BlockSpec((rt_size, C),
+                         lambda g, k, rt, rb=rbb: (k * rb + rt, 0)),
         ],
-        out_specs=pl.BlockSpec((1, C * n_hi, 128), lambda f, rt: (f, 0, 0)),
+        out_specs=pl.BlockSpec((1, fg, C * n_hi, 128),
+                               lambda g, k, rt: (g, 0, 0, 0)),
         interpret=jax.default_backend() != "tpu",
-    )(binned_flat, rel32, vals)
-    # [F, C*n_hi, 128] -> [F, C, n_hi*128] -> [n, F, B, C]
-    out = out.reshape(F, C, n_hi * 128)[:, :, :nB]
+    )(binned3, rel32, vals)
+    # [n_fg, fg, C·n_hi, 128] -> [F, C, n_hi·128] -> [n, F, B, C]
+    out = out.reshape(F_pad, C, n_hi * 128)[:F, :, :nB]
     return out.reshape(F, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
 
 
@@ -194,19 +257,31 @@ def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
     v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
     dn = (((1,), (0,)), ((), ()))
 
-    def dg(vk):
-        return lax.dot_general(vk, onehot, dimension_numbers=dn,
-                               preferred_element_type=jnp.float32)
+    # single matmul with the 3 mantissa terms stacked into M (3·C rows,
+    # one pass) instead of 3 separate C-row passes; the per-term sums
+    # recombine with one VPU add — same products, same f32 accumulate
+    C = vals_t.shape[0]
+    V = jnp.concatenate([v1, v2, v3], axis=0)        # [3·C, T] bf16
+    acc = lax.dot_general(V, onehot, dimension_numbers=dn,
+                          preferred_element_type=jnp.float32)
+    acc = acc.reshape(3, C, nbt)
+    out_ref[0] += acc[0] + acc[1] + acc[2]           # [C, NBT] on the MXU
 
-    out_ref[0] += dg(v1) + dg(v2) + dg(v3)           # [C, NBT] on the MXU
 
-
-def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
+def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int,
+                 binned_tile: int = 1, row_tile: int | None = None):
     r, F = binned.shape
     C = vals.shape[1]
     nB = n_nodes * n_bins
     if -(-nB // 128) <= _FACT_MAX_NHI:
-        return _hist_pallas_fact(binned, rel, vals, n_nodes, n_bins)
+        return _hist_pallas_fact(binned, rel, vals, n_nodes, n_bins,
+                                 binned_tile, row_tile)
+    if binned_tile > 1:
+        # deep-tree (blocked-kernel) shapes are rare for the flattened
+        # class batch — materialize the bin copies rather than widen
+        # the blocked kernel's grid to 4-D
+        binned = jnp.tile(binned, (binned_tile, 1))
+        r = binned.shape[0]
     nbt = _bin_block(n_nodes, n_bins)
     if nbt % 128 and nbt != nB:
         # un-tileable bin block (non-power-of-2 n_bins hitting the lane
@@ -245,6 +320,83 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int):
     )(binned_flat, rel32, vals)
     # [F, C, n*B] -> [n, F, B, C]
     return out.reshape(F, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
+
+
+def _hist_call(binned, rel, vals, n_nodes: int, n_bins: int, impl: str):
+    fn = _hist_pallas if impl == "pallas" else _hist_segment
+    return fn(binned, rel, vals, n_nodes, n_bins)
+
+
+def _hist_vmappable(binned, rel, vals, n_nodes: int, n_bins: int,
+                    impl: str):
+    """Histogram build with a class-batching rule that never vmaps the
+    Pallas kernel.
+
+    ``jax.vmap`` of a pallas_call prepends a squeezed batch dim to
+    every block spec, and Mosaic rejects that for the rank-1 row-stream
+    operands (block (1, T) over a [K, rows] array fails the (8, 128)
+    divisibility rule) — the round-4 on-chip kernel gate caught exactly
+    this in the fused multinomial boost scan, which grows its K class
+    trees under vmap. Instead of batching the kernel, the batch is
+    LOWERED AWAY: class k's rows are relabeled to nodes
+    [k·n_nodes, (k+1)·n_nodes) and the SAME flat kernel runs once over
+    the concatenated row stream. Identical sums, and the MXU M
+    dimension (channels × hi-slots) gets K× fuller than K separate
+    passes would — batching IMPROVES systolic occupancy here.
+    """
+    cv = custom_vmap(
+        functools.partial(_hist_call, n_nodes=n_nodes, n_bins=n_bins,
+                          impl=impl))
+
+    @cv.def_vmap
+    def _rule(axis_size, in_batched, binned_b, rel_b, vals_b):
+        K = axis_size
+        bb, rb, vb = in_batched
+        if impl != "pallas":
+            # segment_sum vmaps fine as-is — no kernel, no flattening
+            fn = functools.partial(_hist_call, n_nodes=n_nodes,
+                                   n_bins=n_bins, impl=impl)
+            out = jax.vmap(fn, in_axes=(0 if bb else None,
+                                        0 if rb else None,
+                                        0 if vb else None))(
+                binned_b, rel_b, vals_b)
+            return out, True
+
+        r = rel_b.shape[1] if rb else rel_b.shape[0]
+        # pad each class's rows to the row tile the flat kernel will
+        # pick for the MERGED node count (fact kernel when it fits,
+        # blocked kernel otherwise)
+        n_hi_t = -(-K * n_nodes * n_bins // 128)
+        rt = _fact_row_tile(n_hi_t, r) if n_hi_t <= _FACT_MAX_NHI \
+            else ROW_TILE
+        pad = (-r) % rt
+        C = vals_b.shape[-1]
+        F = binned_b.shape[-1]
+        # per-class row padding BEFORE flattening so each class's rows
+        # stay aligned with the (re-read) binned row blocks
+        if bb:
+            binned_f = jnp.pad(binned_b, ((0, 0), (0, pad), (0, 0))
+                               ).reshape(K * (r + pad), F)
+            tile = 1
+        else:
+            binned_f = jnp.pad(binned_b, ((0, pad), (0, 0)))
+            tile = K        # binned stored once; grid re-reads it K×
+        rel2 = rel_b if rb else jnp.broadcast_to(rel_b[None], (K, r))
+        rel2 = jnp.pad(rel2, ((0, 0), (0, pad)), constant_values=-1)
+        # class k's rows land in nodes [k·n_nodes, (k+1)·n_nodes)
+        rel2 = jnp.where(rel2 >= 0,
+                         rel2 + (jnp.arange(K, dtype=jnp.int32)
+                                 * n_nodes)[:, None], -1)
+        vals2 = vals_b if vb else jnp.broadcast_to(
+            vals_b[None], (K, r, C))
+        vals2 = jnp.pad(vals2, ((0, 0), (0, pad), (0, 0)))
+        out = _hist_pallas(binned_f, rel2.reshape(K * (r + pad)),
+                           vals2.reshape(K * (r + pad), C),
+                           K * n_nodes, n_bins, binned_tile=tile,
+                           row_tile=rt)
+        return out.reshape((K, n_nodes) + out.shape[1:]), True
+
+    return cv(binned, rel, vals)
 
 
 def resolve_impl(impl: str) -> str:
@@ -288,13 +440,10 @@ def build_histogram(binned, rel, g, h, w, n_nodes: int, n_bins: int,
     if unit_hess:
         vals = jnp.where(live[:, None],
                          jnp.stack([g * w, w], axis=1), 0.0)
-        fn = _hist_pallas if impl == "pallas" else _hist_segment
-        return fn(binned, rel, vals, n_nodes, n_bins)
-    vals = jnp.where(live[:, None],
-                     jnp.stack([g * w, h * w, w], axis=1), 0.0)
-    if impl == "pallas":
-        return _hist_pallas(binned, rel, vals, n_nodes, n_bins)
-    return _hist_segment(binned, rel, vals, n_nodes, n_bins)
+    else:
+        vals = jnp.where(live[:, None],
+                         jnp.stack([g * w, h * w, w], axis=1), 0.0)
+    return _hist_vmappable(binned, rel, vals, n_nodes, n_bins, impl)
 
 
 def expand_unit_hess(hist2):

@@ -128,6 +128,69 @@ def test_gaussian_gbm_unit_hess_matches_full_channels(mesh8):
                                rtol=1e-6)
 
 
+def test_vmapped_batch_matches_loop():
+    """vmap over a class axis (the fused multinomial scan's shape) must
+    equal per-class builds. The custom_vmap rule lowers the batch into
+    the node axis instead of batching the Pallas kernel — Mosaic
+    rejects vmapped rank-1 block specs (round-4 on-chip gate)."""
+    import jax
+
+    K, rows, F, n_nodes, n_bins = 3, 1500, 4, 8, 32
+    rng = np.random.default_rng(21)
+    binned = jnp.asarray(
+        rng.integers(0, n_bins, size=(rows, F)).astype(np.uint8))
+    relK = jnp.asarray(np.where(
+        rng.random((K, rows)) < 0.85,
+        rng.integers(0, n_nodes, size=(K, rows)), -1).astype(np.int32))
+    gK = jnp.asarray(rng.normal(size=(K, rows)).astype(np.float32))
+    hK = jnp.asarray(rng.random((K, rows)).astype(np.float32))
+    w = jnp.asarray((rng.random(rows) < 0.9).astype(np.float32))
+
+    for impl in ("segment", "pallas"):
+        got = jax.vmap(
+            lambda rel, g, h: build_histogram(
+                binned, rel, g, h, w, n_nodes, n_bins, impl))(
+            relK, gK, hK)
+        assert got.shape == (K, n_nodes, F, n_bins, 3)
+        for k in range(K):
+            want = build_histogram(binned, relK[k], gK[k], hK[k], w,
+                                   n_nodes, n_bins, "segment")
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(want),
+                rtol=1e-5, atol=1e-5, err_msg=f"{impl} class {k}")
+
+
+def test_mosaic_lowering_for_tpu_target():
+    """AOT-lower the vmapped pallas build for a TPU target FROM CPU —
+    catches Mosaic block-spec rejections (the round-4 gate failure:
+    vmap prepends a squeezed batch dim that Mosaic refuses on rank-1
+    operands) without needing a chip."""
+    import unittest.mock as mock
+
+    import jax
+
+    rng = np.random.default_rng(7)
+    rows, F, n_nodes, n_bins, K = 2048, 3, 8, 64, 3
+    binned = jnp.asarray(
+        rng.integers(0, n_bins, size=(rows, F)).astype(np.uint8))
+    relK = jnp.asarray(
+        rng.integers(0, n_nodes, size=(K, rows)).astype(np.int32))
+    gK = jnp.asarray(rng.normal(size=(K, rows)).astype(np.float32))
+    hK = jnp.asarray(np.ones((K, rows), np.float32))
+    w = jnp.ones(rows, jnp.float32)
+
+    with mock.patch("jax.default_backend", lambda: "tpu"):
+        def one(rel, g, h):
+            return build_histogram(binned, rel, g, h, w, n_nodes,
+                                   n_bins, "pallas")
+
+        # single (rank-1 specs) and vmapped (batched) forms both lower
+        jax.jit(one).trace(relK[0], gK[0], hK[0]).lower(
+            lowering_platforms=("tpu",))
+        jax.jit(jax.vmap(one)).trace(relK, gK, hK).lower(
+            lowering_platforms=("tpu",))
+
+
 def test_totals_preserved():
     binned, rel, g, h, w = _random_case(700, 3, 8, 32, seed=1)
     hist = build_histogram(binned, rel, g, h, w, 8, 32, impl="pallas")

@@ -257,38 +257,6 @@ def test_avro_nullable_string_keeps_empty_level(tmp_path, mesh8):
     assert codes[1] == 0 and codes[3] == 0      # "" is a real level
 
 
-def test_svmlight_sniff_does_not_eat_csv(tmp_path, mesh8):
-    # a CSV with colon-bearing strings must stay CSV
-    p = tmp_path / "t.csv"
-    p.write_text("a,b\n1,x:1\n2,y:2\n")
-    fr = import_file(str(p))
-    assert fr.names == ["a", "b"]
-    assert fr.vec("b").is_enum()
-    # space-separated count + clock-time rows LOOK like one-pair
-    # svmlight lines; the sniff requires a >= 2-pair line, so this
-    # stays CSV (an extensionless real 1-pair file needs .svm)
-    p2 = tmp_path / "times"
-    p2.write_text("3 08:30\n4 09:15\n5 10:45\n")
-    fr2 = import_file(str(p2))
-    assert "qid" not in fr2.names
-    assert len(fr2.names) == 2
-
-
-def test_avro_nullable_string_keeps_empty_level(tmp_path, mesh8):
-    # union [null, string] with BOTH None and genuine "" values: ""
-    # must stay a level, None must be NA
-    schema = {"type": "record", "name": "r", "fields": [
-        {"name": "s", "type": ["null", "string"]}]}
-    rows = [{"s": "a"}, {"s": ""}, {"s": None}, {"s": ""}, {"s": "b"}]
-    _write_avro(tmp_path / "n.avro", schema, rows)
-    fr = import_file(str(tmp_path / "n.avro"))
-    v = fr.vec("s")
-    assert v.domain == ["", "a", "b"]
-    codes = v.to_numpy()[:5]
-    assert codes[2] == -1                       # None -> NA
-    assert codes[1] == 0 and codes[3] == 0      # "" is a real level
-
-
 def test_offset_cannot_also_be_feature(tmp_path, mesh8):
     import numpy as np
 

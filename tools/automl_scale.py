@@ -60,6 +60,8 @@ def make_table(rows: int, seed: int = 0):
 
 
 def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
+    import traceback
+
     import jax
 
     from h2o_kubernetes_tpu.automl import AutoML
@@ -69,6 +71,10 @@ def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
     # up the hierarchy, so attaching to a child too would double-count
     jax.config.update("jax_log_compiles", True)
     logging.getLogger("jax").addHandler(counter)
+    err = None
+    aml = None
+    lb = []
+    wall = 0.0
     try:
         fr = make_table(rows)
         t0 = time.perf_counter()
@@ -77,6 +83,10 @@ def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
         aml.train(y="y", training_frame=fr)
         wall = time.perf_counter() - t0
         lb = aml.leaderboard.as_list()
+    except Exception:
+        # a crashed shape must still leave a diagnosable record — the
+        # first on-chip 10M run died with nothing but an exit code
+        err = traceback.format_exc()[-2000:]
     finally:
         jax.config.update("jax_log_compiles", False)
         logging.getLogger("jax").removeHandler(counter)
@@ -91,6 +101,11 @@ def run_shape(rows: int, max_models: int, nfolds: int) -> dict:
         "leader_auc": round(lb[0].get("auc", float("nan")), 5)
         if lb else None,
         "platform": jax.default_backend(),
+        # the event log carries every swallowed per-model failure —
+        # a 1-model leaderboard is explainable from the artifact alone
+        "event_log": [f"{ts} {m}" for ts, m in
+                      (aml.event_log if aml is not None else [])][-60:],
+        "error": err,
     }
     print(json.dumps(out), flush=True)
     return out
@@ -118,7 +133,8 @@ def main() -> int:
     # per-model recompile check: compiles must not scale with models —
     # compare against a HALF-max_models run at the smallest shape
     recompile_check = None
-    if len(results) >= 1 and args.max_models >= 4:
+    if len(results) >= 1 and args.max_models >= 4 \
+            and not results[0].get("error"):
         half = run_shape(rows_list[0], max(args.max_models // 2, 2),
                          args.nfolds)
         # tolerance: the half run still compiles the shared trainers

@@ -78,6 +78,11 @@ def main() -> int:
     n_nodes_fact = 16
     assert -(-n_nodes_fact * 256 // 128) <= _FACT_MAX_NHI
     parity("fact_kernel", 100_000, 10, n_nodes_fact, 256)
+    # 1b. factorized kernel AT the VMEM cap (n_hi == _FACT_MAX_NHI):
+    # validates the [3·C·n_hi, T] stacked-term A fits VMEM on real
+    # Mosaic, where interpret mode can't see allocation failures
+    parity("fact_kernel_cap", 50_000, 2, _FACT_MAX_NHI * 128 // 256,
+           256)
     # 2. bin-blocked kernel: force past the factorized cap
     n_nodes_deep = (_FACT_MAX_NHI * 128 // 256) * 2
     parity("binblock_kernel", 50_000, 4, n_nodes_deep, 256)

@@ -119,6 +119,19 @@ def capture() -> float | None:
             json.dump(bench, f, indent=1)
         log(f"new best on-chip value {bench.get('value')}")
 
+    # once per chip window: per-phase + per-op boost profile (where the
+    # bench seconds actually go — drives the MFU work)
+    prof_path = os.path.join(REPO, "PROFILE_TPU_r04.json")
+    if not os.path.exists(prof_path):
+        log("running boost profile on chip")
+        ok, prof, tail = run_json(
+            [sys.executable, os.path.join("tools", "boost_profile.py")],
+            2400.0)
+        log(f"boost_profile ok={ok} "
+            f"result={json.dumps(prof)[:300] if prof else ''}")
+        if not ok:
+            log(f"boost_profile tail: {tail}")
+
     # once per session, with the chip warm: the AutoML-at-scale
     # wall-clock the north star is phrased in (10M x 10, max_models=12)
     aml_path = os.path.join(REPO, "AUTOML_TPU_r04.json")
@@ -128,7 +141,9 @@ def capture() -> float | None:
             [sys.executable, os.path.join("tools", "automl_scale.py"),
              "--max-models", "12"], 7200.0)
         log(f"automl_scale ok={ok} "
-            f"result={json.dumps(aml)[:300] if aml else tail}")
+            f"result={json.dumps(aml)[:300] if aml else ''}")
+        if not ok:
+            log(f"automl_scale tail: {tail}")
     return float(bench.get("value", 0.0))
 
 
