@@ -128,8 +128,15 @@ def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
     iota_lo = lax.broadcasted_iota(jnp.int32, (T, 128), 1)
     dn = (((1,), (0,)), ((), ()))
 
-    for j in range(fg):                              # static unroll
-        bins = binned_ref[0, j, :]                   # [T]
+    # REAL loop over the feature group, not a static unroll: Mosaic
+    # stack-allocates every unrolled iteration's [3·n_ch·n_hi, T] A
+    # operand separately (fg=10 at T=4096 → 22 MB, past the 16 MB
+    # scoped-vmem limit — caught by the on-chip gate), while a
+    # fori_loop body's buffers are reused across iterations. The
+    # feature index is a LEADING dim of the binned/out blocks so the
+    # dynamic index never touches the tiled (sublane, lane) pair.
+    def _feature(j, carry):
+        bins = binned_ref[j, 0, 0, :]                # [T]
         seg = rel_base + bins
         hi = lax.shift_right_arithmetic(seg, 7)      # floor(seg/128)
         lo = seg - hi * 128                          # seg mod 128, >= 0
@@ -149,6 +156,9 @@ def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
                               preferred_element_type=jnp.float32)
         acc = acc.reshape(3, n_ch * n_hi, 128)
         out_ref[0, j] += acc[0] + acc[1] + acc[2]    # [n_ch·n_hi, 128]
+        return carry
+
+    lax.fori_loop(0, fg, _feature, 0)
 
 
 # VMEM cap for the factorized kernel's working set. With the stacked-
@@ -199,10 +209,12 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
         F_pad = -(-F // fg) * fg
         binned = jnp.pad(binned, ((0, 0), (0, F_pad - F)))
     n_fg = F_pad // fg
-    # [rp, F_pad] -> [row_block, F_pad, rt]: a (1, fg, rt) block is a
-    # row block's bins for one feature group
-    binned3 = binned.astype(jnp.int32).T.reshape(
-        F_pad, rbb, rt_size).transpose(1, 0, 2)
+    # [rp, F_pad] -> [F_pad, row_block, 1, rt]: a (fg, 1, 1, rt) block
+    # is a row block's bins for one feature group, with the feature on
+    # a LEADING dim — the kernel's fori_loop indexes it dynamically,
+    # which is only legal off the tiled (sublane, lane) pair
+    binned4 = binned.astype(jnp.int32).T.reshape(
+        F_pad, rbb, 1, rt_size)
     rel32 = rel.astype(jnp.int32)
     vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
     grid = (n_fg, binned_tile, rbb)
@@ -213,8 +225,8 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
                                        jnp.float32, vma=vma),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, fg, rt_size),
-                         lambda g, k, rt: (rt, g, 0)),
+            pl.BlockSpec((fg, 1, 1, rt_size),
+                         lambda g, k, rt: (g, rt, 0, 0)),
             pl.BlockSpec((rt_size,),
                          lambda g, k, rt, rb=rbb: (k * rb + rt,)),
             pl.BlockSpec((rt_size, C),
@@ -223,7 +235,7 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
         out_specs=pl.BlockSpec((1, fg, C * n_hi, 128),
                                lambda g, k, rt: (g, 0, 0, 0)),
         interpret=jax.default_backend() != "tpu",
-    )(binned3, rel32, vals)
+    )(binned4, rel32, vals)
     # [n_fg, fg, C·n_hi, 128] -> [F, C, n_hi·128] -> [n, F, B, C]
     out = out.reshape(F_pad, C, n_hi * 128)[:F, :, :nB]
     return out.reshape(F, C, n_nodes, n_bins).transpose(2, 0, 3, 1)

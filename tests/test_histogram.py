@@ -191,6 +191,61 @@ def test_mosaic_lowering_for_tpu_target():
             lowering_platforms=("tpu",))
 
 
+def test_mosaic_lowering_bench_shape_paths():
+    """AOT-lower the fact kernel's OTHER configurations from CPU: the
+    wide 4096 row tile (rows >= 8192 — the production bench shape; the
+    small-rows case above stays at rt=1024) and the feature-group
+    SPLIT path (F_pad > F grid), which needs _OUT_BUDGET forced down
+    since hitting it naturally takes F > 64."""
+    import unittest.mock as mock
+
+    import jax
+
+    from h2o_kubernetes_tpu.ops import histogram as H
+
+    rng = np.random.default_rng(11)
+    rows, n_nodes, n_bins = 8192, 16, 256
+    w = jnp.ones(rows, jnp.float32)
+    g = jnp.asarray(rng.normal(size=rows).astype(np.float32))
+    h = jnp.asarray(rng.random(rows).astype(np.float32))
+    rel = jnp.asarray(
+        rng.integers(0, n_nodes, size=rows).astype(np.int32))
+
+    with mock.patch("jax.default_backend", lambda: "tpu"):
+        # rt=4096 path (n_hi = 32 <= 64, rows >= 8192)
+        binned = jnp.asarray(
+            rng.integers(0, n_bins, size=(rows, 10)).astype(np.uint8))
+        jax.jit(lambda r: build_histogram(
+            binned, r, g, h, w, n_nodes, n_bins, "pallas")).trace(
+            rel).lower(lowering_platforms=("tpu",))
+        # feature-group split: budget forced to one feature's out block
+        per_f = 3 * 32 * 128 * 4
+        binned_wide = jnp.asarray(
+            rng.integers(0, n_bins, size=(rows, 18)).astype(np.uint8))
+        with mock.patch.object(H, "_OUT_BUDGET", per_f * 8):
+            jax.jit(lambda r: build_histogram(
+                binned_wide, r, g, h, w, n_nodes, n_bins,
+                "pallas")).trace(rel).lower(lowering_platforms=("tpu",))
+
+
+def test_feature_group_split_parity():
+    """Interpret-mode parity through the F_pad > F split path (padded
+    feature columns must histogram into junk rows that are sliced
+    away, not into real features)."""
+    import unittest.mock as mock
+
+    from h2o_kubernetes_tpu.ops import histogram as H
+
+    binned, rel, g, h, w = _random_case(3000, 18, 8, 64, seed=13)
+    want = build_histogram(binned, rel, g, h, w, 8, 64, impl="segment")
+    per_f = 3 * (-(-8 * 64 // 128)) * 128 * 4
+    with mock.patch.object(H, "_OUT_BUDGET", per_f * 8):
+        got = build_histogram(binned, rel, g, h, w, 8, 64,
+                              impl="pallas")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_totals_preserved():
     binned, rel, g, h, w = _random_case(700, 3, 8, 32, seed=1)
     hist = build_histogram(binned, rel, g, h, w, 8, 32, impl="pallas")

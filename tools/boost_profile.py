@@ -38,7 +38,14 @@ def _phase(name, fn, out):
 
 
 def _parse_trace(log_dir: str, top: int = 30):
-    """Aggregate device-track op self-times from the perfetto trace."""
+    """Aggregate device-track op self-times from the perfetto trace.
+
+    The device pid carries several thread tracks — "XLA Ops" (leaf op
+    executions) but also "XLA Modules" / "Steps" spans that COVER the
+    ops; summing every complete event under the pid would double-count
+    each op inside its module span. Only op-level tracks are summed:
+    the "XLA Ops" threads when present, else the pid's threads minus
+    the known enclosing-span tracks."""
     paths = glob.glob(os.path.join(log_dir, "**", "*.trace.json.gz"),
                       recursive=True)
     if not paths:
@@ -46,20 +53,34 @@ def _parse_trace(log_dir: str, top: int = 30):
     with gzip.open(sorted(paths)[-1], "rt") as f:
         trace = json.load(f)
     ev = trace.get("traceEvents", [])
-    # device tracks: pids whose process_name mentions TPU/device; fall
-    # back to aggregating every complete event if none matches
-    pid_names = {}
+    pid_names, tid_names = {}, {}
     for e in ev:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
             pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tid_names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
     device_pids = {p for p, n in pid_names.items()
                    if "TPU" in n or "device" in n.lower()}
+    op_tracks = {k for k, n in tid_names.items()
+                 if (not device_pids or k[0] in device_pids)
+                 and "XLA Ops" in n}
+    if not op_tracks:
+        span = ("XLA Modules", "Steps", "Framework")
+        op_tracks = {k for k, n in tid_names.items()
+                     if (not device_pids or k[0] in device_pids)
+                     and not any(s in n for s in span)}
     agg: dict[str, float] = {}
     total = 0.0
     for e in ev:
         if e.get("ph") != "X":
             continue
-        if device_pids and e.get("pid") not in device_pids:
+        if op_tracks and (e.get("pid"), e.get("tid")) not in op_tracks:
+            continue
+        if not op_tracks and device_pids \
+                and e.get("pid") not in device_pids:
             continue
         name = e.get("name", "?")
         dur = float(e.get("dur", 0.0)) / 1e6       # us -> s
