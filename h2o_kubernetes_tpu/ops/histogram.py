@@ -197,9 +197,10 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
     # feature grouping: each grid step holds [fg, C·n_hi, 128] f32 of
     # output resident; wide tables split into 8-aligned groups (padded
     # feature columns histogram into junk rows that are sliced away).
-    # fg is also capped at 64 outright — the kernel statically unrolls
-    # fg matmuls per grid step, and the row-stream-reuse win saturates
-    # long before the Mosaic program size blows up
+    # fg is also capped at 64 outright: the row-stream-reuse win
+    # saturates long before that, and the resident out block is the
+    # only cost that grows with fg (the kernel's fori_loop reuses one
+    # iteration's buffers)
     per_f = C * n_hi * 128 * 4
     fg_cap = min(F, 64, max(1, _OUT_BUDGET // per_f))
     if fg_cap >= F:

@@ -149,6 +149,22 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         try:
             path = urllib.parse.urlparse(self.path).path.rstrip("/")
+            if path in ("", "/flow", "/flow/index.html"):
+                # the h2o-web Flow analog (SURVEY §2b C19): one
+                # self-contained page, same REST verbs as any client
+                import os
+
+                page = os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "flow", "index.html")
+                with open(page, "rb") as f:
+                    body = f.read()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return None
             if path == "/3/Cloud":
                 from . import cluster_status
 
@@ -212,9 +228,24 @@ class _Handler(BaseHTTPRequestHandler):
                 if key not in MODELS:
                     return self._error(404, f"model '{key}' not found")
                 m = MODELS[key]
-                return self._json({"model_id": {"name": key},
-                                   "algo": m.algo,
-                                   "nclasses": m.nclasses})
+                cvm = getattr(m, "cross_validation_metrics", None)
+                out = {"model_id": {"name": key},
+                       "algo": m.algo,
+                       "nclasses": m.nclasses,
+                       "scoring_history":
+                           getattr(m, "scoring_history", []),
+                       "validation_metrics":
+                           getattr(m, "validation_metrics", None),
+                       "cross_validation_metrics":
+                           cvm() if callable(cvm) else cvm}
+                varimp = getattr(m, "varimp", None)
+                if callable(varimp):
+                    try:
+                        out["variable_importances"] = {
+                            k: float(v) for k, v in varimp().items()}
+                    except Exception:   # noqa: BLE001 — detail is
+                        pass            # best-effort, not the contract
+                return self._json(out)
             return self._error(404, f"no route for GET {path}")
         except Exception as e:       # noqa: BLE001
             traceback.print_exc()

@@ -224,3 +224,35 @@ def test_grid_over_rest(server, tmp_path):
     got = _get(server, "/99/Grids/g1")
     assert len(got["model_ids"]) == 2
     assert got["summary"][0]["model_id"]
+
+
+def test_flow_ui_served(server):
+    """The root path serves the self-contained Flow page (the h2o-web
+    analog) with no external asset references (air-gapped pods)."""
+    for route in ("/", "/flow"):
+        with urllib.request.urlopen(server + route, timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/html")
+            body = r.read().decode()
+        assert "H2O-TPU Flow" in body
+        # self-contained: no external script/style/font loads
+        assert "http://" not in body.replace(server, "")
+        assert "https://" not in body
+        for verb in ("/3/Cloud", "/3/Frames", "/3/ModelBuilders/",
+                     "/99/AutoMLBuilder", "/3/Jobs", "/3/Timeline"):
+            assert verb in body, f"Flow page lost the {verb} flow"
+
+
+def test_model_detail_fields(server, tmp_path):
+    """GET /3/Models/{key} carries scoring history, varimp and CV
+    metrics — what the Flow model page renders."""
+    _mkframe(server, tmp_path, n=300, name="detailtrain")
+    _post_json(server, "/3/ModelBuilders/gbm", {
+        "training_frame": "detailtrain", "response_column": "y",
+        "model_id": "detail_gbm", "ntrees": 3, "max_depth": 3,
+        "nfolds": 3})
+    got = _get(server, "/3/Models/detail_gbm")
+    assert got["algo"] == "gbm" and got["nclasses"] == 2
+    assert len(got["scoring_history"]) >= 1
+    assert got["variable_importances"]["x"] == 1.0
+    cv = got["cross_validation_metrics"]
+    assert cv and 0.5 <= cv["auc"] <= 1.0
