@@ -1,0 +1,204 @@
+# h2o.tpu — R client for the h2o_kubernetes_tpu REST API.
+#
+# The reference ships a full R package (h2o-r/ in h2o-3) whose verbs
+# are thin wrappers over the same REST surface the Python client uses;
+# this file is the equivalent for this framework: one source()-able
+# script, base R + jsonlite, HTTP via the system curl binary (present
+# in every deploy image this targets; no httr dependency).
+#
+#   source("h2o_tpu.R")
+#   h2o.init("http://localhost:54321")
+#   fr  <- h2o.importFile("/data/airlines.csv", "air.hex")
+#   m   <- h2o.gbm(y = "IsDepDelayed", training_frame = "air.hex",
+#                  ntrees = 50, max_depth = 5)
+#   h2o.performance(m)                      # scoring history, CV, varimp
+#   p   <- h2o.predict(m, "air.hex")
+#   aml <- h2o.automl(y = "IsDepDelayed", training_frame = "air.hex",
+#                     max_models = 12)
+#   h2o.leaderboard(aml)
+#
+# NOTE: this environment has no R runtime, so unlike everything else
+# in the repo this client is not exercised by CI; it sticks to the
+# REST verbs tests/test_rest.py covers and to base-R constructs.
+
+.h2o.env <- new.env(parent = emptyenv())
+
+.h2o.url <- function(path) {
+  base <- get0("base", envir = .h2o.env,
+               ifnotfound = "http://localhost:54321")
+  paste0(base, path)
+}
+
+.h2o.http <- function(method, path, body = NULL) {
+  if (!requireNamespace("jsonlite", quietly = TRUE))
+    stop("the h2o.tpu client needs the 'jsonlite' package")
+  args <- c("-s", "-X", method, .h2o.url(path))
+  if (!is.null(body)) {
+    args <- c(args, "-H", "Content-Type: application/json",
+              "--data-binary",
+              jsonlite::toJSON(body, auto_unbox = TRUE))
+  }
+  raw <- suppressWarnings(system2("curl", shQuote(args), stdout = TRUE))
+  txt <- paste(raw, collapse = "\n")
+  if (!nzchar(txt))
+    stop("no response from ", .h2o.url(path),
+         " - is the server running? (h2o.init)")
+  out <- jsonlite::fromJSON(txt, simplifyVector = FALSE)
+  if (!is.null(out$http_status) && out$http_status >= 400)
+    stop("HTTP ", out$http_status, ": ", out$msg)
+  out
+}
+
+# -- cluster ----------------------------------------------------------------
+
+h2o.init <- function(url = "http://localhost:54321") {
+  assign("base", sub("/+$", "", url), envir = .h2o.env)
+  st <- .h2o.http("GET", "/3/Cloud")
+  cat(sprintf("Connected to h2o-tpu v%s: %d device(s), healthy=%s\n",
+              st$version, st$cloud_size, st$cloud_healthy))
+  invisible(st)
+}
+
+h2o.clusterStatus <- function() .h2o.http("GET", "/3/Cloud")
+
+h2o.isLeaderNode <- function() {
+  out <- tryCatch(.h2o.http("GET", "/kubernetes/isLeaderNode"),
+                  error = function(e) list(leader = FALSE))
+  isTRUE(out$leader)
+}
+
+# -- frames -----------------------------------------------------------------
+
+h2o.importFile <- function(path, destination_frame = NULL) {
+  body <- list(path = path)
+  if (!is.null(destination_frame))
+    body$destination_frame <- destination_frame
+  out <- .h2o.http("POST", "/3/ImportFiles", body)
+  out$frame_id$name
+}
+
+h2o.ls <- function() {
+  out <- .h2o.http("GET", "/3/Frames")
+  vapply(out$frames, function(f) f$frame_id$name, character(1))
+}
+
+h2o.describe <- function(frame_id) {
+  .h2o.http("GET", paste0("/3/Frames/", utils::URLencode(frame_id),
+                          "/summary"))$summary
+}
+
+h2o.rm <- function(key) {
+  ok <- tryCatch({
+    .h2o.http("DELETE", paste0("/3/Frames/", utils::URLencode(key)))
+    TRUE
+  }, error = function(e) FALSE)
+  if (!ok)
+    .h2o.http("DELETE", paste0("/3/Models/", utils::URLencode(key)))
+  invisible(key)
+}
+
+h2o.removeAll <- function() invisible(.h2o.http("DELETE", "/3/DKV"))
+
+# -- model builders ---------------------------------------------------------
+
+.h2o.train <- function(algo, y = NULL, training_frame, model_id = NULL,
+                       ...) {
+  body <- list(training_frame = training_frame, ...)
+  if (!is.null(y)) body$response_column <- y
+  if (!is.null(model_id)) body$model_id <- model_id
+  out <- .h2o.http("POST", paste0("/3/ModelBuilders/", algo), body)
+  dest <- out$job$dest$name
+  if (identical(out$job$status, "FAILED"))
+    stop(algo, " build failed: ", out$job$msg)
+  structure(list(model_id = dest, algo = algo), class = "H2OTpuModel")
+}
+
+h2o.gbm <- function(...) .h2o.train("gbm", ...)
+h2o.randomForest <- function(...) .h2o.train("drf", ...)
+h2o.glm <- function(...) .h2o.train("glm", ...)
+h2o.deeplearning <- function(...) .h2o.train("deeplearning", ...)
+h2o.xgboost <- function(...) .h2o.train("xgboost", ...)
+h2o.kmeans <- function(...) .h2o.train("kmeans", ...)
+h2o.naiveBayes <- function(...) .h2o.train("naivebayes", ...)
+h2o.prcomp <- function(...) .h2o.train("pca", ...)
+h2o.isolationForest <- function(...) .h2o.train("isolationforest", ...)
+h2o.glrm <- function(...) .h2o.train("glrm", ...)
+h2o.coxph <- function(...) .h2o.train("coxph", ...)
+h2o.aggregator <- function(...) .h2o.train("aggregator", ...)
+
+h2o.getModel <- function(model_id) {
+  structure(list(model_id = model_id,
+                 detail = .h2o.http(
+                   "GET", paste0("/3/Models/",
+                                 utils::URLencode(model_id)))),
+            class = "H2OTpuModel")
+}
+
+h2o.performance <- function(model) {
+  id <- if (inherits(model, "H2OTpuModel")) model$model_id else model
+  .h2o.http("GET", paste0("/3/Models/", utils::URLencode(id)))
+}
+
+h2o.varimp <- function(model) {
+  perf <- h2o.performance(model)
+  vi <- perf$variable_importances
+  if (is.null(vi)) return(NULL)
+  data.frame(variable = names(vi),
+             relative_importance = unlist(vi, use.names = FALSE))
+}
+
+h2o.predict <- function(model, frame_id) {
+  id <- if (inherits(model, "H2OTpuModel")) model$model_id else model
+  out <- .h2o.http(
+    "POST", paste0("/3/Predictions/models/", utils::URLencode(id),
+                   "/frames/", utils::URLencode(frame_id)))
+  out$predictions_frame$name
+}
+
+# -- grids / automl / jobs --------------------------------------------------
+
+h2o.grid <- function(algo, hyper_params, y, training_frame,
+                     grid_id = NULL, ...) {
+  body <- list(training_frame = training_frame, response_column = y,
+               hyper_parameters = hyper_params, ...)
+  if (!is.null(grid_id)) body$grid_id <- grid_id
+  out <- .h2o.http("POST", paste0("/99/Grid/", algo), body)
+  gid <- out$grid_id$name
+  .h2o.http("GET", paste0("/99/Grids/", utils::URLencode(gid)))
+}
+
+h2o.automl <- function(y, training_frame, project_name = "automl",
+                       max_models = 12, ...) {
+  body <- list(training_frame = training_frame, response_column = y,
+               project_name = project_name, max_models = max_models,
+               ...)
+  out <- .h2o.http("POST", "/99/AutoMLBuilder", body)
+  if (identical(out$job$status, "FAILED"))
+    stop("AutoML failed: ", out$job$msg)
+  structure(list(project_name = out$project_name),
+            class = "H2OTpuAutoML")
+}
+
+h2o.leaderboard <- function(automl) {
+  pn <- if (inherits(automl, "H2OTpuAutoML")) automl$project_name
+        else automl
+  out <- .h2o.http("GET", paste0("/3/AutoML/", utils::URLencode(pn)))
+  rows <- out$leaderboard
+  if (!length(rows)) return(data.frame())
+  cols <- unique(unlist(lapply(rows, names)))
+  as.data.frame(do.call(rbind, lapply(rows, function(r) {
+    r[setdiff(cols, names(r))] <- NA
+    r[cols]
+  })))
+}
+
+h2o.jobs <- function() {
+  out <- .h2o.http("GET", "/3/Jobs")
+  if (!length(out$jobs)) return(data.frame())
+  do.call(rbind, lapply(out$jobs, function(j)
+    data.frame(dest = j$dest, description = j$description,
+               status = j$status, progress = j$progress,
+               msg = if (nzchar(j$msg %||% "")) j$msg else "")))
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a

@@ -104,6 +104,19 @@ def _algo_estimator(algo: str):
     }[algo]
 
 
+def _definite(obj):
+    """Recursively replace non-finite floats with None (JSON null)."""
+    if isinstance(obj, float):
+        import math
+
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _definite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_definite(v) for v in obj]
+    return obj
+
+
 def _frame_schema(key: str, fr) -> dict:
     return {"frame_id": {"name": key}, "rows": fr.nrows,
             "columns": [{"label": n,
@@ -119,7 +132,10 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing ------------------------------------------------------------
 
     def _json(self, obj, code: int = 200):
-        body = json.dumps(obj).encode()
+        # metrics can be NaN (single-class CV folds, zero-weight rmse);
+        # json.dumps would emit bare `NaN` — invalid JSON that strict
+        # parsers (fetch, jsonlite) reject. Null them out instead.
+        body = json.dumps(_definite(obj)).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))

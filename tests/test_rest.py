@@ -256,3 +256,23 @@ def test_model_detail_fields(server, tmp_path):
     assert got["variable_importances"]["x"] == 1.0
     cv = got["cross_validation_metrics"]
     assert cv and 0.5 <= cv["auc"] <= 1.0
+
+
+def test_nan_metrics_serialize_as_null(server):
+    """Non-finite metric values must reach clients as JSON null —
+    json.dumps' bare NaN is rejected by strict parsers (fetch,
+    jsonlite) and would blank the Flow model page."""
+    rest.MODELS["nan_model"] = type("M", (), {
+        "algo": "gbm", "nclasses": 2,
+        "scoring_history": [{"ntrees": 1, "train_auc": float("nan")}],
+        "validation_metrics": {"auc": float("inf")},
+    })()
+    try:
+        raw = urllib.request.urlopen(
+            server + "/3/Models/nan_model", timeout=30).read().decode()
+        assert "NaN" not in raw and "Infinity" not in raw
+        got = json.loads(raw)       # strict parse must succeed
+        assert got["scoring_history"][0]["train_auc"] is None
+        assert got["validation_metrics"]["auc"] is None
+    finally:
+        rest.MODELS.pop("nan_model", None)

@@ -133,17 +133,32 @@ def capture() -> float | None:
             log(f"boost_profile tail: {tail}")
 
     # once per session, with the chip warm: the AutoML-at-scale
-    # wall-clock the north star is phrased in (10M x 10, max_models=12)
+    # wall-clock the north star is phrased in (10M x 10, max_models=12,
+    # 900 s budget — chip availability comes in ~20-min windows, so the
+    # capture is a fixed-time-budget run, the same framing the
+    # reference's AutoML wall-clock comparisons use)
     aml_path = os.path.join(REPO, "AUTOML_TPU_r04.json")
     if not os.path.exists(aml_path):
         log("running on-chip AutoML 10M scale capture")
         ok, aml, tail = run_json(
             [sys.executable, os.path.join("tools", "automl_scale.py"),
-             "--max-models", "12"], 7200.0)
+             "--max-models", "12", "--max-runtime-secs", "900"],
+            2400.0)
         log(f"automl_scale ok={ok} "
             f"result={json.dumps(aml)[:300] if aml else ''}")
         if not ok:
             log(f"automl_scale tail: {tail}")
+        # a chip death mid-run leaves a zero-model artifact — keep it
+        # as evidence under a _failed name but retry next window
+        try:
+            with open(aml_path) as f:
+                curve = json.load(f).get("curve", [])
+            if not any(s.get("models_trained") for s in curve):
+                os.replace(aml_path, aml_path.replace(
+                    ".json", "_failed.json"))
+                log("automl capture had no trained models — will retry")
+        except (OSError, ValueError):
+            pass
     return float(bench.get("value", 0.0))
 
 
