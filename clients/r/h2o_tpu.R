@@ -159,8 +159,10 @@ h2o.predict <- function(model, frame_id) {
 
 h2o.grid <- function(algo, hyper_params, y, training_frame,
                      grid_id = NULL, ...) {
+  # as.list each value so toJSON(auto_unbox) keeps single-valued
+  # hypers as JSON arrays — the server iterates every value list
   body <- list(training_frame = training_frame, response_column = y,
-               hyper_parameters = hyper_params, ...)
+               hyper_parameters = lapply(hyper_params, as.list), ...)
   if (!is.null(grid_id)) body$grid_id <- grid_id
   out <- .h2o.http("POST", paste0("/99/Grid/", algo), body)
   gid <- out$grid_id$name
@@ -186,10 +188,21 @@ h2o.leaderboard <- function(automl) {
   rows <- out$leaderboard
   if (!length(rows)) return(data.frame())
   cols <- unique(unlist(lapply(rows, names)))
-  as.data.frame(do.call(rbind, lapply(rows, function(r) {
-    r[setdiff(cols, names(r))] <- NA
-    r[cols]
-  })))
+  # atomic columns (fromJSON(simplifyVector=FALSE) gives lists; rbind
+  # of lists would make list-columns that break order()/mean());
+  # JSON nulls (NaN metrics) become NA
+  df <- lapply(cols, function(cn) {
+    vals <- lapply(rows, function(r) r[[cn]])
+    if (all(vapply(vals, function(v)
+          is.null(v) || is.numeric(v), logical(1))))
+      vapply(vals, function(v) if (is.null(v)) NA_real_
+             else as.numeric(v), numeric(1))
+    else
+      vapply(vals, function(v) if (is.null(v)) NA_character_
+             else as.character(v), character(1))
+  })
+  names(df) <- cols
+  as.data.frame(df, stringsAsFactors = FALSE)
 }
 
 h2o.jobs <- function() {

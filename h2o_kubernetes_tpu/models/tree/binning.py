@@ -65,15 +65,30 @@ class BinSpec:
 
 import functools
 
+# above this many rows, quantile edges come from a fixed-key uniform
+# row sample instead of a full-column sort. The reference's own hist
+# path (XGBoost tree_method=hist; PAPERS.md GBDT-on-accelerator
+# entries) bins from APPROXIMATE quantile sketches, not exact
+# order statistics — a 64k sample gives ~256 draws per bin edge at
+# n_bins=256, far inside the noise of where a split lands, while the
+# per-column sort cost drops ~16x at 1M rows (fit_bins was ~200 ms of
+# the 2.6 s bench train; sorts dominate it).
+_QUANTILE_SAMPLE = 1 << 16
+
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def _device_quantiles(Xn: jax.Array, n_q: int) -> jax.Array:
     """Per-column quantile edges on device: [n, Fn] → [Fn, n_q].
 
-    Full-data nanquantile (one sort per column on the accelerator)
-    replaces round-1's host-side sampled np.quantile — for a 1M-row
-    frame the host path cost seconds of transfer + permutation sampling
-    per train() call."""
+    Device-side (round 3: no host round-trip before the first training
+    dispatch); past _QUANTILE_SAMPLE rows the quantiles are taken over
+    a with-replacement uniform sample under a FIXED key — deterministic
+    edges for a given shape, no full-data sort."""
+    n = Xn.shape[0]
+    if n > _QUANTILE_SAMPLE:          # static shape: trace-time branch
+        idx = jax.random.randint(jax.random.key(0x51BB),
+                                 (_QUANTILE_SAMPLE,), 0, n)
+        Xn = Xn[idx]
     qs = jnp.linspace(0.0, 1.0, n_q + 2)[1:-1]
     return jax.vmap(lambda c: jnp.nanquantile(c, qs))(Xn.T)
 

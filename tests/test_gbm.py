@@ -272,3 +272,32 @@ def test_zero_weight_frame_raises(mesh8):
          "y": np.full(64, np.nan, dtype=np.float32)})
     with pytest.raises(ValueError, match="positive weight"):
         GBM(ntrees=2, max_depth=2, seed=0).train(y="y", training_frame=fr)
+
+
+def test_sampled_quantile_binning_parity(mesh8, monkeypatch):
+    """Past _QUANTILE_SAMPLE rows fit_bins sketches edges from a fixed
+    uniform sample (the reference's hist path also bins from
+    approximate sketches). Forced onto the sampled path, edges must
+    stay monotone and the model must match the exact-edge model's AUC
+    to within noise."""
+    from h2o_kubernetes_tpu.models.tree import binning as B
+
+    fr, _, _ = _binary_data(n=6000, seed=9)
+    m_exact = GBM(ntrees=5, max_depth=4, seed=1).train(
+        y="y", training_frame=fr)
+    auc_exact = m_exact.scoring_history[-1]["train_auc"]
+
+    monkeypatch.setattr(B, "_QUANTILE_SAMPLE", 1024)
+    B._device_quantiles.clear_cache()
+    try:
+        spec = B.fit_bins(fr, ["x1", "x2", "x3"], n_bins=64)
+        edges = np.asarray(spec.edges_matrix())[0]
+        finite = edges[np.isfinite(edges)]
+        assert len(finite) > 10
+        assert np.all(np.diff(finite) >= 0), "sampled edges not sorted"
+        m_s = GBM(ntrees=5, max_depth=4, seed=1).train(
+            y="y", training_frame=fr)
+        auc_s = m_s.scoring_history[-1]["train_auc"]
+        assert abs(auc_s - auc_exact) < 0.02, (auc_s, auc_exact)
+    finally:
+        B._device_quantiles.clear_cache()

@@ -1,0 +1,169 @@
+"""Multi-config benchmark suite — the BASELINE.json eval configs
+beyond the headline GBM number (bench.py):
+
+- config #2a GLM: binomial IRLSM on a HIGGS-shaped table (28 numeric
+  features) — reports the north-star "GLM iters/sec" plus wall;
+- config #2b DRF: HIGGS-shaped forest — rides the 2-channel
+  unit-hessian histogram path (h ≡ 1);
+- config #3  XGBoost tree_method=hist semantics — regularized-gain
+  boosting on the shared tree core;
+- config #4  DeepLearning MLP (model-averaging allreduce) — rows/sec
+  through one epoch.
+
+Each config warms up once (compile excluded, same contract as
+bench.py) then times a steady-state train. One JSON line per config +
+a trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r04.json`` at the
+repo root. Run by tools/tpu_watch.py once per chip window.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _higgs_like(rows: int, seed: int = 0):
+    """HIGGS-shaped synthetic: 28 numeric features, binary response
+    driven by a few nonlinear combinations (the real set's low-level
+    kinematics + derived masses)."""
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+
+    rng = np.random.default_rng(seed)
+    F = 28
+    X = rng.normal(size=(rows, F)).astype(np.float32)
+    logit = (0.8 * X[:, 0] - 0.6 * X[:, 1] * X[:, 2]
+             + 0.5 * np.abs(X[:, 3]) - 0.4 * (X[:, 4] ** 2)
+             + rng.normal(scale=0.7, size=rows))
+    cols = {f"f{i}": X[:, i] for i in range(F)}
+    cols["y"] = np.where(logit > 0, "s", "b")
+    return h2o.Frame.from_arrays(cols)
+
+
+def _timed(fn):
+    fn()                                   # warm-up: compile cached
+    t0 = time.perf_counter()
+    out = fn()
+    return out, time.perf_counter() - t0
+
+
+def main() -> int:
+    from h2o_kubernetes_tpu.runtime.backend import ensure_live_backend
+
+    ensure_live_backend(budget=float(
+        os.environ.get("H2O_TPU_PROBE_BUDGET", "300")))
+    import jax
+
+    from h2o_kubernetes_tpu.models import DRF, GLM, DeepLearning, XGBoost
+
+    platform = jax.default_backend()
+    on_tpu = platform == "tpu"
+    rows = int(os.environ.get("BENCH_SUITE_ROWS",
+                              1_000_000 if on_tpu else 30_000))
+    results = []
+
+    def record(config, value, unit, seconds, **extra):
+        row = {"config": config, "value": round(value, 1), "unit": unit,
+               "seconds": round(seconds, 3), "rows": rows,
+               "platform": platform, **extra}
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    fr = _higgs_like(rows)
+
+    # config #2a: GLM binomial IRLSM — north-star "GLM iters/sec"
+    m, dt = _timed(lambda: GLM(
+        family="binomial", solver="IRLSM", lambda_=0.0,
+        max_iterations=20, seed=1).train(y="y", training_frame=fr))
+    record("glm_binomial_irlsm", m.n_iterations / dt, "iters/s", dt,
+           iterations=m.n_iterations,
+           auc=round(float(m.model_performance(fr, y="y")["auc"]), 5))
+
+    # config #2b: DRF (unit-hessian 2-channel histograms)
+    ntrees, depth = 10, 8
+    m, dt = _timed(lambda: DRF(
+        ntrees=ntrees, max_depth=depth, seed=1).train(
+        y="y", training_frame=fr))
+    record("drf_higgs", rows * ntrees / dt, "rows*trees/s", dt,
+           ntrees=ntrees, max_depth=depth)
+
+    # config #3: XGBoost hist semantics
+    m, dt = _timed(lambda: XGBoost(
+        ntrees=ntrees, max_depth=6, learn_rate=0.2, seed=1).train(
+        y="y", training_frame=fr))
+    record("xgboost_hist", rows * ntrees / dt, "rows*trees/s", dt,
+           ntrees=ntrees, max_depth=6)
+
+    # config #3b: lambdarank (MSLR-WEB30K shape — graded relevance over
+    # query groups, rank:ndcg LambdaMART)
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+
+    rk_rows = min(rows, 200_000)
+    rng = np.random.default_rng(4)
+    Xr = rng.normal(size=(rk_rows, 20)).astype(np.float32)
+    qid = np.sort(rng.integers(0, rk_rows // 100, size=rk_rows))
+    rel = np.clip((Xr[:, 0] + 0.5 * Xr[:, 1]
+                   + rng.normal(scale=0.8, size=rk_rows)) * 1.2 + 2,
+                  0, 4).astype(np.float32).round()
+    rcols = {f"f{i}": Xr[:, i] for i in range(20)}
+    rcols["rel"] = rel
+    rcols["qid"] = qid.astype(np.float32)
+    fr_rk = h2o.Frame.from_arrays(rcols)
+    m, dt = _timed(lambda: XGBoost(
+        ntrees=10, max_depth=6, objective="rank:ndcg", seed=1).train(
+        y="rel", training_frame=fr_rk, group_column="qid"))
+    ndcg = m.model_performance(fr_rk, y="rel")
+    record("xgboost_lambdarank", rk_rows * 10 / dt, "rows*trees/s", dt,
+           rows_rank=rk_rows,
+           ndcg10=round(float(ndcg.get("ndcg@10", float("nan"))), 5))
+
+    # config #4: DeepLearning MLP, one pass (model-averaging allreduce)
+    dl_rows = min(rows, 200_000)
+    fr_dl = _higgs_like(dl_rows, seed=2)
+    m, dt = _timed(lambda: DeepLearning(
+        hidden=[64, 64], epochs=1, seed=1).train(
+        y="y", training_frame=fr_dl))
+    record("deeplearning_mlp", dl_rows / dt, "rows/s", dt,
+           rows_dl=dl_rows, hidden=[64, 64])
+
+    # config #4b: Word2Vec skip-gram over a synthetic NA-delimited
+    # corpus (sentence rows; negative-sampling epochs)
+    from h2o_kubernetes_tpu.models import Word2Vec
+
+    n_tok = min(rows // 2, 200_000)
+    vocab = np.array([f"w{i}" for i in range(2000)])
+    toks = vocab[rng.integers(0, 2000, size=n_tok)].astype(object)
+    toks[:: 17] = None                       # sentence breaks
+    fr_w2v = h2o.Frame.from_arrays({"words": np.array(toks)})
+    m, dt = _timed(lambda: Word2Vec(
+        vec_size=32, epochs=1, min_word_freq=2, seed=1).train(fr_w2v))
+    record("word2vec_skipgram", n_tok / dt, "tokens/s", dt,
+           tokens=n_tok, vec_size=32)
+
+    out = {"suite": results, "captured_at":
+           time.strftime("%Y-%m-%dT%H:%M:%S")}
+    path = os.path.join(
+        REPO,
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r04.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"bench_suite": "done", "configs": len(results),
+                      "platform": platform}))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except Exception as e:    # one diagnostic line, never a bare death
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({"bench_suite": "error", "error": repr(e)[:300]}))
+        sys.exit(1)
