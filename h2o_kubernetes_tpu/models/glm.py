@@ -482,7 +482,12 @@ class GLM:
         self.cv_args = CVArgs.pop(kw)
         self.params = GLMParams(**kw)
 
-    def _fit_beta(self, Xe, data, dinfo, lam, beta0, mesh):
+    def _fit_beta(self, Xe, data, dinfo, lam, beta0, mesh,
+                  history=None):
+        """history: optional list collecting one row per IRLS
+        iteration ({iteration, lambda, deviance}) — the GLMScoringInfo
+        analog; the per-iteration deviance float already syncs for the
+        convergence check, so recording it is free."""
         p = self.params
         fam = _famspec(p)
         Pn = dinfo.n_expanded
@@ -511,6 +516,9 @@ class GLM:
             dev = float(dev_new)
             db = float(jnp.max(jnp.abs(beta_new - beta)))
             beta = beta_new
+            if history is not None:
+                history.append({"iteration": len(history) + 1,
+                                "lambda": lam, "deviance": dev})
             if fam.family == "gaussian" and fam.link == "identity" \
                     and lam_l1 == 0 and p.solver == "IRLSM":
                 break                      # exact one-shot solve
@@ -636,22 +644,25 @@ class GLM:
         else:
             lams = [p.lambda_ if p.lambda_ is not None else 0.0]
 
+        history: list[dict] = []
         if p.solver == "L_BFGS":
             beta, dev, iters = self._fit_lbfgs(Xe, data, dinfo,
                                                float(lams[-1]), beta_null,
-                                               mesh)
+                                               mesh, history)
             lam_used = float(lams[-1])
         else:
             beta = beta_null
             dev, iters = null_dev, 0
             for lam in lams:               # warm-started λ path
                 beta, dev, its = self._fit_beta(Xe, data, dinfo,
-                                                float(lam), beta, mesh)
+                                                float(lam), beta, mesh,
+                                                history)
                 iters += its
             lam_used = float(lams[-1])
 
         model = GLMModel(data, p, dinfo, beta, lam_used, null_dev, dev,
                          iters)
+        model.scoring_history = history
         model.offset_column = offset_column
         if p.compute_p_values:
             model._fit_inference(Xe, data, fam, mesh)
@@ -684,6 +695,7 @@ class GLM:
         lam_l2 = lam * (1 - p.alpha)
         lam_l1 = lam * p.alpha
         yw = jnp.stack([data.y, data.w], axis=1)
+        history: list[dict] = []
 
         def dev_fn(B):
             def body(xs, yws, b):
@@ -729,6 +741,8 @@ class GLM:
                         _solve_gram(G, b, B[:, k], lam_l1, lam_l2,
                                     p.solver))
                 v = float(dev_fn(B))
+                history.append({"iteration": it, "lambda": lam,
+                                "deviance": v})
                 if abs(prev - v) < p.objective_epsilon * \
                         (abs(prev) + 1e-10):
                     prev = v
@@ -752,6 +766,8 @@ class GLM:
                 require_healthy()   # fail fast on a dead mesh (§5.3)
                 B, state, value = step(B, state)
                 v = float(value)
+                history.append({"iteration": it, "lambda": lam,
+                                "objective": v})
                 if abs(prev - v) < p.objective_epsilon * \
                         (abs(prev) + 1e-10):
                     break
@@ -759,6 +775,7 @@ class GLM:
             dev = float(dev_fn(B))
 
         model = GLMModel(data, p, dinfo, B, lam, null_dev, dev, it)
+        model.scoring_history = history
         from .cv import finalize_train
 
         return finalize_train(
@@ -767,7 +784,8 @@ class GLM:
              "weights_column": weights_column},
             validation_frame)
 
-    def _fit_lbfgs(self, Xe, data, dinfo, lam, beta0, mesh):
+    def _fit_lbfgs(self, Xe, data, dinfo, lam, beta0, mesh,
+                   history=None):
         import optax
 
         p = self.params
@@ -813,6 +831,9 @@ class GLM:
             require_healthy()   # fail fast on a dead mesh (§5.3)
             beta, state, value = step(beta, state)
             v = float(value)
+            if history is not None:
+                history.append({"iteration": len(history) + 1,
+                                "lambda": lam, "objective": v})
             if abs(prev - v) < p.objective_epsilon * (abs(prev) + 1e-10):
                 break
             prev = v

@@ -373,3 +373,31 @@ def test_glm_multinomial_irlsm_vs_lbfgs(mesh8):
              alpha=0.0, max_iterations=50).train(y="y", training_frame=fr)
     acc = float(np.mean(mr.predict(fr)["predict"].to_numpy() == yk))
     assert acc > 0.55
+
+
+def test_glm_scoring_history(mesh8):
+    """GLM records one row per solver iteration (GLMScoringInfo
+    analog): IRLSM logs deviance, L-BFGS logs objective, and the
+    recorded deviance must be non-increasing for a well-posed fit."""
+    rng = np.random.default_rng(5)
+    n = 2000
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.8, size=n) > 0, "a", "b")
+    fr = Frame.from_arrays({"x": x, "y": y})
+
+    m = GLM(family="binomial", solver="IRLSM", lambda_=0.0).train(
+        y="y", training_frame=fr)
+    h = m.scoring_history
+    assert len(h) == m.n_iterations >= 1
+    devs = [r["deviance"] for r in h]
+    assert all(b <= a + 1e-6 * abs(a) for a, b in zip(devs, devs[1:]))
+
+    m2 = GLM(family="binomial", solver="L_BFGS", lambda_=0.0,
+             max_iterations=25).train(y="y", training_frame=fr)
+    assert m2.scoring_history and "objective" in m2.scoring_history[0]
+
+    y3 = np.where(x > 0.5, "p", np.where(x < -0.5, "q", "r"))
+    fr3 = Frame.from_arrays({"x": x, "y": y3})
+    m3 = GLM(family="multinomial", solver="IRLSM", lambda_=0.0).train(
+        y="y", training_frame=fr3)
+    assert m3.scoring_history and "deviance" in m3.scoring_history[0]
