@@ -360,6 +360,15 @@ class DeepLearning:
                 model.validation_metrics = model.model_performance(
                     validation_frame)
             return model
+        if data.nrows <= 100_000:
+            # final-epoch training metrics (H2O's DL scores a SAMPLE at
+            # intervals — score_training_samples defaults to 10k; here
+            # one full-frame row at train end, skipped past 100k rows
+            # where the extra scoring pass would be felt)
+            perf = model.model_performance(training_frame, y)
+            model.scoring_history = [{
+                "epochs": p.epochs,
+                **{f"train_{k}": v for k, v in perf.items()}}]
         from .cv import finalize_train
 
         return finalize_train(

@@ -100,3 +100,16 @@ def test_dl_checkpoint_epochs_total(mesh8):
     m2 = DeepLearning(hidden=(8,), epochs=4, seed=0,
                       checkpoint=m1).train(y="y", training_frame=fr)
     assert m2 is not None
+
+
+def test_dl_scoring_history(mesh8):
+    rng = np.random.default_rng(7)
+    n = 1200
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+    fr = Frame.from_arrays({"x": x, "y": y})
+    m = DeepLearning(hidden=[8], epochs=3, seed=1).train(
+        y="y", training_frame=fr)
+    assert len(m.scoring_history) == 1
+    row = m.scoring_history[0]
+    assert row["epochs"] == 3 and 0.5 <= row["train_auc"] <= 1.0
