@@ -364,11 +364,16 @@ class DeepLearning:
             # final-epoch training metrics (H2O's DL scores a SAMPLE at
             # intervals — score_training_samples defaults to 10k; here
             # one full-frame row at train end, skipped past 100k rows
-            # where the extra scoring pass would be felt)
+            # where the extra scoring pass would be felt). NA offsets
+            # on live rows make NaN predictions by design (training
+            # dropped those rows) and poison the frame-level metrics —
+            # record only a finite row.
             perf = model.model_performance(training_frame, y)
-            model.scoring_history = [{
-                "epochs": p.epochs,
-                **{f"train_{k}": v for k, v in perf.items()}}]
+            if all(np.isfinite(v) for v in perf.values()
+                   if isinstance(v, (int, float))):
+                model.scoring_history = [{
+                    "epochs": p.epochs,
+                    **{f"train_{k}": v for k, v in perf.items()}}]
         from .cv import finalize_train
 
         return finalize_train(

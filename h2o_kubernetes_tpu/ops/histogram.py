@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.custom_batching import custom_vmap
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # Precision: a plain bf16 multiply loses ~0.4% on the gradient sums, so
 # both kernels reproduce f32 products with THREE explicit bf16 mantissa
@@ -235,6 +236,11 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
         ],
         out_specs=pl.BlockSpec((1, fg, C * n_hi, 128),
                                lambda g, k, rt: (g, 0, 0, 0)),
+        # feature groups write DISTINCT out blocks (parallel — Mosaic
+        # may pipeline them); copies and row blocks ACCUMULATE into the
+        # same block (arbitrary = sequential)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(binned4, rel32, vals)
     # [n_fg, fg, C·n_hi, 128] -> [F, C, n_hi·128] -> [n, F, B, C]
@@ -329,6 +335,10 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int,
             pl.BlockSpec((ROW_TILE, C), lambda f, nb, rt: (rt, 0)),
         ],
         out_specs=pl.BlockSpec((1, C, nbt), lambda f, nb, rt: (f, 0, nb)),
+        # features and bin blocks write distinct out blocks; only the
+        # row-block axis accumulates
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=jax.default_backend() != "tpu",
     )(binned_flat, rel32, vals)
     # [F, C, n*B] -> [n, F, B, C]
