@@ -70,7 +70,28 @@ def main() -> None:
     # warm-up with the SAME ntrees: the fused boosting loop compiles a
     # scan whose length is the tree count, so a shorter warm-up would
     # leave the timed run paying a fresh XLA compile
-    run(ntrees)
+    try:
+        run(ntrees)
+    except Exception:
+        # a KERNEL-COMPILE regression must degrade, not zero, the
+        # scoreboard: drop the grid dimension_semantics annotation
+        # (the one compile-affecting knob CPU CI cannot validate) and
+        # retry once. Non-compile failures (OOM, bad data, mesh
+        # health) re-raise immediately — retrying them doubles
+        # time-to-failure for no possible gain.
+        from h2o_kubernetes_tpu.ops import histogram as H
+
+        err = traceback.format_exc()
+        compileish = any(s in err for s in (
+            "Mosaic", "mosaic", "pallas", "vmem", "remote_compile"))
+        if not H._DIMSEM or not compileish:
+            raise
+        traceback.print_exc()
+        print("warm-up failed; retrying without dimension_semantics",
+              file=sys.stderr)
+        H._DIMSEM = False
+        jax.clear_caches()
+        run(ntrees)
     t0 = time.perf_counter()
     run(ntrees)
     dt = time.perf_counter() - t0

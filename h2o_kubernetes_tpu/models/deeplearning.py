@@ -360,20 +360,22 @@ class DeepLearning:
                 model.validation_metrics = model.model_performance(
                     validation_frame)
             return model
-        if data.nrows <= 100_000:
+        # NA offsets make NaN predictions by design (training dropped
+        # those rows) and would poison frame-level metrics — skip the
+        # history row ONLY for that case; legitimately-NaN metrics on
+        # degenerate frames (constant-response r2 etc.) still record
+        off_has_na = offset_column is not None and bool(np.isnan(
+            np.asarray(training_frame.vec(offset_column).as_float(),
+                       dtype=np.float32)).any())
+        if data.nrows <= 100_000 and not off_has_na:
             # final-epoch training metrics (H2O's DL scores a SAMPLE at
             # intervals — score_training_samples defaults to 10k; here
             # one full-frame row at train end, skipped past 100k rows
-            # where the extra scoring pass would be felt). NA offsets
-            # on live rows make NaN predictions by design (training
-            # dropped those rows) and poison the frame-level metrics —
-            # record only a finite row.
+            # where the extra scoring pass would be felt)
             perf = model.model_performance(training_frame, y)
-            if all(np.isfinite(v) for v in perf.values()
-                   if isinstance(v, (int, float))):
-                model.scoring_history = [{
-                    "epochs": p.epochs,
-                    **{f"train_{k}": v for k, v in perf.items()}}]
+            model.scoring_history = [{
+                "epochs": p.epochs,
+                **{f"train_{k}": v for k, v in perf.items()}}]
         from .cv import finalize_train
 
         return finalize_train(
