@@ -37,6 +37,21 @@ from ..runtime.mrtask import doall, shard_rows
 NA_ENUM = -1  # NA/pad sentinel for enum codes
 
 
+def _rollup_map(x):
+    """Per-shard rollup stats (module-level so doall can cache the
+    jitted callable across Vecs — CV fold frames re-derive rollups)."""
+    ok = ~jnp.isnan(x)
+    xz = jnp.where(ok, x, 0.0)
+    return dict(
+        cnt=jnp.sum(ok, dtype=jnp.float32),
+        sum=jnp.sum(xz, dtype=jnp.float32),
+        sumsq=jnp.sum(xz * xz),
+        min=jnp.min(jnp.where(ok, x, jnp.inf)),
+        max=jnp.max(jnp.where(ok, x, -jnp.inf)),
+        zeros=jnp.sum(ok & (x == 0.0), dtype=jnp.float32),
+    )
+
+
 class Vec:
     """One column: a row-sharded device array plus host-side metadata."""
 
@@ -137,20 +152,10 @@ class Vec:
         else:
             col = self.data.astype(jnp.float32)
 
-        def m(x):
-            ok = ~jnp.isnan(x)
-            xz = jnp.where(ok, x, 0.0)
-            return dict(
-                cnt=jnp.sum(ok, dtype=jnp.float32),
-                sum=jnp.sum(xz, dtype=jnp.float32),
-                sumsq=jnp.sum(xz * xz),
-                min=jnp.min(jnp.where(ok, x, jnp.inf)),
-                max=jnp.max(jnp.where(ok, x, -jnp.inf)),
-                zeros=jnp.sum(ok & (x == 0.0), dtype=jnp.float32),
-            )
-
-        r = doall(m, col, reduce=dict(cnt="sum", sum="sum", sumsq="sum",
-                                      min="min", max="max", zeros="sum"))
+        r = doall(_rollup_map, col,
+                  reduce=dict(cnt="sum", sum="sum", sumsq="sum",
+                              min="min", max="max", zeros="sum"),
+                  cache_key="vec_rollups")
         r = {k: float(v) for k, v in r.items()}
         n = r["cnt"]
         mean = r["sum"] / n if n > 0 else float("nan")

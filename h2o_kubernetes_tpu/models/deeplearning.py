@@ -360,14 +360,21 @@ class DeepLearning:
                 model.validation_metrics = model.model_performance(
                     validation_frame)
             return model
-        # NA offsets make NaN predictions by design (training dropped
-        # those rows) and would poison frame-level metrics — skip the
-        # history row ONLY for that case; legitimately-NaN metrics on
-        # degenerate frames (constant-response r2 etc.) still record
-        off_has_na = offset_column is not None and bool(np.isnan(
-            np.asarray(training_frame.vec(offset_column).as_float(),
-                       dtype=np.float32)).any())
-        if data.nrows <= 100_000 and not off_has_na:
+        def _off_has_na():
+            # NA offsets make NaN predictions by design (training
+            # dropped those rows) and would poison frame-level metrics
+            # — skip the history row ONLY for that case;
+            # legitimately-NaN metrics on degenerate frames
+            # (constant-response r2 etc.) still record. Slice to nrows:
+            # as_float() keeps shard-pad rows, which are NaN by design.
+            if offset_column is None:
+                return False
+            off = np.asarray(
+                training_frame.vec(offset_column).as_float(),
+                dtype=np.float32)[: data.nrows]
+            return bool(np.isnan(off).any())
+
+        if data.nrows <= 100_000 and not _off_has_na():
             # final-epoch training metrics (H2O's DL scores a SAMPLE at
             # intervals — score_training_samples defaults to 10k; here
             # one full-frame row at train end, skipped past 100k rows

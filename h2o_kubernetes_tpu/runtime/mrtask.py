@@ -36,18 +36,51 @@ _REDUCERS = {
 }
 
 
+# jitted doall callables, per mesh (weak: a replaced mesh's entries die
+# with it) then by (cache_key, map_fn, reduce-structure, donate).
+# jax.jit keys its executable cache on the CALLABLE's identity, so the
+# fresh `body` closure each doall() call builds means a fresh
+# trace+compile even for byte-identical computations — CV fold frames
+# re-deriving rollups paid ~25 warm recompiles per AutoML run. Callers
+# whose map_fn is a stable module-level function opt in with
+# `cache_key`; per-shape retracing inside one cached callable is jit's
+# normal behavior.
+import weakref
+
+_DOALL_CACHE: "weakref.WeakKeyDictionary[Mesh, dict]" = \
+    weakref.WeakKeyDictionary()
+
+
+def _freeze(reduce) -> Any:
+    leaves, treedef = jax.tree.flatten(reduce)
+    return tuple(leaves), str(treedef)
+
+
 def doall(map_fn: Callable[..., Any], *cols: jax.Array,
           reduce: Any = "sum", mesh: Mesh | None = None,
-          donate: bool = False) -> Any:
+          donate: bool = False, cache_key: Any = None) -> Any:
     """Map `map_fn` over aligned row-shards of `cols`, reduce across shards.
 
     Returns the fully reduced pytree, replicated on every device (like
     `MRTask.getResult()` returning the reduced task object to the caller).
+
+    `cache_key`: opt-in reuse of the jitted callable across calls (the
+    caller asserts map_fn's computation is fully determined by the key,
+    the reduce spec, and the operand shapes).
     """
     from .health import require_healthy
 
     require_healthy()     # fail fast on a broken cloud (SURVEY.md §5.3)
     mesh = mesh or global_mesh()
+
+    if cache_key is not None:
+        # map_fn identity in the key: two callers sharing a cache_key
+        # string with different (module-level) map_fns must not get
+        # each other's computation
+        key = (cache_key, map_fn, _freeze(reduce), donate)
+        cached = _DOALL_CACHE.get(mesh, {}).get(key)
+        if cached is not None:
+            return cached(*cols)
 
     def body(*shards):
         out = map_fn(*shards)
@@ -68,7 +101,11 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
         lambda _, r: P(ROWS) if r == "none" else P(), res, reds)
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=P(ROWS), out_specs=out_specs)
-    return jax.jit(fn, donate_argnums=tuple(range(len(cols))) if donate else ())(*cols)
+    jfn = jax.jit(fn, donate_argnums=tuple(range(len(cols)))
+                  if donate else ())
+    if cache_key is not None:
+        _DOALL_CACHE.setdefault(mesh, {})[key] = jfn
+    return jfn(*cols)
 
 
 @functools.lru_cache(maxsize=None)

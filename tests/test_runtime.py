@@ -142,3 +142,39 @@ def test_env_config_validation(monkeypatch):
     assert C.CONFIG["nbins"] == 64
     monkeypatch.delenv("H2O_TPU_NBINS")
     C.CONFIG["nbins"] = 256          # restore the default for the suite
+
+
+def test_doall_cache_key_reuses_jit(mesh8):
+    """cache_key makes repeated same-computation doall calls reuse one
+    jitted callable — rollups across CV fold frames must not recompile
+    (an AutoML run paid ~25 warm recompiles before this)."""
+    import logging
+
+    import jax
+
+    from h2o_kubernetes_tpu.frame.frame import Frame
+
+    rng = np.random.default_rng(0)
+    fr1 = Frame.from_arrays({"a": rng.normal(size=500).astype(np.float32)})
+    fr1.vec("a").rollups()            # warm the cached callable
+
+    msgs = []
+
+    class H(logging.Handler):
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                msgs.append(record.getMessage())
+
+    h = H()
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(h)
+    try:
+        # same shape, different Vec object: zero new compiles
+        fr2 = Frame.from_arrays(
+            {"b": rng.normal(size=500).astype(np.float32)})
+        r = fr2.vec("b").rollups()
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        logging.getLogger("jax").removeHandler(h)
+    assert msgs == [], msgs
+    assert np.isfinite(r["mean"])
