@@ -92,8 +92,10 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
     `feat_ok`: optional [n_nodes, F] bool mask of allowed features
     (per-tree column sampling and DRF per-node mtries).
     Returns (feat, bin, na_left, can_split, node_value, best_gain,
-    cover) per node — cover is the node's total weight mass (TreeSHAP's
-    r_j).
+    cover, left, right) per node — cover is the node's total weight
+    mass (TreeSHAP's r_j); left/right are the chosen split's side
+    totals [n, 3] (== the children's node totals, NA side applied),
+    which the grower uses as the final level's leaf stats.
     """
     nb = hist.shape[2]
     na = hist[:, :, nb - 1, :]                 # [n, F, 3]
@@ -131,11 +133,24 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
     na_l = jnp.take_along_axis(
         na_left_better.reshape(n_nodes, -1), best[:, None], 1)[:, 0]
 
+    # (G, H, C) of the chosen split's LEFT side (NA routed per na_l):
+    # these ARE the left child's node totals, and right = parent-left —
+    # the grower derives the final level's leaf stats from them instead
+    # of paying one more full-row histogram pass per tree
+    def pick(left4):                                   # [n, F, B-1, 3]
+        return jnp.take_along_axis(
+            left4.reshape(n_nodes, F * (nb - 1), 3),
+            best[:, None, None], 1)[:, 0]              # [n, 3]
+    left = jnp.where(na_l[:, None], pick(cum + na[:, :, None, :]),
+                     pick(cum))
+    right = totn[:, 0, :] - left
+
     G, H, C = totn[:, 0, 0], totn[:, 0, 1], totn[:, 0, 2]
     can_split = (best_gain > p.gamma) & (C >= 2 * p.min_rows) & \
         jnp.isfinite(best_gain)
     value = _leaf_value(G, H, p)
-    return feat, bin_, na_l, can_split, value, best_gain, C
+    return (feat, bin_, na_l, can_split, value, best_gain, C,
+            left, right)
 
 
 def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
@@ -165,21 +180,30 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         n_nodes = 2 ** d
         off = n_nodes - 1
         if d == p.max_depth:
-            # final level: every node is a forced leaf, so per-node
-            # (G, H, C) totals suffice — building the full [n, F, B, 3]
-            # histogram here would be HALF the tree's matmul work (the
-            # deepest level's Nhi equals the sum of all shallower
-            # levels') for data _find_splits immediately collapses to
-            # totals. One single-bin histogram = one [3,T]x[T,128] pass.
-            zero_bin = jnp.zeros((binned.shape[0], 1),
-                                 dtype=binned.dtype)
-            tot = _build_histogram_op(zero_bin, rel, g, h, w, n_nodes,
-                                      1, impl=p.hist_impl,
-                                      unit_hess=p.unit_hess)
-            tot = lax.psum(tot, ROWS)                   # 2- or 3-channel
-            if p.unit_hess:
-                tot = _expand_unit_hess(tot)
-            tot = tot[:, 0, 0, :]                       # [n_nodes, 3]
+            # final level: every node is a forced leaf, and its
+            # (G, H, C) totals are EXACTLY the parent's chosen-split
+            # side stats (same rows, NA routing included) — already in
+            # hand from _find_splits at the previous level. Rounds 2-3
+            # built a histogram here (full at first — half the tree's
+            # matmul work — then single-bin); now it costs NOTHING:
+            # no row-stream pass, no psum.
+            if d == 0:
+                # depth-0 stump: no parent level exists — one
+                # single-bin pass for the root totals
+                zero_bin = jnp.zeros((binned.shape[0], 1),
+                                     dtype=binned.dtype)
+                tot = _build_histogram_op(zero_bin, rel, g, h, w, 1, 1,
+                                          impl=p.hist_impl,
+                                          unit_hess=p.unit_hess)
+                tot = lax.psum(tot, ROWS)
+                if p.unit_hess:
+                    tot = _expand_unit_hess(tot)
+                tot = tot[:, 0, 0, :]
+            else:
+                tot = jnp.where(can_prev[:, None, None],
+                                jnp.stack([left_prev, right_prev],
+                                          axis=1),
+                                0.0).reshape(n_nodes, 3)  # child order
             idx = off + jnp.arange(n_nodes)
             value = value.at[idx].set(
                 _leaf_value(tot[:, 0], tot[:, 1], p))
@@ -222,8 +246,8 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             r = jnp.where(feat_ok, r, jnp.inf)
             kth = jnp.sort(r, axis=1)[:, p.mtries - 1: p.mtries]
             feat_ok = feat_ok & (r <= kth)
-        feat, bin_, na_l, can, val, g_best, cov = _find_splits(hist, p,
-                                                               feat_ok)
+        (feat, bin_, na_l, can, val, g_best, cov, left_ch,
+         right_ch) = _find_splits(hist, p, feat_ok)
         idx = off + jnp.arange(n_nodes)
         split_feat = split_feat.at[idx].set(jnp.where(can, feat, -1))
         split_bin = split_bin.at[idx].set(bin_)
@@ -233,6 +257,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         gain = gain.at[idx].set(jnp.where(can, g_best, 0.0))
         cover = cover.at[idx].set(cov)
         hist_prev, can_prev = hist, can
+        left_prev, right_prev = left_ch, right_ch
         # descend rows: dead rows stay dead; rows in non-split nodes die
         live = rel >= 0
         safe_rel = jnp.where(live, rel, 0)
