@@ -50,6 +50,41 @@ def backends_initialized() -> bool:
         return False
 
 
+def enable_persistent_compile_cache() -> None:
+    """Point jax's persistent compilation cache at a repo-local dir.
+
+    Every capture tool runs in its own subprocess, so without this each
+    one re-pays every XLA compile — and on the tunneled chip a compile
+    is a remote round trip. The disk cache keys on hardware + HLO, so
+    cross-process reuse is exact; bench warm-up/AutoML cold paths drop
+    from minutes of compiles to reads.
+
+    Never IMPORTS jax (preserving this module's never-hang contract —
+    the probe must run before any backend touch): env vars cover a
+    not-yet-imported jax, and when jax IS already imported (its config
+    no longer reads env) the config is updated through sys.modules,
+    which touches no backend. Fully a no-op when the user already set
+    JAX_COMPILATION_CACHE_DIR (their cache policy wins)."""
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return
+    try:
+        cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "tools", "_jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+        # cache everything (default only caches >1s compiles)
+        os.environ.setdefault(
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+        j = sys.modules.get("jax")
+        if j is not None:
+            j.config.update("jax_compilation_cache_dir", cache_dir)
+            j.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:   # noqa: BLE001 — acceleration only, never fatal
+        pass
+
+
 def ensure_live_backend(timeout: float = 90.0,
                         budget: float | None = None) -> str:
     """Probe default-backend init in a throwaway subprocess; pin this
@@ -68,6 +103,7 @@ def ensure_live_backend(timeout: float = 90.0,
     ``H2O_TPU_PROBE_BUDGET`` (seconds; 0 disables probing retries and
     falls back to CPU after one attempt's failure).
     """
+    enable_persistent_compile_cache()
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         return "cpu"
     if "jax" in sys.modules:
