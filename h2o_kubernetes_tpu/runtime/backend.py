@@ -79,8 +79,11 @@ def enable_persistent_compile_cache() -> None:
         j = sys.modules.get("jax")
         if j is not None:
             j.config.update("jax_compilation_cache_dir", cache_dir)
+            # post-setdefault value: a user-exported threshold wins
             j.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 0.0)
+                "jax_persistent_cache_min_compile_time_secs",
+                float(os.environ[
+                    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
     except Exception:   # noqa: BLE001 — acceleration only, never fatal
         pass
 

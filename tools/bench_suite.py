@@ -98,11 +98,38 @@ def main() -> int:
     record("xgboost_hist", rows * ntrees / dt, "rows*trees/s", dt,
            ntrees=ntrees, max_depth=6)
 
-    # config #3b: lambdarank (MSLR-WEB30K shape — graded relevance over
-    # query groups, rank:ndcg LambdaMART)
+    # multinomial GBM: K class trees per round through the
+    # class-flattened batching rule (custom_vmap lowers the class axis
+    # into the node axis — the round-4 Mosaic fix; K x fuller MXU M)
     import numpy as np
 
     import h2o_kubernetes_tpu as h2o
+
+    from h2o_kubernetes_tpu.models import GBM
+
+    mn_rows = min(rows, 500_000)
+    rngm = np.random.default_rng(3)
+    Xm = rngm.normal(size=(mn_rows, 10)).astype(np.float32)
+    score = Xm[:, 0] + 0.5 * Xm[:, 1]
+    ym = np.where(score > 0.6, "a",
+                  np.where(score < -0.6, "b",
+                           np.where(Xm[:, 2] > 0, "c", "d")))
+    mcols = {f"f{i}": Xm[:, i] for i in range(10)}
+    mcols["y"] = ym
+    fr_mn = h2o.Frame.from_arrays(mcols)
+    mn_ntrees = 5
+    m, dt = _timed(lambda: GBM(
+        ntrees=mn_ntrees, max_depth=5, learn_rate=0.2, seed=1).train(
+        y="y", training_frame=fr_mn))
+    record("gbm_multinomial", mn_rows * mn_ntrees * m.nclasses / dt,
+           "rows*classtrees/s", dt, rows_mn=mn_rows,
+           classes=m.nclasses,
+           logloss=round(float(
+               m.scoring_history[-1].get("train_logloss",
+                                         float("nan"))), 5))
+
+    # config #3b: lambdarank (MSLR-WEB30K shape — graded relevance over
+    # query groups, rank:ndcg LambdaMART)
 
     rk_rows = min(rows, 200_000)
     rng = np.random.default_rng(4)
