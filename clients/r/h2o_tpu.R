@@ -147,6 +147,20 @@ h2o.varimp <- function(model) {
              relative_importance = unlist(vi, use.names = FALSE))
 }
 
+h2o.download_mojo <- function(model, path = NULL) {
+  id <- if (inherits(model, "H2OTpuModel")) model$model_id else model
+  if (is.null(path)) path <- paste0(id, ".mojo")
+  # -f: an HTTP error must fail the call, not write the JSON error
+  # body into the artifact file
+  args <- c("-s", "-f", "-o", path,
+            .h2o.url(paste0("/3/Models/", utils::URLencode(id),
+                            "/mojo")))
+  status <- system2("curl", shQuote(args))
+  if (status != 0 || !file.exists(path))
+    stop("mojo download failed for ", id)
+  invisible(path)
+}
+
 h2o.predict <- function(model, frame_id) {
   id <- if (inherits(model, "H2OTpuModel")) model$model_id else model
   out <- .h2o.http(

@@ -197,7 +197,8 @@ class _Handler(BaseHTTPRequestHandler):
 
                 return self._json({"events": timeline.events()})
             if path.startswith("/3/AutoML/"):
-                key = path[len("/3/AutoML/"):]
+                key = urllib.parse.unquote(
+                    path[len("/3/AutoML/"):])
                 if key not in AUTOML:
                     return self._error(404, f"automl '{key}' not found")
                 aml = AUTOML[key]
@@ -210,7 +211,8 @@ class _Handler(BaseHTTPRequestHandler):
                     "sort_metric": aml.leaderboard.sort_metric
                     if aml.leaderboard else None})
             if path.startswith("/99/Grids/"):
-                key = path[len("/99/Grids/"):]
+                key = urllib.parse.unquote(
+                    path[len("/99/Grids/"):])
                 if key not in GRIDS:
                     return self._error(404, f"grid '{key}' not found")
                 g = GRIDS[key]
@@ -228,6 +230,7 @@ class _Handler(BaseHTTPRequestHandler):
             if path.startswith("/3/Frames/"):
                 rest = path[len("/3/Frames/"):]
                 key, _, verb = rest.partition("/")
+                key = urllib.parse.unquote(key)
                 if key not in FRAMES:
                     return self._error(404, f"frame '{key}' not found")
                 fr = FRAMES[key]
@@ -240,10 +243,37 @@ class _Handler(BaseHTTPRequestHandler):
                     {"model_id": {"name": k}, "algo": m.algo}
                     for k, m in MODELS.items()]})
             if path.startswith("/3/Models/"):
-                key = path[len("/3/Models/"):]
+                rest_part = path[len("/3/Models/"):]
+                key, _, verb = rest_part.partition("/")
+                key = urllib.parse.unquote(key)
                 if key not in MODELS:
                     return self._error(404, f"model '{key}' not found")
                 m = MODELS[key]
+                if verb == "mojo":
+                    # artifact download (h2o-py model.download_mojo via
+                    # GET /3/Models/{id}/mojo [U3])
+                    import os
+                    import tempfile
+
+                    from .mojo import export_mojo
+
+                    with tempfile.TemporaryDirectory() as td:
+                        p = export_mojo(m, os.path.join(
+                            td, f"{key}.mojo"))
+                        with open(p, "rb") as f:
+                            blob = f.read()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header(
+                        "Content-Disposition",
+                        f'attachment; filename="{key}.mojo"')
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return None
+                if verb:
+                    return self._error(404, f"no route for GET {path}")
                 cvm = getattr(m, "cross_validation_metrics", None)
                 out = {"model_id": {"name": key},
                        "algo": m.algo,
@@ -301,6 +331,8 @@ class _Handler(BaseHTTPRequestHandler):
             if path.startswith("/3/Predictions/models/"):
                 rest = path[len("/3/Predictions/models/"):]
                 mkey, _, fpart = rest.partition("/frames/")
+                mkey = urllib.parse.unquote(mkey)
+                fpart = urllib.parse.unquote(fpart)
                 if mkey not in MODELS:
                     return self._error(404, f"model '{mkey}' not found")
                 if fpart not in FRAMES:
@@ -319,13 +351,13 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             path = urllib.parse.urlparse(self.path).path.rstrip("/")
             if path.startswith("/3/Frames/"):
-                key = path[len("/3/Frames/"):]
+                key = urllib.parse.unquote(path[len("/3/Frames/"):])
                 if FRAMES.pop(key, None) is None:
                     return self._error(404, f"frame '{key}' not found")
                 return self._json({"frame_id": {"name": key},
                                    "removed": True})
             if path.startswith("/3/Models/"):
-                key = path[len("/3/Models/"):]
+                key = urllib.parse.unquote(path[len("/3/Models/"):])
                 if MODELS.pop(key, None) is None:
                     return self._error(404, f"model '{key}' not found")
                 return self._json({"model_id": {"name": key},

@@ -276,3 +276,30 @@ def test_nan_metrics_serialize_as_null(server):
         assert got["validation_metrics"]["auc"] is None
     finally:
         rest.MODELS.pop("nan_model", None)
+
+
+def test_mojo_download_route(server, tmp_path):
+    """GET /3/Models/{id}/mojo streams a loadable artifact (h2o-py's
+    download_mojo surface)."""
+    import urllib.error
+
+    _mkframe(server, tmp_path, n=300, name="mojotrain")
+    _post_json(server, "/3/ModelBuilders/gbm", {
+        "training_frame": "mojotrain", "response_column": "y",
+        "model_id": "mojo_gbm", "ntrees": 3, "max_depth": 3})
+    with urllib.request.urlopen(
+            server + "/3/Models/mojo_gbm/mojo", timeout=120) as r:
+        assert r.headers["Content-Type"] == "application/octet-stream"
+        blob = r.read()
+    assert len(blob) > 100
+    p = tmp_path / "dl.mojo"
+    p.write_bytes(blob)
+    mj = h2o.import_mojo(str(p))
+    assert mj.predict is not None
+    # unknown sub-verb stays a clean 404
+    try:
+        urllib.request.urlopen(server + "/3/Models/mojo_gbm/nope",
+                               timeout=30)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
