@@ -303,3 +303,27 @@ def test_mojo_download_route(server, tmp_path):
         raise AssertionError("expected 404")
     except urllib.error.HTTPError as e:
         assert e.code == 404
+
+
+def test_encoded_keys_across_routes(server):
+    """Registry keys are percent-decoded on the Frames GET/summary/
+    DELETE routes and the Models detail route — clients URL-encode ids
+    (the R client always does)."""
+    rng = np.random.default_rng(1)
+    fr = h2o.Frame.from_arrays(
+        {"x": rng.normal(size=100).astype(np.float32)})
+    rest.FRAMES["my frame.hex"] = fr
+    got = _get(server, "/3/Frames/my%20frame.hex")
+    assert got["frame_id"]["name"] == "my frame.hex"
+    got = _get(server, "/3/Frames/my%20frame.hex/summary")
+    assert "x" in got["summary"]
+    _delete(server, "/3/Frames/my%20frame.hex")
+    assert "my frame.hex" not in rest.FRAMES
+    rest.MODELS["enc model"] = type("M", (), {
+        "algo": "gbm", "nclasses": 2, "scoring_history": [],
+        "validation_metrics": None})()
+    try:
+        got = _get(server, "/3/Models/enc%20model")
+        assert got["model_id"]["name"] == "enc model"
+    finally:
+        rest.MODELS.pop("enc model", None)
