@@ -30,7 +30,16 @@ def init(coordinator: str | None = None, **kw) -> None:
     On TPU the 'cluster' is the pod slice this process can see; multi-host
     formation goes through the JAX distributed runtime using env injected
     by the operator (see runtime/mesh.py).
+
+    Also points JAX's persistent compilation cache at a per-user dir
+    (unless the user already set JAX_COMPILATION_CACHE_DIR): a cold
+    AutoML run is otherwise dominated by XLA compiles, and on the
+    tunneled chip each one is a remote round trip — the disk cache
+    keys on hardware+HLO, so a SECOND process pays none of them.
     """
+    from .runtime.backend import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
     initialize_distributed(coordinator, **kw)
     global_mesh()
 

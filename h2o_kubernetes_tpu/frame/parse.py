@@ -912,6 +912,15 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
     nas = setup["na_strings"]
     ncol = len(names)
 
+    if _arrow_csv_eligible(setup, names, types):
+        try:
+            return _import_csv_arrow(setup, names, types, skipped)
+        except Exception:
+            # the pure-Python path below DEFINES the parse semantics;
+            # anything arrow rejects (ragged rows, unparseable floats,
+            # exotic quoting) re-parses there
+            pass
+
     raw: list[list[str]] = [[] for _ in range(ncol)]
     for fi, fp in enumerate(setup["files"]):
         with _open_text(fp) as f:
@@ -945,6 +954,144 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
             continue
         vecs[name] = _materialize(raw[c], typ, name, nas)
     return Frame(vecs)
+
+
+def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
+                      skipped: set[str]) -> Frame:
+    """10M-row-capable CSV fast path: pyarrow's multithreaded C++ CSV
+    reader does tokenizing + numeric conversion, our preview pass keeps
+    type-inference semantics (the reference's analog is the
+    chunk-parallel ParseDataset over NewChunks, water/parser/ [U3] —
+    here the chunk parallelism lives inside arrow's reader).
+
+    Eligibility is decided by the caller; any arrow-level failure
+    (ragged rows, unparseable numerics, unsupported codec) raises and
+    the caller falls back to the pure-Python path, which defines the
+    parse semantics."""
+    import pyarrow as pa
+    import pyarrow.csv as pacsv
+
+    nas = setup["na_strings"]
+    # arrow null matching is exact; cover the case variants of our
+    # lowercase token set (the slow path lowercases before comparing)
+    null_values = sorted({v for t in nas for v in
+                          (t, t.upper(), t.capitalize(), t.title())})
+    col_types: dict[str, pa.DataType] = {}
+    time_cols = []
+    for name, typ in zip(names, types):
+        if typ == "numeric":
+            col_types[name] = pa.float32()
+        else:
+            # enum AND time columns land as strings; time parsing uses
+            # the shared _parse_time_ms formats host-side (rare columns
+            # — the 10M-row cost is numeric/enum, which stay in C++)
+            col_types[name] = pa.string()
+            if typ == "time":
+                time_cols.append(name)
+
+    tables = []
+    for fi, fp in enumerate(setup["files"]):
+        # arrow's skip_rows counts PHYSICAL lines while the slow path
+        # skips blank lines anywhere — count the leading blank/
+        # whitespace-only lines so the header row is the one skipped
+        blanks = 0
+        with _open_text(fp) as f:
+            for ln in f:
+                if ln.strip():
+                    break
+                blanks += 1
+        skip = blanks
+        if setup["header"]:
+            if fi == 0:
+                skip += 1
+            else:
+                # later files may be headerless continuations (same
+                # check as the slow path): drop the first record only
+                # when it repeats the header
+                with _open_text(fp) as f:
+                    first = next(_read_records(f, limit=1), None)
+                if first is not None and [
+                        t.strip() for t in
+                        _split_line(first, setup["sep"])] == setup["names"]:
+                    skip += 1
+        # pa.input_stream decompresses gz/bz2 by extension; xz is
+        # rejected by the caller's eligibility check
+        with pa.input_stream(fp, compression="detect") as stream:
+            tables.append(pacsv.read_csv(
+                stream,
+                read_options=pacsv.ReadOptions(
+                    column_names=names, skip_rows=skip,
+                    block_size=16 << 20),
+                parse_options=pacsv.ParseOptions(
+                    delimiter=setup["sep"], newlines_in_values=True),
+                convert_options=pacsv.ConvertOptions(
+                    column_types=col_types, null_values=null_values,
+                    strings_can_be_null=True,
+                    quoted_strings_can_be_null=False,
+                    # drop skipped columns inside the reader — at 10M
+                    # rows their C++ conversion is real money
+                    include_columns=[n for n in names
+                                     if n not in skipped])))
+    table = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+
+    vecs: dict[str, Vec] = {}
+    for name, typ in zip(names, types):
+        if name in skipped:
+            continue
+        col = table.column(name).combine_chunks()
+        if typ == "numeric":
+            a = col.to_numpy(zero_copy_only=False)
+            vecs[name] = Vec.from_numpy(
+                np.asarray(a, dtype=np.float32), name)
+        elif name in time_cols:
+            vals = ["" if v is None else v for v in col.to_pylist()]
+            vecs[name] = _materialize(vals, "time", name, nas)
+        else:
+            enc = col.dictionary_encode()
+            dom_raw = [str(v) for v in enc.dictionary.to_pylist()]
+            codes = enc.indices.to_numpy(zero_copy_only=False)
+            codes = np.where(np.isnan(codes.astype(np.float64)), -1,
+                             np.nan_to_num(codes.astype(np.float64),
+                                           nan=-1)).astype(np.int64)
+            # arrow keeps surrounding whitespace and matches NA tokens
+            # exactly; re-apply the slow path's strip + lowercase-NA
+            # semantics on the (small) dictionary, not the rows
+            stripped = [s.strip() for s in dom_raw]
+            keep = sorted({s for s in stripped
+                           if s.lower() not in nas})
+            order = {tok: i for i, tok in enumerate(keep)}
+            remap = np.empty(len(dom_raw) + 1, dtype=np.int32)
+            remap[-1] = NA_ENUM
+            for old, tok in enumerate(stripped):
+                remap[old] = order.get(tok, NA_ENUM)
+            vecs[name] = Vec.from_numpy(remap[codes], name, domain=keep)
+    return Frame(vecs)
+
+
+def _arrow_csv_eligible(setup: dict, names: list[str],
+                        types: list[str]) -> bool:
+    """The fast path must only run where it reproduces the slow path's
+    semantics: single-char separator, no xz/lzma (arrow can't detect
+    it), pyarrow importable, and not disabled via env."""
+    if os.environ.get("H2O_TPU_ARROW_CSV", "1") == "0":
+        return False
+    # whitespace-only lines are records to arrow but skipped by the
+    # slow path; with >= 2 columns they raise a column-count error and
+    # fall back, but a 1-column frame (or space separator) would
+    # silently grow NA rows instead
+    if len(names) < 2 or setup["sep"] == " ":
+        return False
+    if len(setup["sep"]) != 1:
+        return False
+    if any(f.lower().endswith((".xz", ".lzma")) for f in setup["files"]):
+        return False
+    if len(set(names)) != len(names):
+        return False
+    try:
+        import pyarrow.csv  # noqa: F401
+    except ImportError:
+        return False
+    return True
 
 
 def _norm_type(t: str) -> str:

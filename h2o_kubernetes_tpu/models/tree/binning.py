@@ -11,8 +11,8 @@ literature (PAPERS.md: XGBoost GPU, Booster) rather than the Java design.
 
 Layout: B total bins per feature. Bin B-1 is reserved for NA. Numeric
 features use quantile edges (≤ B-2 finite bins); categorical features
-use their codes directly (cardinality must be ≤ B-1, else the column is
-target-encoding territory — round 1 raises).
+use their codes directly; past B-1 levels, contiguous code ranges share
+bins (the reference's DHistogram grouping past nbins_cats [U3]).
 """
 
 from __future__ import annotations
@@ -109,14 +109,25 @@ def fit_bins(frame, feature_names: list[str],
     is_enum: list[bool] = []
     num_idx: list[int] = []
     num_cols = []
+    ovf_idx: list[int] = []
+    ovf_card: list[int] = []
     for name in feature_names:
         v = frame.vec(name)
         if v.is_enum():
             card = v.cardinality()
             if card > n_bins - 1:
-                raise ValueError(
-                    f"categorical '{name}' has {card} levels > {n_bins - 1}; "
-                    "reduce cardinality or raise n_bins")
+                # high-cardinality categorical (airlines Origin/Dest is
+                # ~300): group contiguous CODE RANGES into the B-2
+                # finite bins — the same range grouping the reference's
+                # DHistogram applies to categoricals past nbins_cats
+                # ([U3] hex/tree/DHistogram). Expressed through the
+                # numeric searchsorted path (is_enum=False + synthetic
+                # edges between ranges); NA codes arrive as NaN from
+                # as_float and land in the NA bin as usual.
+                ovf_idx.append(len(is_enum))
+                ovf_card.append(card)
+                is_enum.append(False)
+                continue
             is_enum.append(True)
             continue
         num_idx.append(len(is_enum))
@@ -131,6 +142,12 @@ def fit_bins(frame, feature_names: list[str],
         Q = jnp.where(jnp.isnan(Q), jnp.inf, Q.astype(jnp.float32))
         M = M.at[jnp.asarray(num_idx, dtype=jnp.int32),
                  : n_bins - 3].set(Q)
+    for fi, card in zip(ovf_idx, ovf_card):
+        # n_bins-3 edges split the code space [0, card) into n_bins-2
+        # near-equal ranges; the -0.5 puts each edge BETWEEN codes
+        e = (np.arange(1, n_bins - 2, dtype=np.float32)
+             * card / (n_bins - 2)) - 0.5
+        M = M.at[fi, : n_bins - 3].set(jnp.asarray(e))
     return BinSpec(names=list(feature_names), edges=None,
                    is_enum=is_enum, n_bins=n_bins, edges_dev=M)
 

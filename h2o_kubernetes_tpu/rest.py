@@ -257,17 +257,24 @@ class _Handler(BaseHTTPRequestHandler):
 
                     from .mojo import export_mojo
 
+                    # fixed artifact name inside the tempdir: model keys
+                    # come verbatim from POST bodies, so using them as a
+                    # path component would allow ../ traversal out of td
                     with tempfile.TemporaryDirectory() as td:
-                        p = export_mojo(m, os.path.join(
-                            td, f"{key}.mojo"))
+                        p = export_mojo(m, os.path.join(td, "model.mojo"))
                         with open(p, "rb") as f:
                             blob = f.read()
+                    # header filename: strip path separators, quotes and
+                    # control chars (CRLF here = response splitting)
+                    safe = "".join(
+                        c for c in key
+                        if c.isalnum() or c in "._- ") or "model"
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/octet-stream")
                     self.send_header(
                         "Content-Disposition",
-                        f'attachment; filename="{key}.mojo"')
+                        f'attachment; filename="{safe}.mojo"')
                     self.send_header("Content-Length", str(len(blob)))
                     self.end_headers()
                     self.wfile.write(blob)

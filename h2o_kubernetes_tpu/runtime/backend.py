@@ -68,14 +68,43 @@ def enable_persistent_compile_cache() -> None:
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return
     try:
-        cache_dir = os.path.join(os.path.dirname(os.path.dirname(
+        repo_dir = os.path.join(os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__)))),
             "tools", "_jax_cache")
-        os.makedirs(cache_dir, exist_ok=True)
+        # the repo-relative path only exists in a git checkout; from an
+        # installed wheel fall back to a per-user cache dir rather than
+        # polluting site-packages' parent (or silently losing caching)
+        candidates = [repo_dir] if os.path.isdir(
+            os.path.dirname(repo_dir)) else []
+        candidates.append(os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "h2o_tpu_jax_cache"))
+        import tempfile
+        candidates.append(
+            os.path.join(tempfile.gettempdir(), "h2o_tpu_jax_cache"))
+        cache_dir = None
+        for cand in candidates:
+            try:
+                os.makedirs(cand, exist_ok=True)
+                # pid suffix: two capture tools probing the shared repo
+                # cache concurrently must not delete each other's probe
+                probe = os.path.join(cand, f".writable.{os.getpid()}")
+                with open(probe, "w") as f:
+                    f.write("")
+                os.remove(probe)
+                cache_dir = cand
+                break
+            except OSError:
+                continue
+        if cache_dir is None:
+            return
         os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
-        # cache everything (default only caches >1s compiles)
+        # 0.5s threshold: catches every real XLA compile (the cheapest
+        # boost-step compile on this box is ~1s) while keeping the
+        # trivial scalar dispatches from growing the dir without bound
         os.environ.setdefault(
-            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
         j = sys.modules.get("jax")
         if j is not None:
             j.config.update("jax_compilation_cache_dir", cache_dir)

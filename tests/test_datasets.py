@@ -1,0 +1,95 @@
+"""Reference-shaped dataset generators (tools/datasets.py) + the
+capabilities they exercise: high-cardinality categorical binning and
+the pyarrow CSV fast path's exact equivalence to the pure-Python
+parser (BASELINE.json configs name airlines/HIGGS/MSLR shapes)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tools import datasets as D
+
+
+def test_airlines_shape_and_nas():
+    cols, domains = D.airlines_arrays(20_000, seed=1)
+    assert len(cols) >= 25
+    assert domains["IsDepDelayed"] == ["NO", "YES"]
+    assert len(domains["Origin"]) == 300
+    # NA injection present but bounded
+    na = float(np.isnan(cols["DepTime"]).mean())
+    assert 0.005 < na < 0.08
+    # response is balanced-ish (a degenerate target would make every
+    # AutoML model trivially equal)
+    rate = float(np.nanmean(cols["IsDepDelayed"]))
+    assert 0.3 < rate < 0.7
+
+
+def test_mslr_shape():
+    cols = D.mslr_arrays(20_000, seed=1, n_features=20)
+    q = cols["qid"]
+    assert (np.diff(q) >= 0).all()          # grouped + sorted
+    _, counts = np.unique(q, return_counts=True)
+    assert counts.mean() > 30               # real group sizes, not pairs
+    hist = np.bincount(cols["rel"].astype(int), minlength=5)
+    assert hist[0] > hist[1] > hist[2] > hist[3] >= hist[4] > 0
+
+
+def test_airlines_frame_trains_gbm():
+    from h2o_kubernetes_tpu.models import GBM
+
+    fr = D.airlines_frame(4_000, seed=2)
+    assert fr.vec("Origin").cardinality() == 300   # > n_bins: range-bin
+    m = GBM(ntrees=3, max_depth=4, seed=1).train(
+        y="IsDepDelayed", training_frame=fr)
+    auc = float(m.model_performance(fr, y="IsDepDelayed")["auc"])
+    assert auc > 0.7
+
+
+def test_highcard_enum_binning_splits_levels():
+    """Overflow enums bin by contiguous code ranges: codes far apart
+    land in different bins, adjacent codes may share."""
+    import jax.numpy as jnp
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models.tree.binning import (apply_bins,
+                                                        fit_bins)
+
+    card = 500
+    codes = np.arange(card, dtype=np.float32)
+    fr = h2o.Frame.from_arrays(
+        {"c": codes}, domains={"c": [f"L{i}" for i in range(card)]})
+    spec = fit_bins(fr, ["c"], n_bins=64)
+    assert spec.is_enum == [False]          # overflow → numeric path
+    binned = apply_bins(jnp.asarray(codes)[:, None],
+                        spec.edges_matrix(),
+                        jnp.asarray([False]), spec.na_bin)
+    b = np.asarray(binned)[:, 0]
+    assert b.min() == 0 and b.max() == 61   # fills the finite bins
+    assert (np.diff(b) >= 0).all()          # order-preserving ranges
+    # NA code (NaN after as_float) → NA bin
+    binned_na = apply_bins(jnp.asarray([[np.nan]]),
+                           spec.edges_matrix(),
+                           jnp.asarray([False]), spec.na_bin)
+    assert int(binned_na[0, 0]) == spec.na_bin
+
+
+@pytest.mark.slow
+def test_arrow_csv_matches_python_parser(tmp_path):
+    import h2o_kubernetes_tpu.frame.parse as P
+
+    p = str(tmp_path / "air.csv")
+    D.airlines_csv(p, 5_000, chunk=5_000)
+    fr = P.import_file(p)
+    os.environ["H2O_TPU_ARROW_CSV"] = "0"
+    try:
+        fr2 = P.import_file(p)
+    finally:
+        os.environ.pop("H2O_TPU_ARROW_CSV", None)
+    assert fr.names == fr2.names
+    for n in fr.names:
+        a, b = fr.vec(n), fr2.vec(n)
+        assert a.domain == b.domain, n
+        x = np.asarray(a.data)[: fr.nrows]
+        y = np.asarray(b.data)[: fr2.nrows]
+        assert np.allclose(x, y, equal_nan=True), n

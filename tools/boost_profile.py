@@ -10,7 +10,7 @@ breaks it down so kernel work is attacked where the time actually is:
    top-op self-times (no tensorboard needed — the trace JSON is parsed
    directly).
 
-Writes ``PROFILE_TPU_r04.json`` (or ``PROFILE_CPU_r04.json``) at the
+Writes ``PROFILE_TPU_r05.json`` (or ``PROFILE_CPU_r05.json``) at the
 repo root and prints one JSON summary line. Run by tools/tpu_watch.py
 once per chip window after the bench capture.
 """
@@ -169,7 +169,7 @@ def main() -> int:
            "phases": phases, "op_profile": op_profile,
            "captured_at": time.strftime("%Y-%m-%dT%H:%M:%S")}
     path = os.path.join(
-        REPO, f"PROFILE_{'TPU' if platform == 'tpu' else 'CPU'}_r04.json")
+        REPO, f"PROFILE_{'TPU' if platform == 'tpu' else 'CPU'}_r05.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"profile": "ok", "platform": platform,

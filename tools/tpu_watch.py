@@ -4,13 +4,13 @@ Loop: probe TPU client init in a subprocess (the tunneled chip HANGS on
 init when down, so every probe gets a hard timeout).  The moment a
 probe succeeds, run the kernel gate (tools/kernel_gate.py) and the
 bench (bench.py) on the chip and write their JSON lines to
-``TPU_GATE_r04.json`` / ``BENCH_TPU_r04.json`` at the repo root, plus
+``TPU_GATE_r05.json`` / ``BENCH_TPU_r05.json`` at the repo root, plus
 an append-only probe log at ``tools/tpu_watch.log``.
 
 After a successful capture it keeps watching and re-captures at most
 every RECAPTURE_S seconds, keeping the BEST bench value (highest
-rows*trees/s) in BENCH_TPU_r04.json and the latest in
-BENCH_TPU_r04_latest.json — so late-session perf work still lands an
+rows*trees/s) in BENCH_TPU_r05.json and the latest in
+BENCH_TPU_r05_latest.json — so late-session perf work still lands an
 on-chip number without re-plumbing.
 
 Usage: nohup python tools/tpu_watch.py &   (or driver background task)
@@ -89,7 +89,7 @@ def capture() -> float | None:
         GATE_TIMEOUT)
     if gate is not None:
         gate["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-        with open(os.path.join(REPO, "TPU_GATE_r04.json"), "w") as f:
+        with open(os.path.join(REPO, "TPU_GATE_r05.json"), "w") as f:
             json.dump(gate, f, indent=1)
     log(f"gate ok={ok} result={json.dumps(gate)[:300] if gate else tail}")
 
@@ -103,10 +103,10 @@ def capture() -> float | None:
     if bench.get("platform") != "tpu":
         log("bench fell back to CPU despite live probe — not recording")
         return None
-    latest = os.path.join(REPO, "BENCH_TPU_r04_latest.json")
+    latest = os.path.join(REPO, "BENCH_TPU_r05_latest.json")
     with open(latest, "w") as f:
         json.dump(bench, f, indent=1)
-    best_path = os.path.join(REPO, "BENCH_TPU_r04.json")
+    best_path = os.path.join(REPO, "BENCH_TPU_r05.json")
     best_val = -1.0
     if os.path.exists(best_path):
         try:
@@ -121,7 +121,7 @@ def capture() -> float | None:
 
     # once per chip window: per-phase + per-op boost profile (where the
     # bench seconds actually go — drives the MFU work)
-    prof_path = os.path.join(REPO, "PROFILE_TPU_r04.json")
+    prof_path = os.path.join(REPO, "PROFILE_TPU_r05.json")
     if not os.path.exists(prof_path):
         log("running boost profile on chip")
         ok, prof, tail = run_json(
@@ -137,7 +137,7 @@ def capture() -> float | None:
     # 900 s budget — chip availability comes in ~20-min windows, so the
     # capture is a fixed-time-budget run, the same framing the
     # reference's AutoML wall-clock comparisons use)
-    aml_path = os.path.join(REPO, "AUTOML_TPU_r04.json")
+    aml_path = os.path.join(REPO, "AUTOML_TPU_r05.json")
     if not os.path.exists(aml_path):
         log("running on-chip AutoML 10M scale capture")
         ok, aml, tail = run_json(
@@ -164,7 +164,7 @@ def capture() -> float | None:
     # the round's named evidence): the non-GBM BASELINE configs (GLM
     # iters/sec, DRF HIGGS on the unit-hess path, XGBoost hist,
     # lambdarank, DL, Word2Vec)
-    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r04.json")
+    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r05.json")
     if not os.path.exists(suite_path):
         log("running bench_suite on chip")
         ok, suite, tail = run_json(
