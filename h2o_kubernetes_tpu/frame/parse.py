@@ -1049,10 +1049,9 @@ def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
         else:
             enc = col.dictionary_encode()
             dom_raw = [str(v) for v in enc.dictionary.to_pylist()]
-            codes = enc.indices.to_numpy(zero_copy_only=False)
-            codes = np.where(np.isnan(codes.astype(np.float64)), -1,
-                             np.nan_to_num(codes.astype(np.float64),
-                                           nan=-1)).astype(np.int64)
+            codes = np.nan_to_num(
+                enc.indices.to_numpy(zero_copy_only=False).astype(
+                    np.float64), nan=-1).astype(np.int64)
             # arrow keeps surrounding whitespace and matches NA tokens
             # exactly; re-apply the slow path's strip + lowercase-NA
             # semantics on the (small) dictionary, not the rows

@@ -156,36 +156,89 @@ def cross_validate(est, y: str, frame: Frame, cv: CVArgs,
         ignored = list(tkw.get("ignored_columns") or []) + fold_col_ignore
         tkw["ignored_columns"] = ignored
 
+    # SHAPE-SHARED fold training (compile-dominated regime): instead of
+    # slicing per-fold frames (each a new row shape → every jitted
+    # program recompiles per fold AND for the final fit), train each
+    # fold model on the FULL frame with the holdout rows' weights
+    # zeroed. All fold fits + the final fit then share one row shape,
+    # one binned matrix and one set of XLA executables — the dominant
+    # share of a cold AutoML's compile count (232 → 166 measured,
+    # AUTOML_R04SHAPE_r05.json). Holdout rows still carry zero
+    # loss/histogram/Gram weight (w=0 is the established dead-row
+    # convention); frame-global statistics (quantile bin edges, mean
+    # imputation, standardization) see the holdout feature
+    # distributions — the same global-binning semantics LightGBM's cv
+    # uses, and label-free. The trade: each fold model computes over
+    # all n rows (n/(nfolds-1)·nfolds extra FLOPs) — a clear win on
+    # TPU, where a fold fit is milliseconds and every avoided compile
+    # is a REMOTE round trip, and a measured loss on the CPU mesh
+    # (+22% wall at 30k rows on 1 core), so it gates on the backend.
+    # Above the row threshold the classic sliced-frame CV runs either
+    # way (at 10M rows fold FLOPs dwarf compiles). Env overrides:
+    # H2O_TPU_CV_SHAPE_SHARE_ROWS=0 disables, =N forces the threshold
+    # on any backend.
+    import os
+
+    import jax
+
+    _thresh_env = os.environ.get("H2O_TPU_CV_SHAPE_SHARE_ROWS")
+    if _thresh_env is not None:
+        share = n <= int(_thresh_env)
+    else:
+        share = jax.default_backend() == "tpu" and n <= 1_000_000
+    wcol = tkw.get("weights_column")
+    mask_col = "_cv_mask_w_"
+    if mask_col in frame.names:       # collision: fall back, stay correct
+        share = False
+    if share:
+        from ..frame import Vec
+
+        base_w = (np.asarray(frame.vec(wcol).as_float())[:n]
+                  if wcol else np.ones(n, dtype=np.float32))
+        tkw_share = dict(tkw)
+        tkw_share["weights_column"] = mask_col
+        if wcol:
+            # the original weights column is folded into the mask; it
+            # must stay EXCLUDED from features (resolve_xy only ignores
+            # the active weights_column)
+            tkw_share["ignored_columns"] = list(
+                tkw.get("ignored_columns") or []) + [wcol]
+
     models, fold_metrics = [], []
     preds: np.ndarray | None = None
+    y_codes_all = yv.to_numpy() if yv.is_enum() else \
+        np.asarray(yv.as_float())[:n]
     for k in range(nfolds):
         hold = folds == k
         clone = copy.deepcopy(est)
         clone.cv_args = CVArgs()            # fold models never recurse
-        m = clone.train(y=y, training_frame=frame.select_rows(~hold),
-                        **tkw)
-        hold_fr = frame.select_rows(hold)
-        pk = m.predict_raw(hold_fr)
+        if share:
+            wk = np.where(hold, 0.0, base_w).astype(np.float32)
+            vecs = {nm: frame.vec(nm) for nm in frame.names}
+            vecs[mask_col] = Vec.from_numpy(wk, mask_col)
+            m = clone.train(y=y, training_frame=Frame(vecs), **tkw_share)
+            pk_full = m.predict_raw(frame)   # full shape: shared program
+            pk = pk_full[hold]
+        else:
+            m = clone.train(y=y,
+                            training_frame=frame.select_rows(~hold),
+                            **tkw)
+            pk = m.predict_raw(frame.select_rows(hold))
         if preds is None:
             preds = np.zeros((n,) + pk.shape[1:], dtype=pk.dtype)
         preds[hold] = pk
         # fold metrics straight from pk — a model_performance() call
         # would rebuild the design matrix and re-score the holdout
-        yh = hold_fr.vec(y)
         fold_metrics.append(_combined_metrics(
-            m, yh.to_numpy() if yh.is_enum() else
-            np.asarray(yh.as_float())[: hold_fr.nrows],
-            yh.is_enum(), pk, m.distribution))
+            m, y_codes_all[hold], yv.is_enum(), pk, m.distribution))
         models.append(m)
 
     keys = fold_metrics[0].keys()
     summary = {key: {"mean": float(np.mean([fm[key] for fm in fold_metrics])),
                      "std": float(np.std([fm[key] for fm in fold_metrics]))}
                for key in keys}
-    y_codes = yv.to_numpy() if yv.is_enum() else \
-        np.asarray(yv.as_float())[:n]
-    combined = _combined_metrics(models[0], y_codes, yv.is_enum(), preds,
-                                 models[0].distribution)
+    combined = _combined_metrics(models[0], y_codes_all, yv.is_enum(),
+                                 preds, models[0].distribution)
     return CVResult(
         fold_ids=folds,
         models=models if cv.keep_cross_validation_models else None,

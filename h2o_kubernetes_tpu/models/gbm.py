@@ -19,6 +19,7 @@ Distributions (hex/genmodel DistributionFamily analogs):
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
@@ -33,7 +34,8 @@ from ..runtime.mesh import global_mesh
 from .base import Model, TrainData, resolve_xy
 from .tree.binning import BinSpec, apply_bins, apply_bins_jit, fit_bins
 from .tree.core import (BoostParams, Tree, TreeParams, _grad_hess,
-                        boost_trees, boost_trees_multi, descend_tree,
+                        boost_trees, boost_trees_drf,
+                        boost_trees_multi, descend_tree,
                         predict_tree)
 
 
@@ -422,6 +424,38 @@ class GBM:
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 
+        # deep-tree memory validation: the dense heap's per-level
+        # histogram working set is O(2^d·F·B·C) (the ×5 covers
+        # hist_prev + hist_l + hist_r + the stacked level — the same
+        # accounting as core._MULTI_HIST_BUDGET). The reference reaches
+        # depth 20 via dynamic row partitions; here ANY depth whose
+        # level histograms fit the budget trains fine (e.g. depth 16
+        # with 4 features × 16 bins is ~25 MB), and one that cannot
+        # fit fails HERE with sizing guidance instead of an opaque
+        # device OOM mid-boost.
+        C = 2 if tp.unit_hess else 3
+        hist_bytes = 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.nbins \
+            * C * 4
+        if K > 1:
+            # the multinomial grower vmaps K class trees only while
+            # K x histograms fit its own budget; past that it falls to
+            # lax.map with one class's histograms live — validate the
+            # memory that will actually be live, not a K x worst case
+            from .tree.core import _MULTI_HIST_BUDGET
+
+            if K * hist_bytes <= _MULTI_HIST_BUDGET:
+                hist_bytes *= K
+        budget = float(os.environ.get("H2O_TPU_HIST_BYTES_BUDGET",
+                                      2 ** 30))
+        if hist_bytes > budget:
+            need_mb = hist_bytes / 2 ** 20
+            raise ValueError(
+                f"max_depth={p.max_depth} with {F} features x "
+                f"{p.nbins} bins needs ~{need_mb:.0f} MiB of level "
+                f"histograms (> budget {budget / 2 ** 20:.0f} MiB). "
+                "Lower max_depth or nbins, drop features, or raise "
+                "H2O_TPU_HIST_BYTES_BUDGET if the device has room.")
+
         off = data.offset if data.offset is not None \
             else jnp.zeros_like(data.y)
         if ckpt is not None:
@@ -533,7 +567,13 @@ class GBM:
                 # a blocking host sync)
                 n = min(n, score - (t - start_t) % score)
             key, kc = jax.random.split(key)
-            if K == 1:
+            if K == 1 and p._drf_mode:
+                # independent forest trees grow in vmapped GROUPS (the
+                # class-flattening kernel rule): G× fuller MXU M at
+                # shallow levels, G× fewer sequential level steps
+                margin, tchunk = boost_trees_drf(
+                    binned, data.y, data.w, margin, kc, n, tp, bp)
+            elif K == 1:
                 margin, tchunk = boost_trees(binned, data.y, data.w,
                                              margin, kc, n, tp, bp)
             else:

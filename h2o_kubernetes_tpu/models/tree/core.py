@@ -465,6 +465,85 @@ def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
     return margin, trees
 
 
+def _boost_shard_drf(binned, y, w, margin, keys, p: TreeParams,
+                     bp: BoostParams, G: int):
+    """DRF grouped growth: forest trees are INDEPENDENT (no margin
+    coupling), so G trees grow per scan step via vmap — the
+    class-flattening custom_vmap rule relabels tree g's rows to nodes
+    [g·n_nodes, (g+1)·n_nodes) and ONE kernel call covers the group.
+    Two wins over the sequential scan: the MXU M dimension (channels ×
+    hi-slots) is G× fuller at shallow tree levels (PROFILE.md names
+    sub-128 M as a main MFU lever), and the per-level sequencing
+    overhead amortizes over G trees. keys: [rounds, G]."""
+    F = binned.shape[1]
+    g0 = -y
+    h0 = jnp.ones_like(y)
+
+    def body(carry, kt_group):
+        def grow_one(kt):
+            k_row, k_col, k_tree = jax.random.split(kt, 3)
+            w_t, col_mask = _round_sampling(bp, w, F, k_row, k_col)
+            tree, _ = _grow_tree_shard(binned, g0, h0, w_t, col_mask,
+                                       k_tree, p)
+            return tree
+
+        return carry, jax.vmap(grow_one)(kt_group)
+
+    _, trees = lax.scan(body, 0, keys)
+    # [rounds, G, N] -> [rounds*G, N]
+    return margin, jax.tree.map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), trees)
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
+def _boost_drf_jit(binned, y, w, margin, keys, p: TreeParams,
+                   bp: BoostParams, G: int, mesh):
+    fn = jax.shard_map(
+        functools.partial(_boost_shard_drf, p=p, bp=bp, G=G),
+        mesh=mesh,
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P()),
+        out_specs=(P(ROWS), P()),
+        check_vma=_resolve_impl(p.hist_impl) == "segment")
+    return fn(binned, y, w, margin, keys)
+
+
+def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
+                    p: TreeParams, bp: BoostParams, mesh=None):
+    """Grouped DRF forest growth: n_trees independent trees in ONE
+    dispatch, vmapped in groups sized to the histogram memory budget.
+    Returns (margin unchanged, trees [n_trees, N])."""
+    assert bp.drf_mode
+    F = binned.shape[1]
+    # same live-histogram accounting as the multinomial path: vmap
+    # multiplies per-level histogram memory by G. Grouping only pays on
+    # the MXU (fuller M, fewer kernel launches); under the segment impl
+    # (CPU mesh) it just multiplies live memory on a shared host — and
+    # the virtual-device mesh multiplies it again by the shard count —
+    # so grow sequentially there.
+    C = 2 if p.unit_hess else 3
+    hist_bytes = 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.n_bins \
+        * C * 4
+    if _resolve_impl(p.hist_impl) != "pallas":
+        G = 1
+    else:
+        # the user's histogram-memory budget (gbm.py validates single-
+        # tree fit against it) also caps the GROUP's live memory — a
+        # grouped grow must not exceed what the validation promised
+        import os as _os
+
+        budget = min(_MULTI_HIST_BUDGET,
+                     int(float(_os.environ.get(
+                         "H2O_TPU_HIST_BYTES_BUDGET", 2 ** 30))))
+        G = int(max(1, min(n_trees, 16, budget // hist_bytes)))
+    rounds = -(-n_trees // G)
+    keys = jax.random.split(key, rounds * G).reshape(rounds, G)
+    margin, trees = _boost_drf_jit(binned, y, w, margin, keys, p, bp,
+                                   G, mesh or global_mesh())
+    if rounds * G != n_trees:       # drop the last group's padding
+        trees = jax.tree.map(lambda a: a[:n_trees], trees)
+    return margin, trees
+
+
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
 def _boost_multi_jit(binned, y, w, margin, keys, p: TreeParams,
                      bp: BoostParams, K: int, mesh):

@@ -52,3 +52,32 @@ def test_drf_multiclass_probs_sum_to_one(mesh8):
     out = m.predict_raw(fr)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
     assert m.model_performance(fr, "y")["accuracy"] > 0.9
+
+
+def test_deep_tree_budget_validation(mesh8):
+    """Depth past 12 trains when the level histograms fit the memory
+    budget and fails with sizing guidance when they cannot — the
+    reference reaches depth 20 via dynamic row partitions; the dense
+    heap's answer is a validated budget (models/gbm.py)."""
+    import pytest
+
+    rng = np.random.default_rng(9)
+    n = 4096
+    cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+            for i in range(4)}
+    cols["y"] = np.where(cols["x0"] + 0.5 * cols["x1"] > 0, "p", "n")
+    fr = Frame.from_arrays(cols)
+    # depth 16, 4 features x 16 bins: ~25 MiB of level histograms —
+    # must TRAIN, not error (depth itself is not capped)
+    m = DRF(ntrees=2, max_depth=16, nbins=16, min_rows=1,
+            seed=1).train(y="y", training_frame=fr)
+    assert m.model_performance(fr, "y")["auc"] > 0.8
+    # many features x 64 bins at depth 16 blows the budget: the error
+    # must name the knobs (max_depth / nbins / budget)
+    wide = {f"x{i}": rng.normal(size=256).astype(np.float32)
+            for i in range(30)}
+    wide["y"] = np.where(wide["x0"] > 0, "p", "n")
+    fr_wide = Frame.from_arrays(wide)
+    with pytest.raises(ValueError, match="max_depth.*nbins|nbins.*budget"):
+        DRF(ntrees=1, max_depth=16, nbins=64, seed=1).train(
+            y="y", training_frame=fr_wide)

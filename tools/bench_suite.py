@@ -1,18 +1,26 @@
 """Multi-config benchmark suite — the BASELINE.json eval configs
 beyond the headline GBM number (bench.py):
 
+- ingest: airlines-shaped CSV → Frame rows/s (the pyarrow fast path;
+  SURVEY C8 — the reference's parse is chunk-parallel for this);
 - config #2a GLM: binomial IRLSM on a HIGGS-shaped table (28 numeric
-  features) — reports the north-star "GLM iters/sec" plus wall;
+  features) — ≥50 IRLS iterations on ≥100k rows so the number
+  measures the Gram path, not dispatch overhead;
 - config #2b DRF: HIGGS-shaped forest — rides the 2-channel
   unit-hessian histogram path (h ≡ 1);
 - config #3  XGBoost tree_method=hist semantics — regularized-gain
   boosting on the shared tree core;
+- config #3b lambdarank on the MSLR shape (qid groups, graded rel);
 - config #4  DeepLearning MLP (model-averaging allreduce) — rows/sec
-  through one epoch.
+  through one epoch;
+- config #4b Word2Vec skip-gram, Zipf corpus.
 
-Each config warms up once (compile excluded, same contract as
-bench.py) then times a steady-state train. One JSON line per config +
-a trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r04.json`` at the
+Every config reports BOTH timings: ``compile_seconds`` (the first
+call — what a cold user pays, XLA compile included) and ``seconds``
+(steady state, compile cached; repeated until ≥1 s of measured work
+or 3 calls on the CPU mesh, single repeat on TPU where trains are
+long and chip windows are ~20 min). One JSON line per config + a
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r05.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -25,30 +33,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _higgs_like(rows: int, seed: int = 0):
-    """HIGGS-shaped synthetic: 28 numeric features, binary response
-    driven by a few nonlinear combinations (the real set's low-level
-    kinematics + derived masses)."""
-    import numpy as np
-
-    import h2o_kubernetes_tpu as h2o
-
-    rng = np.random.default_rng(seed)
-    F = 28
-    X = rng.normal(size=(rows, F)).astype(np.float32)
-    logit = (0.8 * X[:, 0] - 0.6 * X[:, 1] * X[:, 2]
-             + 0.5 * np.abs(X[:, 3]) - 0.4 * (X[:, 4] ** 2)
-             + rng.normal(scale=0.7, size=rows))
-    cols = {f"f{i}": X[:, i] for i in range(F)}
-    cols["y"] = np.where(logit > 0, "s", "b")
-    return h2o.Frame.from_arrays(cols)
-
-
-def _timed(fn):
-    fn()                                   # warm-up: compile cached
+def _timed(fn, on_tpu: bool, min_secs: float = 1.0):
+    """(out, steady_seconds_per_call, calls, compile_seconds)."""
     t0 = time.perf_counter()
     out = fn()
-    return out, time.perf_counter() - t0
+    compile_dt = time.perf_counter() - t0
+    total, calls = 0.0, 0
+    max_calls = 1 if on_tpu else 3
+    while calls < max_calls:
+        t0 = time.perf_counter()
+        out = fn()
+        total += time.perf_counter() - t0
+        calls += 1
+        if total >= min_secs:
+            break
+    return out, total / calls, calls, compile_dt
 
 
 def main() -> int:
@@ -57,8 +56,12 @@ def main() -> int:
     ensure_live_backend(budget=float(
         os.environ.get("H2O_TPU_PROBE_BUDGET", "300")))
     import jax
+    import numpy as np
 
-    from h2o_kubernetes_tpu.models import DRF, GLM, DeepLearning, XGBoost
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import (DRF, GBM, GLM, DeepLearning,
+                                           Word2Vec, XGBoost)
+    from tools import datasets as D
 
     platform = jax.default_backend()
     on_tpu = platform == "tpu"
@@ -66,48 +69,64 @@ def main() -> int:
                               1_000_000 if on_tpu else 30_000))
     results = []
 
-    def record(config, value, unit, seconds, **extra):
+    def record(config, value, unit, seconds, calls, compile_s, **extra):
         row = {"config": config, "value": round(value, 1), "unit": unit,
-               "seconds": round(seconds, 3), "rows": rows,
+               "seconds": round(seconds, 3), "calls": calls,
+               "compile_seconds": round(compile_s, 3), "rows": rows,
                "platform": platform, **extra}
         results.append(row)
         print(json.dumps(row), flush=True)
 
-    fr = _higgs_like(rows)
+    # ingest: airlines-shaped CSV through import_file (arrow fast path)
+    import tempfile
+    ing_rows = min(max(rows, 100_000), 2_000_000)
+    with tempfile.TemporaryDirectory() as td:
+        csv_path = os.path.join(td, "air.csv")
+        D.airlines_csv(csv_path, ing_rows, chunk=1_000_000)
+        mb = os.path.getsize(csv_path) / 1e6
+        fr_ing, dt, calls, cdt = _timed(
+            lambda: h2o.import_file(csv_path), on_tpu)
+        ncells = ing_rows * fr_ing.ncols
+        record("ingest_airlines_csv", ing_rows / dt, "rows/s", dt, calls,
+               cdt, rows_ingest=ing_rows, mb=round(mb, 1),
+               cells_per_s=round(ncells / dt, 1),
+               mb_per_s=round(mb / dt, 2))
 
-    # config #2a: GLM binomial IRLSM — north-star "GLM iters/sec"
-    m, dt = _timed(lambda: GLM(
+    # config #2a: GLM binomial IRLSM — north-star "GLM iters/sec".
+    # 50 iterations on >=100k rows: the r04 number (4 iters on 15k
+    # rows, 0.024 s) measured dispatch, not the Gram path.
+    fr_glm = D.higgs_frame(rows if on_tpu else max(rows, 100_000))
+    # epsilons at 0 force the full 50 iterations — the benchmark wants
+    # a fixed, comparable amount of Gram work, not a convergence race
+    m, dt, calls, cdt = _timed(lambda: GLM(
         family="binomial", solver="IRLSM", lambda_=0.0,
-        max_iterations=20, seed=1).train(y="y", training_frame=fr))
+        max_iterations=50, objective_epsilon=0.0, beta_epsilon=0.0,
+        seed=1).train(y="y", training_frame=fr_glm), on_tpu)
     record("glm_binomial_irlsm", m.n_iterations / dt, "iters/s", dt,
-           iterations=m.n_iterations,
-           auc=round(float(m.model_performance(fr, y="y")["auc"]), 5))
+           calls, cdt, iterations=m.n_iterations, rows_glm=fr_glm.nrows,
+           auc=round(float(m.model_performance(fr_glm, y="y")["auc"]), 5))
+
+    fr = fr_glm if on_tpu else D.higgs_frame(rows)
 
     # config #2b: DRF (unit-hessian 2-channel histograms)
     ntrees, depth = 10, 8
-    m, dt = _timed(lambda: DRF(
+    m, dt, calls, cdt = _timed(lambda: DRF(
         ntrees=ntrees, max_depth=depth, seed=1).train(
-        y="y", training_frame=fr))
-    record("drf_higgs", rows * ntrees / dt, "rows*trees/s", dt,
-           ntrees=ntrees, max_depth=depth)
+        y="y", training_frame=fr), on_tpu)
+    record("drf_higgs", fr.nrows * ntrees / dt, "rows*trees/s",
+           dt, calls, cdt, ntrees=ntrees, max_depth=depth)
 
     # config #3: XGBoost hist semantics
-    m, dt = _timed(lambda: XGBoost(
+    m, dt, calls, cdt = _timed(lambda: XGBoost(
         ntrees=ntrees, max_depth=6, learn_rate=0.2, seed=1).train(
-        y="y", training_frame=fr))
-    record("xgboost_hist", rows * ntrees / dt, "rows*trees/s", dt,
-           ntrees=ntrees, max_depth=6)
+        y="y", training_frame=fr), on_tpu)
+    record("xgboost_hist", fr.nrows * ntrees / dt, "rows*trees/s",
+           dt, calls, cdt, ntrees=ntrees, max_depth=6)
 
     # multinomial GBM: K class trees per round through the
     # class-flattened batching rule (custom_vmap lowers the class axis
     # into the node axis — the round-4 Mosaic fix; K x fuller MXU M)
-    import numpy as np
-
-    import h2o_kubernetes_tpu as h2o
-
-    from h2o_kubernetes_tpu.models import GBM
-
-    mn_rows = min(rows, 500_000)
+    mn_rows = min(fr.nrows, 500_000)
     rngm = np.random.default_rng(3)
     Xm = rngm.normal(size=(mn_rows, 10)).astype(np.float32)
     score = Xm[:, 0] + 0.5 * Xm[:, 1]
@@ -118,11 +137,11 @@ def main() -> int:
     mcols["y"] = ym
     fr_mn = h2o.Frame.from_arrays(mcols)
     mn_ntrees = 5
-    m, dt = _timed(lambda: GBM(
+    m, dt, calls, cdt = _timed(lambda: GBM(
         ntrees=mn_ntrees, max_depth=5, learn_rate=0.2, seed=1).train(
-        y="y", training_frame=fr_mn))
+        y="y", training_frame=fr_mn), on_tpu)
     record("gbm_multinomial", mn_rows * mn_ntrees * m.nclasses / dt,
-           "rows*classtrees/s", dt, rows_mn=mn_rows,
+           "rows*classtrees/s", dt, calls, cdt, rows_mn=mn_rows,
            classes=m.nclasses,
            logloss=round(float(
                m.scoring_history[-1].get("train_logloss",
@@ -130,54 +149,40 @@ def main() -> int:
 
     # config #3b: lambdarank (MSLR-WEB30K shape — graded relevance over
     # query groups, rank:ndcg LambdaMART)
-
-    rk_rows = min(rows, 200_000)
-    rng = np.random.default_rng(4)
-    Xr = rng.normal(size=(rk_rows, 20)).astype(np.float32)
-    qid = np.sort(rng.integers(0, rk_rows // 100, size=rk_rows))
-    rel = np.clip((Xr[:, 0] + 0.5 * Xr[:, 1]
-                   + rng.normal(scale=0.8, size=rk_rows)) * 1.2 + 2,
-                  0, 4).astype(np.float32).round()
-    rcols = {f"f{i}": Xr[:, i] for i in range(20)}
-    rcols["rel"] = rel
-    rcols["qid"] = qid.astype(np.float32)
-    fr_rk = h2o.Frame.from_arrays(rcols)
-    m, dt = _timed(lambda: XGBoost(
+    rk_rows = min(fr.nrows, 200_000)
+    fr_rk = D.mslr_frame(rk_rows, seed=4, n_features=20)
+    m, dt, calls, cdt = _timed(lambda: XGBoost(
         ntrees=10, max_depth=6, objective="rank:ndcg", seed=1).train(
-        y="rel", training_frame=fr_rk, group_column="qid"))
+        y="rel", training_frame=fr_rk, group_column="qid"), on_tpu)
     ndcg = m.model_performance(fr_rk, y="rel")
     record("xgboost_lambdarank", rk_rows * 10 / dt, "rows*trees/s", dt,
-           rows_rank=rk_rows,
+           calls, cdt, rows_rank=rk_rows,
            ndcg10=round(float(ndcg.get("ndcg@10", float("nan"))), 5))
 
     # config #4: DeepLearning MLP, one pass (model-averaging allreduce)
-    dl_rows = min(rows, 200_000)
-    fr_dl = _higgs_like(dl_rows, seed=2)
-    m, dt = _timed(lambda: DeepLearning(
+    dl_rows = min(fr.nrows, 200_000)
+    fr_dl = D.higgs_frame(dl_rows, seed=2)
+    m, dt, calls, cdt = _timed(lambda: DeepLearning(
         hidden=[64, 64], epochs=1, seed=1).train(
-        y="y", training_frame=fr_dl))
-    record("deeplearning_mlp", dl_rows / dt, "rows/s", dt,
+        y="y", training_frame=fr_dl), on_tpu)
+    record("deeplearning_mlp", dl_rows / dt, "rows/s", dt, calls, cdt,
            rows_dl=dl_rows, hidden=[64, 64])
 
-    # config #4b: Word2Vec skip-gram over a synthetic NA-delimited
-    # corpus (sentence rows; negative-sampling epochs)
-    from h2o_kubernetes_tpu.models import Word2Vec
-
-    n_tok = min(rows // 2, 200_000)
-    vocab = np.array([f"w{i}" for i in range(2000)])
-    toks = vocab[rng.integers(0, 2000, size=n_tok)].astype(object)
-    toks[:: 17] = None                       # sentence breaks
+    # config #4b: Word2Vec skip-gram over a Zipf NA-delimited corpus
+    n_tok = 200_000
+    toks = D.text8_like_tokens(n_tok, vocab_size=5_000, seed=5)
     fr_w2v = h2o.Frame.from_arrays({"words": np.array(toks)})
-    m, dt = _timed(lambda: Word2Vec(
-        vec_size=32, epochs=1, min_word_freq=2, seed=1).train(fr_w2v))
-    record("word2vec_skipgram", n_tok / dt, "tokens/s", dt,
+    m, dt, calls, cdt = _timed(lambda: Word2Vec(
+        vec_size=32, epochs=1, min_word_freq=2, seed=1).train(fr_w2v),
+        on_tpu)
+    record("word2vec_skipgram", n_tok / dt, "tokens/s", dt, calls, cdt,
            tokens=n_tok, vec_size=32)
 
     out = {"suite": results, "captured_at":
            time.strftime("%Y-%m-%dT%H:%M:%S")}
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r04.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r05.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),
