@@ -120,13 +120,17 @@ def main() -> None:
         base = None  # first run at this platform+shape: no ratio yet
     else:
         base = entry["value"]
-    if on_tpu:
-        store["last_tpu"] = {"value": rows_per_sec_per_chip,
-                             "rows": rows, "trees": ntrees,
-                             "recorded": time.strftime(
-                                 "%Y-%m-%dT%H:%M:%S")}
-    with open(base_path, "w") as f:
-        json.dump(store, f, indent=1)
+    # H2O_TPU_BENCH_NO_STORE=1: measure without touching the baseline
+    # store — experimental-mode runs (the watcher's 2-term capture)
+    # must not overwrite last_tpu, the headline full-precision number
+    if os.environ.get("H2O_TPU_BENCH_NO_STORE") != "1":
+        if on_tpu:
+            store["last_tpu"] = {"value": rows_per_sec_per_chip,
+                                 "rows": rows, "trees": ntrees,
+                                 "recorded": time.strftime(
+                                     "%Y-%m-%dT%H:%M:%S")}
+        with open(base_path, "w") as f:
+            json.dump(store, f, indent=1)
 
     print(json.dumps({
         "metric": METRIC,

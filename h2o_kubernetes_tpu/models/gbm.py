@@ -425,26 +425,23 @@ class GBM:
         F = len(data.feature_names)
 
         # deep-tree memory validation: the dense heap's per-level
-        # histogram working set is O(2^d·F·B·C) (the ×5 covers
-        # hist_prev + hist_l + hist_r + the stacked level — the same
-        # accounting as core._MULTI_HIST_BUDGET). The reference reaches
-        # depth 20 via dynamic row partitions; here ANY depth whose
-        # level histograms fit the budget trains fine (e.g. depth 16
-        # with 4 features × 16 bins is ~25 MB), and one that cannot
-        # fit fails HERE with sizing guidance instead of an opaque
-        # device OOM mid-boost.
-        C = 2 if tp.unit_hess else 3
-        hist_bytes = 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.nbins \
-            * C * 4
-        if K > 1:
-            # the multinomial grower vmaps K class trees only while
-            # K x histograms fit its own budget; past that it falls to
-            # lax.map with one class's histograms live — validate the
-            # memory that will actually be live, not a K x worst case
-            from .tree.core import _MULTI_HIST_BUDGET
+        # histogram working set is O(2^d·F·B·C) — the SAME accounting
+        # (core.level_hist_bytes) the multinomial vmap branch and the
+        # grouped-DRF G sizing use, so this validator and the actual
+        # branch decisions cannot drift. The reference reaches depth 20
+        # via dynamic row partitions; here ANY depth whose level
+        # histograms fit the budget trains fine (e.g. depth 16 with 4
+        # features × 16 bins is ~25 MB), and one that cannot fit fails
+        # HERE with sizing guidance instead of an opaque device OOM
+        # mid-boost.
+        from .tree.core import level_hist_bytes, multi_grow_vmapped
 
-            if K * hist_bytes <= _MULTI_HIST_BUDGET:
-                hist_bytes *= K
+        hist_bytes = level_hist_bytes(tp, F)
+        if K > 1 and multi_grow_vmapped(tp, F, K):
+            # validate the memory that will actually be live: K× only
+            # when the grower really vmaps (past its budget it falls
+            # to lax.map with one class's histograms live)
+            hist_bytes *= K
         budget = float(os.environ.get("H2O_TPU_HIST_BYTES_BUDGET",
                                       2 ** 30))
         if hist_bytes > budget:

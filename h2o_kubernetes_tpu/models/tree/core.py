@@ -410,6 +410,23 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
 _MULTI_HIST_BUDGET = 2 ** 30
 
 
+def level_hist_bytes(p: TreeParams, F: int) -> int:
+    """Peak live histogram bytes for ONE tree's deepest level: the ×5
+    covers hist_prev, hist_l, hist_r (2^(d-1) nodes each) and the
+    stacked level (2^d nodes) live at once. THE single accounting used
+    by the up-front budget validation (models/gbm.py), the multinomial
+    vmap-vs-lax.map branch, and grouped-DRF G sizing — one formula so
+    the validator and the branch decisions cannot drift."""
+    C = 2 if p.unit_hess else 3
+    return 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.n_bins * C * 4
+
+
+def multi_grow_vmapped(p: TreeParams, F: int, K: int) -> bool:
+    """True when the K-class grow vmaps (K× histograms live); False
+    when it falls to lax.map with one class's histograms live."""
+    return K * level_hist_bytes(p, F) <= _MULTI_HIST_BUDGET
+
+
 def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
                        bp: BoostParams, K: int):
     """Multinomial analog of ``_boost_shard``: K class trees grow per
@@ -447,11 +464,7 @@ def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
         # vmap multiplies per-level histogram memory by K; past a VMEM/
         # HBM budget grow classes sequentially INSIDE the dispatch
         # (lax.map: 1/K the live histogram footprint, still one compile)
-        # ×5: at the deepest level hist_prev, hist_l, hist_r (2^(d-1)
-        # nodes each) and the stacked hist (2^d nodes) are live at once
-        hist_bytes = 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.n_bins \
-            * 3 * 4
-        if K * hist_bytes <= _MULTI_HIST_BUDGET:
+        if multi_grow_vmapped(p, F, K):
             trees, leaf = jax.vmap(grow_one)(g, h, keys_k)
         else:
             trees, leaf = lax.map(lambda a: grow_one(*a), (g, h, keys_k))
@@ -520,9 +533,7 @@ def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
     # (CPU mesh) it just multiplies live memory on a shared host — and
     # the virtual-device mesh multiplies it again by the shard count —
     # so grow sequentially there.
-    C = 2 if p.unit_hess else 3
-    hist_bytes = 5 * (2 ** max(p.max_depth - 1, 0)) * F * p.n_bins \
-        * C * 4
+    hist_bytes = level_hist_bytes(p, F)
     if _resolve_impl(p.hist_impl) != "pallas":
         G = 1
     else:
@@ -536,6 +547,11 @@ def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
                          "H2O_TPU_HIST_BYTES_BUDGET", 2 ** 30))))
         G = int(max(1, min(n_trees, 16, budget // hist_bytes)))
     rounds = -(-n_trees // G)
+    # rebalance: n_trees=20, G=16 would grow 2 rounds x 16 = 32 trees
+    # and throw 12 away; G = ceil(n_trees / rounds) keeps the same
+    # round count (and stays under the old G, hence under budget) with
+    # minimal padded work
+    G = -(-n_trees // rounds)
     keys = jax.random.split(key, rounds * G).reshape(rounds, G)
     margin, trees = _boost_drf_jit(binned, y, w, margin, keys, p, bp,
                                    G, mesh or global_mesh())

@@ -63,6 +63,17 @@ import os as _os
 
 _DIMSEM = _os.environ.get("H2O_TPU_HIST_DIMSEM", "1") != "0"
 
+# mantissa terms for the f32-precision bf16 emulation. 3 (default)
+# reproduces f32 products to ~2^-24 (parity-gated at 1e-6 vs the
+# segment path). 2 is the throughput mode (~2^-16 product precision —
+# the single-precision-histogram regime LightGBM ships): the stacked
+# A operand drops from 3·C·n_hi to 2·C·n_hi MXU rows, which at the
+# bench shape's deepest level means ONE 128-row M-tile instead of two,
+# and the A-build VPU cost falls by a third. Gain argmaxes are robust
+# at 2^-16 relative noise; the kernel gate checks the 2-term path at
+# its own looser tolerance.
+_TERMS = 2 if _os.environ.get("H2O_TPU_HIST_TERMS", "3") == "2" else 3
+
 
 def _dimsem(*sems):
     return pltpu.CompilerParams(dimension_semantics=sems) \
@@ -96,8 +107,23 @@ def _bin_block(n_nodes: int, n_bins: int) -> int:
     return k * n_bins
 
 
+def _mantissa_terms(vals_t, terms: int):
+    """Split [n_ch, T] f32 values into `terms` stacked bf16 mantissa
+    terms whose products against a 0/1 operand sum back to the f32
+    product (to ~2^-8·8·terms relative)."""
+    v1 = vals_t.astype(jnp.bfloat16)
+    if terms == 1:
+        return v1
+    r1 = vals_t - v1.astype(jnp.float32)
+    v2 = r1.astype(jnp.bfloat16)
+    if terms == 2:
+        return jnp.concatenate([v1, v2], axis=0)
+    v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
+    return jnp.concatenate([v1, v2, v3], axis=0)
+
+
 def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
-                      n_hi, n_ch, fg):
+                      n_hi, n_ch, fg, terms):
     """Factorized one-hot histogram matmul (the fast path).
 
     seg = rel·B + bin is split as seg = hi·128 + lo.  The LHS packs the
@@ -126,17 +152,13 @@ def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
     rel_base = rel * n_bins
     T = rel.shape[0]
     vals_t = vals_ref[:].T                           # [n_ch, T]
-    # f32-precision via 3 bf16 mantissa terms, split on the TINY
+    # f32-precision via `terms` bf16 mantissa terms, split on the TINY
     # [n_ch, T] values and masked by the 0/1 one-hot IN bf16 —
     # bit-identical to splitting the big masked A (0/1 masking commutes
     # with rounding) but skips materializing a [n_ch*n_hi, T] f32 A
     # plus two subtract passes over it: the A-build drops from ~6
-    # f32-width VPU passes to 3 bf16-width multiplies.
-    v1 = vals_t.astype(jnp.bfloat16)
-    r1 = vals_t - v1.astype(jnp.float32)
-    v2 = r1.astype(jnp.bfloat16)
-    v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
-    V = jnp.concatenate([v1, v2, v3], axis=0)        # [3·n_ch, T] bf16
+    # f32-width VPU passes to `terms` bf16-width multiplies.
+    V = _mantissa_terms(vals_t, terms)               # [terms·n_ch, T]
     iota_hi = lax.broadcasted_iota(jnp.int32, (n_hi, T), 0)
     iota_lo = lax.broadcasted_iota(jnp.int32, (T, 128), 1)
     dn = (((1,), (0,)), ((), ()))
@@ -157,18 +179,18 @@ def _hist_fact_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins,
         # hi < 0 and match no slot; their vals are zeroed upstream.
         oh_hi = (iota_hi == hi[None, :]).astype(jnp.bfloat16)
         B = (iota_lo == lo[:, None]).astype(jnp.bfloat16)
-        # ONE matmul with all 3 mantissa terms stacked into M — the
-        # MXU's row occupancy triples (3·n_ch·n_hi rows instead of 3
-        # passes of n_ch·n_hi); the per-term partial sums recombine
-        # with one cheap VPU add over [n_ch·n_hi, 128]. Same bf16
-        # products, same f32 accumulation.
+        # ONE matmul with all mantissa terms stacked into M — the
+        # MXU's row occupancy multiplies (terms·n_ch·n_hi rows instead
+        # of `terms` passes of n_ch·n_hi); the per-term partial sums
+        # recombine with one cheap VPU add over [n_ch·n_hi, 128]. Same
+        # bf16 products, same f32 accumulation.
         a = jnp.concatenate(
-            [oh_hi * V[k][None, :] for k in range(3 * n_ch)],
-            axis=0)                                  # [3·n_ch·n_hi, T]
+            [oh_hi * V[k][None, :] for k in range(terms * n_ch)],
+            axis=0)                             # [terms·n_ch·n_hi, T]
         acc = lax.dot_general(a, B, dimension_numbers=dn,
                               preferred_element_type=jnp.float32)
-        acc = acc.reshape(3, n_ch * n_hi, 128)
-        out_ref[0, j] += acc[0] + acc[1] + acc[2]    # [n_ch·n_hi, 128]
+        acc = acc.reshape(terms, n_ch * n_hi, 128)
+        out_ref[0, j] += acc.sum(axis=0)             # [n_ch·n_hi, 128]
         return carry
 
     lax.fori_loop(0, fg, _feature, 0)
@@ -234,7 +256,7 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
     grid = (n_fg, binned_tile, rbb)
     out = pl.pallas_call(
         functools.partial(_hist_fact_kernel, n_bins=n_bins, n_hi=n_hi,
-                          n_ch=C, fg=fg),
+                          n_ch=C, fg=fg, terms=_TERMS),
         out_shape=jax.ShapeDtypeStruct((n_fg, fg, C * n_hi, 128),
                                        jnp.float32, vma=vma),
         grid=grid,
@@ -259,7 +281,8 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
     return out.reshape(F, C, n_nodes, n_bins).transpose(2, 0, 3, 1)
 
 
-def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
+def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt,
+                 terms):
     nb = pl.program_id(1)
     rt = pl.program_id(2)
 
@@ -276,26 +299,22 @@ def _hist_kernel(binned_ref, rel_ref, vals_ref, out_ref, *, n_bins, nbt):
     # a non-negative iota slot — no explicit liveness mask needed (a bool
     # [:, None] broadcast is also unsupported by Mosaic for non-32-bit)
     onehot = ((seg[:, None] - base) == iota).astype(jnp.bfloat16)
-    vals_t = vals_ref[:].T                           # [3, T]
+    vals_t = vals_ref[:].T                           # [C, T]
     # same f32-precision recipe as the factorized kernel: the one-hot
-    # RHS is 0/1 (bf16-exact) and the [3, T] values split into three
-    # bf16 mantissa terms — 3 explicit bf16 passes replace the implicit
+    # RHS is 0/1 (bf16-exact) and the [C, T] values split into `terms`
+    # bf16 mantissa terms — explicit bf16 passes replace the implicit
     # ~6-pass f32 HIGHEST emulation on BOTH operands
-    v1 = vals_t.astype(jnp.bfloat16)
-    r1 = vals_t - v1.astype(jnp.float32)
-    v2 = r1.astype(jnp.bfloat16)
-    v3 = (r1 - v2.astype(jnp.float32)).astype(jnp.bfloat16)
     dn = (((1,), (0,)), ((), ()))
 
-    # single matmul with the 3 mantissa terms stacked into M (3·C rows,
-    # one pass) instead of 3 separate C-row passes; the per-term sums
-    # recombine with one VPU add — same products, same f32 accumulate
+    # single matmul with the mantissa terms stacked into M (terms·C
+    # rows, one pass) instead of separate C-row passes; the per-term
+    # sums recombine with one VPU add — same products, f32 accumulate
     C = vals_t.shape[0]
-    V = jnp.concatenate([v1, v2, v3], axis=0)        # [3·C, T] bf16
+    V = _mantissa_terms(vals_t, terms)               # [terms·C, T] bf16
     acc = lax.dot_general(V, onehot, dimension_numbers=dn,
                           preferred_element_type=jnp.float32)
-    acc = acc.reshape(3, C, nbt)
-    out_ref[0] += acc[0] + acc[1] + acc[2]           # [C, NBT] on the MXU
+    acc = acc.reshape(terms, C, nbt)
+    out_ref[0] += acc.sum(axis=0)                    # [C, NBT] on the MXU
 
 
 def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int,
@@ -336,7 +355,8 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int,
     # varying-mesh-axes set or jax's vma check rejects the call
     vma = getattr(jax.typeof(vals), "vma", frozenset()) or frozenset()
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_bins=n_bins, nbt=nbt),
+        functools.partial(_hist_kernel, n_bins=n_bins, nbt=nbt,
+                          terms=_TERMS),
         out_shape=jax.ShapeDtypeStruct((F, C, nB), jnp.float32, vma=vma),
         grid=grid,
         in_specs=[

@@ -50,7 +50,8 @@ def make_table(rows: int, seed: int = 0):
 
 
 def run_shape(rows: int, max_models: int, nfolds: int,
-              max_runtime_secs: float | None = None) -> dict:
+              max_runtime_secs: float | None = None,
+              exclude_algos=None) -> dict:
     import traceback
 
     import jax
@@ -71,6 +72,7 @@ def run_shape(rows: int, max_models: int, nfolds: int,
         t0 = time.perf_counter()
         aml = AutoML(max_models=max_models, nfolds=nfolds, seed=1,
                      max_runtime_secs=max_runtime_secs,
+                     exclude_algos=exclude_algos,
                      project_name=f"scale_{rows}")
         aml.train(y="IsDepDelayed", training_frame=fr)
         wall = time.perf_counter() - t0
@@ -117,6 +119,10 @@ def main() -> int:
                     "models+leader-AUC within the budget — the same "
                     "fixed-time framing the reference's AutoML wall-"
                     "clock comparisons use)")
+    ap.add_argument("--exclude-algos", nargs="+", default=None,
+                    help="AutoML families to skip (the 1M-row CPU "
+                    "curve drops drf/deeplearning: 100 depth-12 CPU "
+                    "trees per point measure the box, not the design)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -129,7 +135,7 @@ def main() -> int:
     rows_list = args.rows or ([10_000_000] if on_tpu
                               else [100_000, 300_000, 1_000_000])
     results = [run_shape(r, args.max_models, args.nfolds,
-                         args.max_runtime_secs)
+                         args.max_runtime_secs, args.exclude_algos)
                for r in rows_list]
     # per-model recompile check: a WARM repeat of the smallest shape
     # (same families, same row count, same plan) must compile ~nothing
@@ -143,7 +149,7 @@ def main() -> int:
     if not on_tpu and len(results) >= 1 \
             and not results[0].get("error"):
         warm = run_shape(rows_list[0], args.max_models, args.nfolds,
-                         args.max_runtime_secs)
+                         args.max_runtime_secs, args.exclude_algos)
         recompile_check = {
             "cold_models": results[0]["models_trained"],
             "cold_compiles": results[0]["xla_compiles"],
