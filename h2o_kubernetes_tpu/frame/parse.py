@@ -1074,6 +1074,18 @@ def _arrow_csv_eligible(setup: dict, names: list[str],
     it), pyarrow importable, and not disabled via env."""
     if os.environ.get("H2O_TPU_ARROW_CSV", "1") == "0":
         return False
+    # MAIN THREAD ONLY: pyarrow materialization segfaulted (flaky,
+    # ~3-in-4 module runs) when this path ran inside a REST handler
+    # thread on a 1-core box (tests/test_rest.py::
+    # test_model_detail_fields; crash stack in _import_csv_arrow), and
+    # ReadOptions(use_threads=False) did NOT cure it — so server-side
+    # imports take the pure-Python parser, and the 10M-row fast reader
+    # stays a Python-API (main-thread) feature. Narrowing this guard
+    # needs a root cause, not another heuristic.
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return False
     # whitespace-only lines are records to arrow but skipped by the
     # slow path; with >= 2 columns they raise a column-count error and
     # fall back, but a 1-column frame (or space separator) would
