@@ -75,17 +75,15 @@ def test_highcard_enum_binning_splits_levels():
 
 
 @pytest.mark.slow
-def test_arrow_csv_matches_python_parser(tmp_path):
+def test_arrow_csv_matches_python_parser(tmp_path, monkeypatch):
     import h2o_kubernetes_tpu.frame.parse as P
 
     p = str(tmp_path / "air.csv")
     D.airlines_csv(p, 5_000, chunk=5_000)
+    monkeypatch.delenv("H2O_TPU_ARROW_CSV", raising=False)
     fr = P.import_file(p)
-    os.environ["H2O_TPU_ARROW_CSV"] = "0"
-    try:
-        fr2 = P.import_file(p)
-    finally:
-        os.environ.pop("H2O_TPU_ARROW_CSV", None)
+    monkeypatch.setenv("H2O_TPU_ARROW_CSV", "0")
+    fr2 = P.import_file(p)
     assert fr.names == fr2.names
     for n in fr.names:
         a, b = fr.vec(n), fr2.vec(n)
@@ -93,3 +91,38 @@ def test_arrow_csv_matches_python_parser(tmp_path):
         x = np.asarray(a.data)[: fr.nrows]
         y = np.asarray(b.data)[: fr2.nrows]
         assert np.allclose(x, y, equal_nan=True), n
+
+
+def test_arrow_blank_line_before_header(tmp_path, monkeypatch):
+    """A blank line before the header must not shift arrow's skip_rows
+    (review finding: physical-line counting made the header a data
+    row); both parsers must agree."""
+    import h2o_kubernetes_tpu.frame.parse as P
+
+    p = str(tmp_path / "b.csv")
+    with open(p, "w") as f:
+        f.write("\n  \na,b\n1,x\n2,y\n3,x\n")
+    monkeypatch.delenv("H2O_TPU_ARROW_CSV", raising=False)
+    fr = P.import_file(p)
+    assert fr.nrows == 3 and fr.names == ["a", "b"]
+    assert fr.vec("b").domain == ["x", "y"]
+    monkeypatch.setenv("H2O_TPU_ARROW_CSV", "0")
+    fr2 = P.import_file(p)
+    assert fr2.nrows == 3 and fr2.names == fr.names
+    np.testing.assert_allclose(
+        np.asarray(fr.vec("a").data)[:3],
+        np.asarray(fr2.vec("a").data)[:3])
+
+
+def test_single_column_csv_uses_python_parser(tmp_path):
+    """1-column frames are ineligible for the arrow path (whitespace-
+    only lines would silently become NA rows there) — the pure-Python
+    parser must handle them, skipping blank lines."""
+    import h2o_kubernetes_tpu.frame.parse as P
+
+    p = str(tmp_path / "one.csv")
+    with open(p, "w") as f:
+        f.write("name\nalpha\n \nbeta\n")
+    fr = P.import_file(p)
+    assert fr.nrows == 2
+    assert fr.vec("name").domain == ["alpha", "beta"]
