@@ -17,9 +17,19 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_COORDINATOR | — | jax.distributed coordinator (runtime/mesh) |
 | H2O_TPU_NUM_PROCESSES | 1 | multi-host process count (runtime/mesh) |
 | H2O_TPU_PROCESS_ID | 0 | this host's process id (runtime/mesh) |
+| H2O_TPU_HIST_TERMS | 3 | bf16 mantissa terms (2 = throughput mode, ~2⁻¹⁶ products; ops/histogram) |
+| H2O_TPU_HIST_DIMSEM | 1 | 0 drops the Pallas grid dimension_semantics annotation (compile-regression escape hatch) |
+| H2O_TPU_HIST_BYTES_BUDGET | 2³⁰ | deep-tree level-histogram memory budget (models/gbm validation + grouped-DRF sizing) |
+| H2O_TPU_CV_SHAPE_SHARE_ROWS | tpu≤1M | weights-masked CV row threshold; 0 disables, N forces on any backend (models/cv) |
+| H2O_TPU_ARROW_CSV | 1 | 0 disables the pyarrow CSV fast path (frame/parse) |
+| H2O_TPU_PROBE_BUDGET | 600 | backend-probe stubbornness seconds (runtime/backend) |
+| JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset |
 
-The last three are the operator's injection contract and are consumed
-directly by `runtime/mesh.initialize_distributed`.
+COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection
+contract, consumed directly by `runtime/mesh.initialize_distributed`.
+The knobs below the line are read at USE time by their owning modules
+(perf/robustness switches, not cluster identity), so they stay
+env-only rather than entering the programmatic tier.
 
 Caveat: `hist_impl` is read when a training program is TRACED; XLA
 executables already compiled for a shape keep the kernel they were
