@@ -303,6 +303,12 @@ class AutoML:
                     (model_id, model))
                 self._log(f"{model_id}: resumed from checkpoint")
                 return True
+            from .runtime import faults
+
+            # fault point: one plan step about to TRAIN (resumed steps
+            # above don't count) — lets chaos drills kill run N's step K
+            # deterministically and assert the resume round-trip
+            faults.fire("automl.step", step=model_id)
             est = _EST[fam](
                 **params, seed=self.seed,
                 nfolds=self.nfolds, fold_assignment="modulo",
@@ -325,7 +331,28 @@ class AutoML:
                       f"{metrics.get(metric, float('nan')):.5f}")
             return True
 
-        from .runtime.health import ClusterHealthError
+        from .runtime.health import (ClusterHealthError, healthy,
+                                     is_device_error, mark_unhealthy)
+
+        def step_failed(name: str, e: Exception) -> None:
+            """A failed step never kills the run — UNLESS it took the
+            cluster down with it (a device error escaping the training
+            step): then every later step would fail too, so escalate to
+            the same clean job failure a ClusterHealthError gets."""
+            self._log(f"{name} failed: {e!r}")
+            if is_device_error(e) and healthy():
+                # a REAL XLA runtime error from a training loop's direct
+                # shard_map dispatch reaches here without having flipped
+                # health (only doall/predict run under device_dispatch)
+                # — flip it now, or the plan grinds through every
+                # remaining step against a dead mesh
+                mark_unhealthy(f"device error during {name}: {e}")
+            if not healthy():
+                err = ClusterHealthError(
+                    f"cluster died during {name}: {e!r} — restart and "
+                    "rerun with the same checkpoint_dir to resume")
+                self.job.failed(repr(err))
+                raise err from e
 
         for fam, name, params in plan:
             if out_of_budget():
@@ -341,8 +368,8 @@ class AutoML:
                 # (reference fail-fast semantics, SURVEY.md §5.3)
                 self.job.failed(repr(e))
                 raise
-            except Exception as e:       # a failed step never kills the run
-                self._log(f"{name} failed: {e!r}")
+            except Exception as e:
+                step_failed(name, e)
             n_done += 1
             self.job.update(min(0.8, n_done / max(budget or 20, 1)))
 
@@ -362,7 +389,7 @@ class AutoML:
                 self.job.failed(repr(e))
                 raise
             except Exception as e:
-                self._log(f"grid {fam} failed: {e!r}")
+                step_failed(f"grid {fam}", e)
             n_done += 1
             self.job.update(min(0.9, n_done / max(budget or 20, 1)))
 

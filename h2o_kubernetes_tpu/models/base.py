@@ -222,25 +222,42 @@ class Model:
         return jnp.stack(cols, axis=1)
 
     def predict_raw(self, frame: Frame) -> np.ndarray:
-        """[n, K] class probabilities, or [n] regression predictions."""
-        X = self._design_matrix(frame)
-        if getattr(self, "offset_column", None):
-            # a model trained with an offset needs it at scoring time
-            # too (hex/Model.adaptTestForTrain errors likewise [U3])
-            if self.offset_column not in frame:
-                raise ValueError(
-                    f"this model was trained with offset_column="
-                    f"'{self.offset_column}' which is missing from the "
-                    "scoring frame")
-            # NA offsets propagate: a row with no defined base margin
-            # has no defined prediction (training likewise drops such
-            # rows via w=0) — coercing to 0 would return a confident
-            # number for a row the model cannot score
-            off = frame.vec(self.offset_column).as_float()
-            out = np.asarray(self._score_matrix(X, offset=off))
-            return out[: frame.nrows]
-        out = np.asarray(self._score_matrix(X))[: frame.nrows]
-        return out
+        """[n, K] class probabilities, or [n] regression predictions.
+
+        Scoring fails fast on a locked cloud (same gate as training)
+        and runs its dispatch under the device guard: a runtime error
+        escaping the mesh mid-predict (halted chip, dead ICI link)
+        surfaces as ClusterHealthError with the locked-cloud recovery
+        message, not a raw XLA traceback."""
+        from ..runtime.health import device_dispatch, require_healthy
+
+        # scoring is not a training chunk boundary: it must never
+        # consume an armed train.step fault's skip/count budget
+        require_healthy(fault_site=None)
+        # the guard covers the design-matrix build too: it dispatches
+        # per-column device ops, so a chip halting there must surface
+        # the same way as one halting mid-score (ValueErrors from the
+        # validation below pass through the guard untouched)
+        with device_dispatch("model scoring"):
+            X = self._design_matrix(frame)
+            if getattr(self, "offset_column", None):
+                # a model trained with an offset needs it at scoring
+                # time too (hex/Model.adaptTestForTrain errors likewise
+                # [U3])
+                if self.offset_column not in frame:
+                    raise ValueError(
+                        f"this model was trained with offset_column="
+                        f"'{self.offset_column}' which is missing from "
+                        "the scoring frame")
+                # NA offsets propagate: a row with no defined base
+                # margin has no defined prediction (training likewise
+                # drops such rows via w=0) — coercing to 0 would return
+                # a confident number for a row the model cannot score
+                off = frame.vec(self.offset_column).as_float()
+                out = np.asarray(self._score_matrix(X, offset=off))
+                return out[: frame.nrows]
+            out = np.asarray(self._score_matrix(X))[: frame.nrows]
+            return out
 
     def predict(self, frame: Frame) -> Frame:
         """H2O-style prediction frame: `predict` (+ per-class probs)."""

@@ -29,7 +29,7 @@ import numpy as np
 from jax import lax
 
 from ..frame import Frame
-from ..runtime.health import require_healthy
+from ..runtime.health import device_dispatch, require_healthy
 from ..runtime.mesh import global_mesh
 from .base import Model, TrainData, resolve_xy
 from .tree.binning import BinSpec, apply_bins, apply_bins_jit, fit_bins
@@ -564,22 +564,33 @@ class GBM:
                 # a blocking host sync)
                 n = min(n, score - (t - start_t) % score)
             key, kc = jax.random.split(key)
-            if K == 1 and p._drf_mode:
-                # independent forest trees grow in vmapped GROUPS (the
-                # class-flattening kernel rule): G× fuller MXU M at
-                # shallow levels, G× fewer sequential level steps
-                margin, tchunk = boost_trees_drf(
-                    binned, data.y, data.w, margin, kc, n, tp, bp)
-            elif K == 1:
-                margin, tchunk = boost_trees(binned, data.y, data.w,
-                                             margin, kc, n, tp, bp)
-            else:
-                margin, tchunk = boost_trees_multi(
-                    binned, data.y, data.w, margin, kc, n, K, tp, bp)
-                # [n, K, ...] -> interleaved [n*K, ...] (class fastest),
-                # the layout _margins de-interleaves with a[k::K]
-                tchunk = jax.tree.map(
-                    lambda a: a.reshape((-1,) + a.shape[2:]), tchunk)
+            # the boost dispatch runs under the device guard: a chip
+            # halting AT dispatch marks the cluster unhealthy and
+            # raises ClusterHealthError (locked-cloud protocol) — this
+            # loop dispatches shard_map directly, bypassing doall's
+            # guard. Deliberately NOT block_until_ready: chunk
+            # pipelining is the loop's perf design, so a mid-EXECUTION
+            # device error instead surfaces at the metrics/model read
+            # and is escalated to the same locked-cloud failure by
+            # AutoML's step_failed device-error check
+            with device_dispatch("gbm boost dispatch"):
+                if K == 1 and p._drf_mode:
+                    # independent forest trees grow in vmapped GROUPS
+                    # (the class-flattening kernel rule): G× fuller MXU
+                    # M at shallow levels, G× fewer sequential steps
+                    margin, tchunk = boost_trees_drf(
+                        binned, data.y, data.w, margin, kc, n, tp, bp)
+                elif K == 1:
+                    margin, tchunk = boost_trees(
+                        binned, data.y, data.w, margin, kc, n, tp, bp)
+                else:
+                    margin, tchunk = boost_trees_multi(
+                        binned, data.y, data.w, margin, kc, n, K, tp, bp)
+                    # [n, K, ...] -> interleaved [n*K, ...] (class
+                    # fastest), the layout _margins de-interleaves with
+                    # a[k::K]
+                    tchunk = jax.tree.map(
+                        lambda a: a.reshape((-1,) + a.shape[2:]), tchunk)
             chunks.append(tchunk)
             t += n
             if score and (t - start_t) % score == 0:

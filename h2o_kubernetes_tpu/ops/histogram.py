@@ -42,6 +42,16 @@ ROW_TILE = 1024     # bin-blocked kernel's row tile (its [T, nbt] one-hot
 #                     is VMEM-bounded: 4 MB bf16 at T=1024, nbt=2048)
 
 
+def _out_struct(shape, dtype, vma) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct threading the vma set where the running jax
+    supports it; older builds have neither the kwarg nor the vma check
+    that needs it (runtime/compat.py disables check_rep there)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _fact_row_tile(n_hi: int, rows: int) -> int:
     """Row tile for the factorized kernel. Wider tiles amortize
     per-grid-step overhead (the bench shape runs ~250 steps/level at
@@ -75,9 +85,15 @@ _DIMSEM = _os.environ.get("H2O_TPU_HIST_DIMSEM", "1") != "0"
 _TERMS = 2 if _os.environ.get("H2O_TPU_HIST_TERMS", "3") == "2" else 3
 
 
+# renamed TPUCompilerParams -> CompilerParams across pallas releases;
+# same dimension_semantics kwarg either way
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams",
+                           getattr(pltpu, "TPUCompilerParams", None))
+
+
 def _dimsem(*sems):
-    return pltpu.CompilerParams(dimension_semantics=sems) \
-        if _DIMSEM else None
+    return _COMPILER_PARAMS(dimension_semantics=sems) \
+        if _DIMSEM and _COMPILER_PARAMS is not None else None
 
 
 def _hist_segment(binned, rel, vals, n_nodes: int, n_bins: int):
@@ -257,8 +273,8 @@ def _hist_pallas_fact(binned, rel, vals, n_nodes: int, n_bins: int,
     out = pl.pallas_call(
         functools.partial(_hist_fact_kernel, n_bins=n_bins, n_hi=n_hi,
                           n_ch=C, fg=fg, terms=_TERMS),
-        out_shape=jax.ShapeDtypeStruct((n_fg, fg, C * n_hi, 128),
-                                       jnp.float32, vma=vma),
+        out_shape=_out_struct((n_fg, fg, C * n_hi, 128),
+                              jnp.float32, vma),
         grid=grid,
         in_specs=[
             pl.BlockSpec((fg, 1, 1, rt_size),
@@ -357,7 +373,7 @@ def _hist_pallas(binned, rel, vals, n_nodes: int, n_bins: int,
     out = pl.pallas_call(
         functools.partial(_hist_kernel, n_bins=n_bins, nbt=nbt,
                           terms=_TERMS),
-        out_shape=jax.ShapeDtypeStruct((F, C, nB), jnp.float32, vma=vma),
+        out_shape=_out_struct((F, C, nB), jnp.float32, vma),
         grid=grid,
         in_specs=[
             pl.BlockSpec((ROW_TILE,),

@@ -51,10 +51,13 @@ def _mem_write(path: str, data: bytes) -> None:
 
 
 def _http_read(path: str) -> bytes:
-    import urllib.request
+    # one transient classifier for every persist HTTP verb: retries
+    # 429/5xx (honoring Retry-After)/timeouts/resets/truncation, maps
+    # 404 on this read to FileNotFoundError, fires the persist.http
+    # fault point
+    from .persist_cloud import _http
 
-    with urllib.request.urlopen(path, timeout=60) as r:  # noqa: S310
-        return r.read()
+    return _http("GET", path)
 
 
 def _http_write(path: str, data: bytes) -> None:

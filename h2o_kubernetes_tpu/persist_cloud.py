@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import http.client
 import json
 import os
 import urllib.error
@@ -38,23 +39,76 @@ __all__ = ["s3_read", "s3_write", "gs_read", "gs_write",
            "hdfs_read", "hdfs_write"]
 
 
-def _http(method: str, url: str, data: bytes | None = None,
-          headers: dict | None = None, timeout: float = 60.0) -> bytes:
-    req = urllib.request.Request(url, data=data, method=method,
-                                 headers=headers or {})
+def _retry_after(e: urllib.error.HTTPError) -> float | None:
+    """Seconds from a Retry-After header (numeric form only — the
+    HTTP-date form is rare on object stores and not worth a parser)."""
     try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
-            return r.read()
-    except urllib.error.HTTPError as e:
-        body = e.read()[:300].decode(errors="replace")
-        if e.code == 404:
-            # missing-object reads behave like a missing local file so
-            # callers (e.g. the AutoML resume manifest) can distinguish
-            # "not there yet" from auth/transport failures
-            raise FileNotFoundError(f"{method} {url} -> HTTP 404") \
-                from None
-        raise IOError(
-            f"{method} {url} -> HTTP {e.code}: {body}") from None
+        raw = e.headers.get("Retry-After") if e.headers else None
+        return float(raw) if raw else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _http(method: str, url: str, data: bytes | None = None,
+          headers=None, timeout: float = 60.0,
+          read: bool | None = None, policy=None) -> bytes:
+    """One HTTP verb with the shared retry/backoff policy.
+
+    Transients — 429, 5xx (honoring Retry-After), timeouts, connection
+    resets, truncated transfers — retry under H2O_TPU_RETRY_* knobs, so
+    an S3/GCS/WebHDFS blip no longer destroys a model save or an AutoML
+    checkpoint. `read` marks the verb as a data fetch: ONLY reads map
+    HTTP 404 to FileNotFoundError (callers like the resume manifest
+    probe for "not there yet"); a 404 on a write (a WebHDFS CREATE
+    redirect target or a deleted GCS upload session) is an IOError —
+    the object is not "missing", the write path is broken.
+
+    `headers` may be a dict or a zero-arg callable re-evaluated per
+    attempt: SigV4 signatures (x-amz-date, 15-min validity) and OAuth
+    bearer tokens must be FRESH on each retry, or a long outage ridden
+    out under a raised H2O_TPU_RETRY_DEADLINE ends in a permanent 403
+    once the first attempt's signature goes stale.
+    """
+    if read is None:
+        read = method == "GET"
+    from .runtime import faults, retry
+
+    def attempt() -> bytes:
+        hdrs = headers() if callable(headers) else (headers or {})
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=hdrs)
+        try:
+            # the fault point sits INSIDE the classifier so injected
+            # errors (real HTTPError/URLError/... instances) take the
+            # exact retry/permanent path their real twins would
+            faults.fire("persist.http", method=method, url=url)
+            with urllib.request.urlopen(req, timeout=timeout) as r:  # noqa: S310
+                return r.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()[:300].decode(errors="replace")
+            if e.code == 404:
+                if read:
+                    raise FileNotFoundError(
+                        f"{method} {url} -> HTTP 404") from None
+                raise IOError(f"{method} {url} -> HTTP 404 "
+                              "(write target gone)") from None
+            if e.code == 429 or e.code >= 500:
+                raise retry.TransientError(
+                    f"{method} {url} -> HTTP {e.code}: {body}",
+                    retry_after=_retry_after(e)) from None
+            raise IOError(
+                f"{method} {url} -> HTTP {e.code}: {body}") from None
+        except http.client.IncompleteRead as e:
+            raise retry.TransientError(
+                f"{method} {url} -> truncated transfer: {e!r}") from None
+        except (TimeoutError, ConnectionError) as e:
+            raise retry.TransientError(
+                f"{method} {url} -> {e!r}") from None
+        except urllib.error.URLError as e:
+            raise retry.TransientError(
+                f"{method} {url} -> {e.reason!r}") from None
+
+    return retry.call(attempt, policy=policy, describe=f"{method} {url}")
 
 
 # -- s3:// -------------------------------------------------------------------
@@ -143,15 +197,15 @@ def _sigv4_headers(method: str, host: str, canonical_uri: str,
 def s3_read(path: str) -> bytes:
     bucket, key = _split_bucket_key(path)
     url, host, uri = _s3_url(bucket, key)
-    return _http("GET", url, headers=_sigv4_headers("GET", host, uri,
-                                                    b""))
+    return _http("GET", url,
+                 headers=lambda: _sigv4_headers("GET", host, uri, b""))
 
 
 def s3_write(path: str, data: bytes) -> None:
     bucket, key = _split_bucket_key(path)
     url, host, uri = _s3_url(bucket, key)
     _http("PUT", url, data=data,
-          headers=_sigv4_headers("PUT", host, uri, data))
+          headers=lambda: _sigv4_headers("PUT", host, uri, data))
 
 
 # -- gs:// -------------------------------------------------------------------
@@ -174,7 +228,7 @@ def gs_read(path: str) -> bytes:
     bucket, key = _split_bucket_key(path)
     obj = urllib.parse.quote(key, safe="")
     url = (f"{_gs_endpoint()}/storage/v1/b/{bucket}/o/{obj}?alt=media")
-    return _http("GET", url, headers=_gs_headers())
+    return _http("GET", url, headers=_gs_headers)
 
 
 def gs_write(path: str, data: bytes) -> None:
@@ -182,9 +236,9 @@ def gs_write(path: str, data: bytes) -> None:
     name = urllib.parse.quote(key, safe="")
     url = (f"{_gs_endpoint()}/upload/storage/v1/b/{bucket}/o"
            f"?uploadType=media&name={name}")
-    headers = {"Content-Type": "application/octet-stream",
-               **_gs_headers()}
-    _http("POST", url, data=data, headers=headers)
+    _http("POST", url, data=data,
+          headers=lambda: {"Content-Type": "application/octet-stream",
+                           **_gs_headers()})
 
 
 # -- hdfs:// -----------------------------------------------------------------
@@ -229,21 +283,48 @@ def hdfs_write(path: str, data: bytes) -> None:
     directly (2xx, no redirect) get the data in a second direct PUT."""
     url = (f"{_webhdfs_base()}/webhdfs/v1{_hdfs_path(path)}"
            f"?op=CREATE&overwrite=true")
-    opener = urllib.request.build_opener(_NoRedirect)
-    req = urllib.request.Request(url, method="PUT")
     ct = {"Content-Type": "application/octet-stream"}
-    try:
-        with opener.open(req, timeout=60) as r:
-            r.read()
-        target = url                  # direct-accepting endpoint
-    except urllib.error.HTTPError as e:
-        if e.code in (301, 302, 307) and e.headers.get("Location"):
-            target = e.headers["Location"]
-        else:
+    from .runtime import faults, retry
+
+    def create() -> str:
+        """Namenode step: returns the datanode target (or `url` itself
+        for direct-accepting gateways). Transients propagate to the
+        whole-dance retry below — a namenode failover 503s for a few
+        seconds."""
+        opener = urllib.request.build_opener(_NoRedirect)
+        req = urllib.request.Request(url, method="PUT")
+        try:
+            faults.fire("persist.http", method="PUT", url=url)
+            with opener.open(req, timeout=60) as r:
+                r.read()
+            return url                # direct-accepting endpoint
+        except urllib.error.HTTPError as e:
+            if e.code in (301, 302, 307) and e.headers.get("Location"):
+                return e.headers["Location"]
             body = e.read()[:300].decode(errors="replace")
+            if e.code == 429 or e.code >= 500:
+                raise retry.TransientError(
+                    f"PUT {url} -> HTTP {e.code}: {body}",
+                    retry_after=_retry_after(e)) from None
+            # note: a 404 here is an IOError, not FileNotFoundError —
+            # CREATE is a write; "the file isn't there yet" is its job
             raise IOError(
                 f"PUT {url} -> HTTP {e.code}: {body}") from None
-    _http("PUT", target, data=data, headers=ct)
+        except (TimeoutError, ConnectionError) as e:
+            raise retry.TransientError(f"PUT {url} -> {e!r}") from None
+        except urllib.error.URLError as e:
+            raise retry.TransientError(
+                f"PUT {url} -> {e.reason!r}") from None
+
+    def dance() -> None:
+        """One CREATE + data PUT. The data PUT gets a SINGLE attempt:
+        a dead datanode must send the retry back through CREATE for a
+        FRESH redirect target, not hammer the stale one."""
+        target = create()
+        _http("PUT", target, data=data, headers=ct,
+              policy=retry.RetryPolicy(attempts=1))
+
+    retry.call(dance, describe=f"hdfs CREATE+PUT {url}")
 
 
 def register(schemes: dict) -> None:

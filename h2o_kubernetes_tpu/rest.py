@@ -26,6 +26,8 @@ import traceback
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .runtime.health import ClusterHealthError
+
 FRAMES: dict[str, object] = {}     # key -> Frame (DKV analog)
 MODELS: dict[str, object] = {}     # key -> Model
 AUTOML: dict[str, object] = {}     # project_name -> AutoML
@@ -145,6 +147,19 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, code: int, msg: str):
         self._json({"__schema": "H2OErrorV3", "http_status": code,
                     "msg": msg}, code)
+
+    def _unhealthy_503(self) -> bool:
+        """Send 503 + the health error when the cloud is locked-
+        unhealthy — graceful degradation instead of spawning a doomed
+        job (or a 500 with a raw traceback). False when healthy."""
+        from .runtime import health
+
+        if health.healthy():
+            return False
+        err = health.health_status()["error"]
+        self._error(503, f"cluster unhealthy: {err} — restart the "
+                    "cluster and resume from the last checkpoint")
+        return True
 
     def _params(self) -> dict:
         q = urllib.parse.urlparse(self.path).query
@@ -300,6 +315,8 @@ class _Handler(BaseHTTPRequestHandler):
                         pass            # best-effort, not the contract
                 return self._json(out)
             return self._error(404, f"no route for GET {path}")
+        except ClusterHealthError as e:
+            return self._error(503, str(e))
         except Exception as e:       # noqa: BLE001
             traceback.print_exc()
             return self._error(500, repr(e))
@@ -308,6 +325,11 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             path = urllib.parse.urlparse(self.path).path.rstrip("/")
             params = self._params()
+            # every POST verb does device work (parse shards onto the
+            # mesh, builds/predictions dispatch collectives): on a dead
+            # cloud degrade to 503 up front — reads (GET) stay served
+            if self._unhealthy_503():
+                return None
             if path == "/3/ImportFiles" or path == "/3/Parse":
                 from .frame import import_file
 
@@ -350,6 +372,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"predictions_frame": {"name": key},
                                    **_frame_schema(key, pred)})
             return self._error(404, f"no route for POST {path}")
+        except ClusterHealthError as e:
+            # the cloud died between the up-front gate and the dispatch
+            return self._error(503, str(e))
         except Exception as e:       # noqa: BLE001
             traceback.print_exc()
             return self._error(500, repr(e))
@@ -404,7 +429,10 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 fn()
                 job.done()
-            except Exception as e:     # noqa: BLE001
+            except BaseException as e:  # noqa: BLE001 — a worker dying
+                # for ANY reason (incl. SystemExit from a wedged
+                # runtime) must land on the Job, never leave it RUNNING
+                # forever for pollers of /3/Jobs
                 traceback.print_exc()
                 job.failed(repr(e))
 

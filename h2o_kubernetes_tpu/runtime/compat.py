@@ -1,0 +1,47 @@
+"""JAX version-compatibility shims.
+
+The runtime targets the public `jax.shard_map` API (promoted out of
+jax.experimental in newer releases). Older jaxlib/jax builds — like
+the baked-in toolchain on some pod images — only ship
+`jax.experimental.shard_map.shard_map`, whose signature is compatible
+with every call site here (f, mesh=, in_specs=, out_specs=). Alias it
+onto the jax module once, at runtime-package import, so 18 call sites
+across models/ and runtime/ stay written against the public name.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    try:
+        import inspect
+
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        if "check_vma" in inspect.signature(_shard_map).parameters:
+            jax.shard_map = _shard_map
+        else:
+            # the replication-check kwarg was renamed check_rep ->
+            # check_vma when shard_map went public. The old checker
+            # also lacks replication rules the kernels rely on (e.g.
+            # custom_vmap_call from the histogram op), so on old jax
+            # the check is disabled outright — it is a static
+            # validation pass with no runtime semantics
+            def _compat_shard_map(f, *args, **kw):
+                kw.pop("check_vma", None)
+                kw["check_rep"] = False
+                return _shard_map(f, *args, **kw)
+
+            jax.shard_map = _compat_shard_map
+    except ImportError:     # pragma: no cover — very old jax; let call
+        pass                # sites raise their own AttributeError
+
+if not hasattr(jax, "typeof"):
+    # jax.typeof (public aval accessor) postdates this jax; the
+    # classic spelling returns the same ShapedArray for concrete
+    # arrays AND tracers (histogram.py reads .vma off it, which simply
+    # doesn't exist here — callers already getattr with a default)
+    import jax.core as _jax_core
+
+    jax.typeof = _jax_core.get_aval

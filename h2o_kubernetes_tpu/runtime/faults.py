@@ -1,0 +1,246 @@
+"""Deterministic fault injection — rehearse failures without a real outage.
+
+TPU_PROBE_r05.txt logged 132 consecutive probe hangs we could never
+rehearse against: the detection path (health.py), the retry path
+(retry.py / persist_cloud.py) and the checkpoint-restart path (automl
+resume manifest) were all only exercisable by waiting for real
+infrastructure to fail. This module makes those paths testable on CPU:
+named fault *points* in the runtime call `fire(site)`, and armed fault
+*specs* decide whether that call raises, hangs, or passes through.
+
+Fault points wired through the runtime:
+
+- ``persist.http``  — every cloud-persist HTTP attempt (persist_cloud
+  _http / WebHDFS CREATE, persist._http_read). Kinds: ``http_<code>``
+  (raises a real urllib HTTPError, e.g. http_503 / http_429 — param
+  sets a Retry-After header), ``timeout``, ``urlerror``, ``truncate``
+  (an IncompleteRead, the partial-write/read signature).
+- ``health.probe``  — the heartbeat's collective probe. Kinds:
+  ``hang`` (sleeps param seconds, default 3600 — the wedged-mesh
+  signature), ``error``.
+- ``train.step``    — every `require_healthy()` chunk-boundary guard in
+  the training hot loops (GBM/DRF/XGBoost/GLM/DL + resolve_xy). Kind
+  ``device_error`` marks the cluster unhealthy and raises
+  InjectedDeviceError — a device error escaping a training step.
+- ``mrtask.doall``  — MRTask dispatch. Kind ``device_error`` as above.
+- ``automl.step``   — one AutoML plan step about to train (resumed
+  steps don't count). Kind ``device_error`` kills the run mid-plan.
+
+Spec grammar (documented in docs/RESILIENCE.md)::
+
+    spec     := clause (";" clause)*          # "," also separates
+    clause   := site ":" kind ["*" count] ["@" skip] ["~" param]
+    count    := int | "inf"                   # how many times to fire (default 1)
+    skip     := int                           # matching calls to let through first
+    param    := float                         # kind-specific (seconds / Retry-After)
+
+Examples::
+
+    persist.http:http_503*2          # first two persist HTTP calls 503
+    health.probe:hang~0.5            # probe sleeps 0.5 s (longer than its deadline)
+    train.step:device_error@3        # 4th chunk boundary loses the mesh
+    persist.http:http_429~0.05;train.step:device_error
+
+Activation: the ``H2O_TPU_FAULTS`` env var (parsed lazily, counters
+live for the process), or the ``inject(spec)`` context manager (test
+scoped). With neither set, `fire()` is a dict lookup and a return —
+safe in hot loops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import re
+import threading
+import time
+import urllib.error
+from dataclasses import dataclass
+from email.message import Message
+from typing import Iterator
+
+__all__ = ["Fault", "FaultError", "InjectedDeviceError", "parse",
+           "inject", "fire", "active", "reset"]
+
+
+class FaultError(RuntimeError):
+    """Base class for errors raised by an injected fault."""
+
+
+class InjectedDeviceError(FaultError):
+    """Simulated device/runtime error escaping a dispatch (the XLA
+    'DEADLINE_EXCEEDED / device halted' family)."""
+
+
+@dataclass
+class Fault:
+    """One armed fault: fires `count` times at `site` after letting
+    `skip` matching calls through."""
+
+    site: str
+    kind: str
+    count: float = 1          # float so "inf" arms a permanent fault
+    skip: int = 0
+    param: float | None = None
+
+    def spec(self) -> str:
+        out = f"{self.site}:{self.kind}"
+        if self.count != 1:
+            out += f"*{'inf' if self.count == float('inf') else int(self.count)}"
+        if self.skip:
+            out += f"@{self.skip}"
+        if self.param is not None:
+            out += f"~{self.param:g}"
+        return out
+
+
+_CLAUSE = re.compile(
+    r"^(?P<site>[\w.]+):(?P<kind>\w+)"
+    r"(?:\*(?P<count>\d+|inf))?"
+    r"(?:@(?P<skip>\d+))?"
+    r"(?:~(?P<param>\d+(?:\.\d+)?))?$")
+
+
+def parse(spec: str) -> list[Fault]:
+    """Parse a fault-spec string into armed Fault objects."""
+    out = []
+    for clause in re.split(r"[;,]", spec):
+        clause = clause.strip()
+        if not clause:
+            continue
+        m = _CLAUSE.match(clause)
+        if not m:
+            raise ValueError(
+                f"bad fault clause {clause!r} — expected "
+                "site:kind[*count][@skip][~param] (see docs/RESILIENCE.md)")
+        out.append(Fault(
+            site=m["site"], kind=m["kind"],
+            count=float("inf") if m["count"] == "inf"
+            else int(m["count"] or 1),
+            skip=int(m["skip"] or 0),
+            param=float(m["param"]) if m["param"] else None))
+    return out
+
+
+_lock = threading.Lock()
+_CTX: list[Fault] = []                 # inject()-scoped faults
+# env-armed faults, cached against the env string so counters persist
+# across fire() calls but a CHANGED env value re-arms fresh counters
+_ENV_CACHE: tuple[str, list[Fault]] | None = None
+
+
+def _armed() -> list[Fault]:
+    """All armed faults (context-scoped first), under _lock."""
+    global _ENV_CACHE
+    env = os.environ.get("H2O_TPU_FAULTS", "")
+    if not env:
+        _ENV_CACHE = None
+        return list(_CTX)
+    if _ENV_CACHE is None or _ENV_CACHE[0] != env:
+        _ENV_CACHE = (env, parse(env))
+    return list(_CTX) + _ENV_CACHE[1]
+
+
+def active() -> list[str]:
+    """Specs of armed, non-exhausted faults (introspection/status)."""
+    with _lock:
+        return [f.spec() for f in _armed() if f.count > 0]
+
+
+def reset() -> None:
+    """Disarm everything — context faults AND env-armed ones.
+
+    The current H2O_TPU_FAULTS value is pinned to an EMPTY armed list
+    (not just dropped from the cache): otherwise the next fire() would
+    re-parse the unchanged env var and resurrect exhausted faults with
+    fresh counters. A *changed* env value still re-arms normally."""
+    global _ENV_CACHE
+    with _lock:
+        _CTX.clear()
+        env = os.environ.get("H2O_TPU_FAULTS", "")
+        _ENV_CACHE = (env, []) if env else None
+
+
+@contextlib.contextmanager
+def inject(spec: str | list[Fault]) -> Iterator[list[Fault]]:
+    """Arm faults for the duration of a with-block (test scoped)."""
+    faults = parse(spec) if isinstance(spec, str) else list(spec)
+    with _lock:
+        _CTX.extend(faults)
+    try:
+        yield faults
+    finally:
+        with _lock:
+            for f in faults:
+                try:
+                    _CTX.remove(f)
+                except ValueError:
+                    pass
+
+
+def fire(site: str, **ctx) -> None:
+    """Fault point: called by the runtime at a named site.
+
+    Finds the first armed fault for `site`; consumes one skip or one
+    count; raises/sleeps per the fault kind. No armed faults → returns
+    immediately (the hot-loop fast path).
+    """
+    if not _CTX and not os.environ.get("H2O_TPU_FAULTS"):
+        return
+    fault, desc = None, ""
+    with _lock:
+        for f in _armed():
+            if f.site != site or f.count <= 0:
+                continue
+            if f.skip > 0:
+                f.skip -= 1
+                return
+            desc = f.spec()           # before the decrement, for logs
+            f.count -= 1
+            fault = f
+            break
+    if fault is None:
+        return
+    from ..diagnostics import log, timeline
+
+    timeline.record("fault_injected", desc, site=site, **{
+        k: str(v)[:120] for k, v in ctx.items()})
+    log.warning("fault injected at %s: %s", site, desc)
+    _trigger(fault, site, ctx)
+
+
+def _trigger(fault: Fault, site: str, ctx: dict) -> None:
+    kind = fault.kind
+    if kind.startswith("http_"):
+        code = int(kind[len("http_"):])
+        hdrs = Message()
+        if fault.param is not None:
+            hdrs["Retry-After"] = f"{fault.param:g}"
+        raise urllib.error.HTTPError(
+            str(ctx.get("url", "injected://fault")), code,
+            f"injected HTTP {code}", hdrs, io.BytesIO(b"injected fault"))
+    if kind == "timeout":
+        raise TimeoutError(f"injected timeout at {site}")
+    if kind == "urlerror":
+        raise urllib.error.URLError(f"injected connection failure at {site}")
+    if kind == "truncate":
+        import http.client
+
+        raise http.client.IncompleteRead(b"", expected=1)
+    if kind == "hang":
+        time.sleep(fault.param if fault.param is not None else 3600.0)
+        return
+    if kind == "device_error":
+        # a device error escaping a training step takes the mesh down:
+        # flip health first so the next chunk-boundary guard fails fast
+        # with the locked-cloud error (reference semantics, SURVEY §5.3)
+        from . import health
+
+        msg = (f"injected device error at {site} "
+               "(fault harness, kind=device_error)")
+        health.mark_unhealthy(msg)
+        raise InjectedDeviceError(msg)
+    if kind == "error":
+        raise FaultError(f"injected error at {site}")
+    raise ValueError(f"unknown fault kind {kind!r} (site {site})")

@@ -20,6 +20,7 @@ as a clean error, not a hang. Recovery is checkpoint-restart
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -32,6 +33,10 @@ _state = {
 _lock = threading.Lock()
 _thread: threading.Thread | None = None
 _stop = threading.Event()
+# the in-flight probe worker: once the mesh wedges, every heartbeat()
+# would otherwise leak one more hung daemon thread (each parked inside
+# a collective that never completes) — track it and refuse to stack up
+_probe_thread: threading.Thread | None = None
 
 
 class ClusterHealthError(RuntimeError):
@@ -40,6 +45,9 @@ class ClusterHealthError(RuntimeError):
 
 def _probe() -> float:
     """One heartbeat: psum a scalar across the whole mesh."""
+    from . import faults
+
+    faults.fire("health.probe")   # rehearse hangs/errors without a TPU
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -65,7 +73,13 @@ def heartbeat(timeout: float = 60.0) -> bool:
     The probe runs on a DAEMON thread joined with a timeout — an
     executor/`with` block would join the hung worker (the very failure
     this probe detects) and block heartbeat() itself, and a non-daemon
-    worker would also block interpreter exit."""
+    worker would also block interpreter exit.
+
+    A probe that outlives its deadline keeps running (nothing can
+    interrupt a thread stuck in a collective); while it is still
+    alive, further heartbeat() calls log-and-return-False instead of
+    stacking up one more hung thread per call."""
+    global _probe_thread
     box: dict = {}
 
     def run():
@@ -74,8 +88,32 @@ def heartbeat(timeout: float = 60.0) -> bool:
         except Exception as e:  # noqa: BLE001 — any device error is fatal
             box["exc"] = e
 
-    t = threading.Thread(target=run, name="h2o-tpu-probe", daemon=True)
-    t.start()
+    # check-claim-START under ONE lock hold: two concurrent heartbeats
+    # (the background loop + a direct call) must not both see the slot
+    # free and spawn two probes into the same hung collective. The
+    # start() must happen inside the lock too — an unstarted Thread
+    # reports is_alive()==False, so a claimed-but-not-started probe
+    # would look like a free slot to the second caller.
+    with _lock:
+        if _probe_thread is not None and _probe_thread.is_alive():
+            t = None
+        else:
+            t = threading.Thread(target=run, name="h2o-tpu-probe",
+                                 daemon=True)
+            _probe_thread = t
+            t.start()
+    if t is None:
+        from ..diagnostics import log, timeline
+
+        log.warning("heartbeat: previous probe still in flight — "
+                    "skipping spawn")
+        timeline.record("heartbeat_skipped",
+                        "previous probe still in flight")
+        # no probe ran: report the standing health state. In the wedged
+        # case the earlier deadline already flipped it to False; a
+        # caller merely racing the background loop's HEALTHY in-flight
+        # probe must not read a false outage.
+        return healthy()
     t.join(timeout)
     if t.is_alive():
         ok, err = False, f"heartbeat probe hung > {timeout}s"
@@ -106,13 +144,73 @@ def health_status() -> dict:
         return dict(_state)
 
 
-def require_healthy() -> None:
-    """Fail fast (reference: jobs on a broken cloud fail cleanly)."""
+def require_healthy(fault_site: str | None = "train.step") -> None:
+    """Fail fast (reference: jobs on a broken cloud fail cleanly).
+
+    The training hot loops call this at chunk boundaries, which makes
+    it the natural ``train.step`` fault point: an armed device_error
+    flips health and raises from here — exactly where a real device
+    error escaping a training step would surface. Non-training callers
+    (doall has its own ``mrtask.doall`` site; predict/scoring) pass
+    ``fault_site=None`` so an armed train.step fault keeps its
+    documented skip-count determinism and can never be consumed by,
+    e.g., a user predict() on a healthy cluster."""
+    from . import faults
+
+    if fault_site:
+        faults.fire(fault_site)
     with _lock:
         if not _state["healthy"]:
             raise ClusterHealthError(
                 f"cluster unhealthy: {_state['error']} — restart the "
                 "cluster and resume from the last checkpoint")
+
+
+def is_device_error(e: BaseException) -> bool:
+    """True for device-runtime failures (XLA runtime errors and the
+    harness's InjectedDeviceError) — the class of exception that means
+    the mesh, not the caller's inputs, is broken."""
+    from . import faults
+
+    return isinstance(e, faults.InjectedDeviceError) or \
+        isinstance(e, _device_error_types())
+
+
+def _device_error_types() -> tuple[type, ...]:
+    try:
+        from jax.errors import JaxRuntimeError
+
+        return (JaxRuntimeError,)
+    except ImportError:
+        try:
+            from jaxlib.xla_extension import XlaRuntimeError
+
+            return (XlaRuntimeError,)
+        except ImportError:
+            return ()
+
+
+@contextlib.contextmanager
+def device_dispatch(desc: str):
+    """Guard a device dispatch: a runtime error escaping it (a halted
+    chip, a dead ICI link, an injected device_error) marks the cluster
+    unhealthy and re-surfaces as ClusterHealthError, so callers see the
+    locked-cloud protocol instead of a raw XLA traceback."""
+    from . import faults
+
+    try:
+        yield
+    except faults.InjectedDeviceError as e:
+        # the fault handler already flipped health; keep the error type
+        # callers recover from uniform
+        raise ClusterHealthError(
+            f"{desc}: {e} — restart the cluster and resume from the "
+            "last checkpoint") from e
+    except _device_error_types() as e:
+        mark_unhealthy(f"{desc}: {e}")
+        raise ClusterHealthError(
+            f"{desc}: device runtime error ({e}) — restart the cluster "
+            "and resume from the last checkpoint") from e
 
 
 def mark_unhealthy(error: str) -> None:
@@ -124,9 +222,18 @@ def mark_unhealthy(error: str) -> None:
 
 
 def reset() -> None:
-    """Clear health state (new cluster after restart)."""
+    """Clear health state (new cluster after restart).
+
+    Also abandons any still-wedged probe thread: a probe stuck in a
+    collective that never returns can't be joined, and leaving it
+    tracked would make every post-reset heartbeat skip-spawn and
+    report the standing (now healthy) state forever — the dead mesh
+    would never be re-detected. The orphaned daemon thread is leaked
+    deliberately; one fresh probe per reset is the bounded cost."""
+    global _probe_thread
     with _lock:
         _state.update(healthy=True, error="", last_beat=None, beats=0)
+        _probe_thread = None
 
 
 def start_heartbeat(interval: float = 30.0, timeout: float = 60.0) -> None:

@@ -68,9 +68,13 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
     caller asserts map_fn's computation is fully determined by the key,
     the reduce spec, and the operand shapes).
     """
-    from .health import require_healthy
+    from . import faults
+    from .health import device_dispatch, require_healthy
 
-    require_healthy()     # fail fast on a broken cloud (SURVEY.md §5.3)
+    # fail fast on a broken cloud (SURVEY.md §5.3); doall fires its OWN
+    # site, so it must not also consume train.step fault counts
+    require_healthy(fault_site=None)
+    faults.fire("mrtask.doall")
     mesh = mesh or global_mesh()
 
     if cache_key is not None:
@@ -80,7 +84,13 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
         key = (cache_key, map_fn, _freeze(reduce), donate)
         cached = _DOALL_CACHE.get(mesh, {}).get(key)
         if cached is not None:
-            return cached(*cols)
+            with device_dispatch("doall dispatch"):
+                # block inside the guard: async dispatch would surface
+                # a mid-execution device error at the CALLER's first
+                # read, outside the guard. doall results are small
+                # fully-reduced pytrees callers read immediately, so
+                # the sync costs nothing real.
+                return jax.block_until_ready(cached(*cols))
 
     def body(*shards):
         out = map_fn(*shards)
@@ -105,7 +115,9 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
                   if donate else ())
     if cache_key is not None:
         _DOALL_CACHE.setdefault(mesh, {})[key] = jfn
-    return jfn(*cols)
+    with device_dispatch("doall dispatch"):
+        # block inside the guard (see the cached branch above)
+        return jax.block_until_ready(jfn(*cols))
 
 
 @functools.lru_cache(maxsize=None)

@@ -1,0 +1,136 @@
+"""Shared retry/timeout/backoff layer for transient faults.
+
+The reference's persist backends ride on SDKs (AWS/GCS clients) that
+retry throttles and 5xx bursts internally; our stdlib REST clients
+(persist_cloud.py) had none, so a single S3 blip destroyed a model
+save or an AutoML checkpoint. This module is the one retry policy for
+every transient-capable path: exponential backoff with full jitter,
+a Retry-After override, an attempt cap and a wall-clock deadline.
+
+Callers wrap one *attempt* in a function that raises TransientError
+for retryable outcomes (429/5xx, timeouts, connection resets, partial
+reads) and any other exception for permanent ones, then hand it to
+`call()`. TransientError subclasses IOError, so exhausted retries
+surface to persist callers as the same exception family as before.
+
+Env knobs (all optional, read per call so tests/operators can tune a
+live process):
+
+- ``H2O_TPU_RETRY_ATTEMPTS``   total attempts, default 5
+- ``H2O_TPU_RETRY_BASE``       first backoff in seconds, default 0.2
+- ``H2O_TPU_RETRY_MAX_DELAY``  per-sleep cap in seconds, default 10
+- ``H2O_TPU_RETRY_DEADLINE``   total budget in seconds, default 120
+- ``H2O_TPU_RETRY_DISABLE=1``  single attempt, no sleeps (chaos drills
+  use this to prove a fault actually exercises the retry path)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Callable, TypeVar
+
+__all__ = ["RetryPolicy", "TransientError", "policy_from_env", "call"]
+
+T = TypeVar("T")
+
+
+class TransientError(IOError):
+    """A retryable failure (throttle, 5xx, timeout, connection reset).
+
+    `retry_after`: server-mandated wait in seconds (HTTP Retry-After),
+    overriding the backoff schedule for the next sleep when set.
+    """
+
+    def __init__(self, msg: str, retry_after: float | None = None):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    attempts: int = 5
+    base: float = 0.2           # first backoff; doubles per attempt
+    max_delay: float = 10.0     # per-sleep cap
+    deadline: float = 120.0     # total wall-clock budget (0 = none)
+    jitter: bool = True
+
+    def backoff(self, attempt: int, rng=random.random) -> float:
+        """Sleep before attempt `attempt+1` (attempt is 1-based)."""
+        delay = min(self.max_delay, self.base * (2 ** (attempt - 1)))
+        if self.jitter:
+            # full jitter in [delay/2, delay]: desynchronizes a pod
+            # slice's workers hammering the same recovering endpoint
+            delay *= 0.5 + 0.5 * rng()
+        return delay
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        from ..diagnostics import log
+
+        log.warning("ignoring unparseable %s=%r", name, raw)
+        return default
+
+
+def policy_from_env(**overrides) -> RetryPolicy:
+    """Build the active policy from H2O_TPU_RETRY_* (see module doc)."""
+    if os.environ.get("H2O_TPU_RETRY_DISABLE", "") not in ("", "0"):
+        return RetryPolicy(attempts=1, **{k: v for k, v in
+                                          overrides.items()
+                                          if k != "attempts"})
+    kw = dict(
+        attempts=int(_env_float("H2O_TPU_RETRY_ATTEMPTS", 5)),
+        base=_env_float("H2O_TPU_RETRY_BASE", 0.2),
+        max_delay=_env_float("H2O_TPU_RETRY_MAX_DELAY", 10.0),
+        deadline=_env_float("H2O_TPU_RETRY_DEADLINE", 120.0),
+    )
+    kw.update(overrides)
+    return RetryPolicy(**kw)
+
+
+def call(fn: Callable[[], T], policy: RetryPolicy | None = None,
+         describe: str = "", sleep: Callable[[float], None] = time.sleep,
+         ) -> T:
+    """Run `fn` under the retry policy.
+
+    Retries ONLY TransientError; everything else propagates on the
+    first attempt (permanent failures must not burn the deadline).
+    On exhaustion the last TransientError is re-raised — an IOError
+    whose message carries the final failure detail.
+    """
+    policy = policy or policy_from_env()
+    start = time.monotonic()
+    last: TransientError | None = None
+    for attempt in range(1, max(1, policy.attempts) + 1):
+        try:
+            return fn()
+        except TransientError as e:
+            last = e
+            if attempt >= policy.attempts:
+                break
+            delay = e.retry_after if e.retry_after is not None \
+                else policy.backoff(attempt)
+            if policy.deadline and \
+                    time.monotonic() - start + delay > policy.deadline:
+                break
+            from ..diagnostics import log, timeline
+
+            timeline.record("retry", describe or str(e),
+                            attempt=attempt, delay=round(delay, 3))
+            log.warning("transient failure (attempt %d/%d, retrying in "
+                        "%.2fs): %s", attempt, policy.attempts, delay, e)
+            sleep(delay)
+    from ..diagnostics import timeline
+
+    timeline.record("retry_exhausted", describe or str(last),
+                    attempts=policy.attempts)
+    assert last is not None
+    raise last

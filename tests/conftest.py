@@ -6,11 +6,61 @@ shard_map/psum semantics are exercised for real — no mocked collectives.
 """
 
 import os
+import subprocess
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # env ships JAX_PLATFORMS=axon (TPU)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def _xla_flags_supported(candidate: str) -> bool:
+    """XLA treats unknown XLA_FLAGS as FATAL (parse_flags_from_env
+    aborts the process at first backend init), so a flag the installed
+    jaxlib doesn't know would kill every test in the suite before one
+    runs — probe support in a throwaway interpreter instead.
+
+    The answer depends only on the installed jaxlib, so it is cached
+    on disk per jaxlib version: only the first pytest run on a box
+    pays the subprocess jax init."""
+    import hashlib
+    import tempfile
+
+    try:
+        import jaxlib
+
+        ver = getattr(jaxlib, "__version__", "unknown")
+    except ImportError:
+        ver = "nojaxlib"
+    tag = hashlib.sha1(f"{ver}|{candidate}".encode()).hexdigest()[:16]
+    cache = os.path.join(tempfile.gettempdir(),
+                         f".h2o_tpu_xla_flag_probe_{tag}")
+    try:
+        with open(cache) as f:
+            return f.read().strip() == "1"
+    except OSError:
+        pass
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=candidate)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env=env, capture_output=True, timeout=300)
+    except Exception:
+        return False            # transient (timeout/spawn) — don't cache
+    ok = r.returncode == 0
+    # cache "0" ONLY for the definitive unknown-flag abort; any other
+    # nonzero exit (OOM-killed probe, transient breakage) must retry
+    # next run, or supported flags would be dropped forever silently
+    if ok or b"Unknown flags" in r.stderr:
+        try:
+            with open(cache, "w") as f:
+                f.write("1" if ok else "0")
+        except OSError:
+            pass
+    return ok
+
+
 if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
     # Root cause of round-1's roaming full-suite SIGABRT: XLA:CPU's
     # collective rendezvous TERMINATES the process ("Termination timeout
@@ -20,10 +70,14 @@ if "xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
     # ~2h of cumulative scheduling into a run. Raise the deadline far past
     # any real scheduling delay; a true deadlock still fails via the
     # suite-level timeout instead of a silent abort.
-    flags = (flags +
-             " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
-             " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
-             " --xla_cpu_collective_timeout_seconds=7200").strip()
+    # These flags only exist in newer XLA builds — adding them blindly
+    # is itself a fatal abort on older jaxlibs (the round-5 seed state:
+    # DOTS_PASSED=0 because every pytest process died in make_cpu_client).
+    _collective = (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+                   " --xla_cpu_collective_call_terminate_timeout_seconds=7200"
+                   " --xla_cpu_collective_timeout_seconds=7200")
+    if _xla_flags_supported(flags + _collective):
+        flags += _collective
 os.environ["XLA_FLAGS"] = flags
 
 # sitecustomize may import jax at interpreter start (latching

@@ -1,0 +1,572 @@
+"""Fault-injection harness + retry/backoff resilience layer.
+
+Chaos drills (ISSUE 1): prove the failure paths — persist HTTP bursts,
+probe hangs, device errors escaping a training step — recover through
+the retry layer and the checkpoint-restart protocol, on CPU, without a
+real outage. The acceptance test also proves the NEGATIVE: with the
+retry layer disabled via env, the same faults break the run (the
+harness really exercises the path).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.runtime import faults, health, retry
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults():
+    faults.reset()
+    health.reset()
+    yield
+    faults.reset()
+    health.reset()
+
+
+# -- fault-spec grammar ------------------------------------------------------
+
+def test_parse_spec_grammar():
+    fs = faults.parse("persist.http:http_503*2;train.step:device_error@3;"
+                      "health.probe:hang~0.5, persist.http:http_429*inf~1.5")
+    assert [f.site for f in fs] == ["persist.http", "train.step",
+                                    "health.probe", "persist.http"]
+    assert fs[0].count == 2 and fs[0].skip == 0
+    assert fs[1].skip == 3 and fs[1].count == 1
+    assert fs[2].param == 0.5
+    assert fs[3].count == float("inf") and fs[3].param == 1.5
+    # round-trips through .spec()
+    assert faults.parse(";".join(f.spec() for f in fs)) == fs
+
+
+def test_parse_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="bad fault clause"):
+        faults.parse("persist.http=503")
+    with pytest.raises(ValueError, match="bad fault clause"):
+        faults.parse("nope")
+
+
+def test_fire_consumes_skip_then_count():
+    with faults.inject("site.a:error*2@1"):
+        faults.fire("site.a")                 # skipped
+        with pytest.raises(faults.FaultError):
+            faults.fire("site.a")
+        with pytest.raises(faults.FaultError):
+            faults.fire("site.a")
+        faults.fire("site.a")                 # exhausted — passes
+    faults.fire("site.a")                     # disarmed outside the block
+
+
+def test_env_activation(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_FAULTS", "site.env:error")
+    assert "site.env:error" in faults.active()
+    with pytest.raises(faults.FaultError):
+        faults.fire("site.env")
+    faults.fire("site.env")                   # count exhausted
+    # a CHANGED env value re-arms fresh counters
+    monkeypatch.setenv("H2O_TPU_FAULTS", "site.env:error*1")
+    with pytest.raises(faults.FaultError):
+        faults.fire("site.env")
+
+
+# -- retry layer -------------------------------------------------------------
+
+def test_retry_backoff_then_success():
+    calls = {"n": 0}
+    sleeps: list[float] = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise retry.TransientError(f"blip {calls['n']}")
+        return "ok"
+
+    pol = retry.RetryPolicy(attempts=5, base=0.1, max_delay=10.0,
+                            deadline=60.0, jitter=False)
+    assert retry.call(flaky, policy=pol, sleep=sleeps.append) == "ok"
+    assert calls["n"] == 4
+    assert sleeps == [0.1, 0.2, 0.4]          # exponential, no jitter
+
+
+def test_retry_jitter_bounds():
+    pol = retry.RetryPolicy(base=1.0, jitter=True)
+    delays = [pol.backoff(1) for _ in range(50)]
+    assert all(0.5 <= d <= 1.0 for d in delays)
+    assert len(set(delays)) > 1               # actually jittered
+
+
+def test_retry_honors_retry_after():
+    sleeps: list[float] = []
+    calls = {"n": 0}
+
+    def throttled():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise retry.TransientError("429", retry_after=0.017)
+        return "ok"
+
+    pol = retry.RetryPolicy(attempts=3, base=5.0, jitter=False)
+    assert retry.call(throttled, policy=pol, sleep=sleeps.append) == "ok"
+    assert sleeps == [0.017]                  # server wait, not backoff
+
+
+def test_retry_exhaustion_raises_last_transient():
+    def hopeless():
+        raise retry.TransientError("still down")
+
+    pol = retry.RetryPolicy(attempts=3, base=0.0, jitter=False)
+    with pytest.raises(IOError, match="still down"):
+        retry.call(hopeless, policy=pol, sleep=lambda s: None)
+
+
+def test_retry_permanent_error_no_retry():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        retry.call(broken, policy=retry.RetryPolicy(attempts=5),
+                   sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_env_knobs(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_RETRY_ATTEMPTS", "7")
+    monkeypatch.setenv("H2O_TPU_RETRY_BASE", "0.05")
+    pol = retry.policy_from_env()
+    assert pol.attempts == 7 and pol.base == 0.05
+    monkeypatch.setenv("H2O_TPU_RETRY_DISABLE", "1")
+    assert retry.policy_from_env().attempts == 1
+
+
+# -- persist HTTP path under faults ------------------------------------------
+
+class _FlakyStore(BaseHTTPRequestHandler):
+    """Tiny object store whose failure behavior tests steer per-class:
+    `fail_codes` is a queue of status codes returned (and consumed)
+    before requests succeed; `put_404` makes every PUT 404."""
+
+    store: dict[str, bytes] = {}
+    fail_codes: list[int] = []
+    put_404: bool = False
+    requests: list[str] = []
+
+    def log_message(self, *a):
+        pass
+
+    def _maybe_fail(self) -> bool:
+        type(self).requests.append(f"{self.command} {self.path}")
+        if self.fail_codes:
+            code = type(self).fail_codes.pop(0)
+            self.send_response(code)
+            if code == 429:
+                self.send_header("Retry-After", "0.01")
+            self.end_headers()
+            return True
+        return False
+
+    def do_GET(self):
+        if self._maybe_fail():
+            return
+        key = self.path.split("?", 1)[0]
+        if key not in self.store:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = self.store[key]
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if self._maybe_fail():
+            return
+        if type(self).put_404:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.store[self.path.split("?", 1)[0]] = body
+        self.send_response(200)
+        self.end_headers()
+
+    do_POST = do_PUT
+
+
+@pytest.fixture()
+def flaky_store(monkeypatch):
+    _FlakyStore.store = {}
+    _FlakyStore.fail_codes = []
+    _FlakyStore.put_404 = False
+    _FlakyStore.requests = []
+    srv = HTTPServer(("127.0.0.1", 0), _FlakyStore)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_port}"
+    monkeypatch.setenv("AWS_ENDPOINT_URL", url)
+    monkeypatch.delenv("AWS_ACCESS_KEY_ID", raising=False)
+    monkeypatch.delenv("AWS_SECRET_ACCESS_KEY", raising=False)
+    # fast, deterministic-enough retries for tests
+    monkeypatch.setenv("H2O_TPU_RETRY_BASE", "0.01")
+    monkeypatch.setenv("H2O_TPU_RETRY_MAX_DELAY", "0.05")
+    yield url
+    srv.shutdown()
+
+
+def test_persist_survives_503_burst_from_server(flaky_store):
+    _FlakyStore.fail_codes = [503, 503]
+    h2o.persist.write_bytes("s3://bkt/obj.bin", b"payload")
+    assert _FlakyStore.store["/bkt/obj.bin"] == b"payload"
+    assert len(_FlakyStore.requests) == 3      # 2 failures + 1 success
+
+
+def test_persist_survives_injected_503_burst(flaky_store):
+    """The harness path: the 503s come from the fault layer (no server
+    cooperation needed) and the write still lands."""
+    with faults.inject("persist.http:http_503*2"):
+        h2o.persist.write_bytes("s3://bkt/inj.bin", b"x" * 64)
+    assert _FlakyStore.store["/bkt/inj.bin"] == b"x" * 64
+    # the two injected failures never reached the wire
+    assert len(_FlakyStore.requests) == 1
+
+
+def test_persist_fails_without_retry_layer(flaky_store, monkeypatch):
+    """Negative control: the SAME fault breaks the save when retries
+    are disabled — proving the harness exercises the retry path."""
+    monkeypatch.setenv("H2O_TPU_RETRY_DISABLE", "1")
+    with faults.inject("persist.http:http_503*2"):
+        with pytest.raises(IOError, match="503"):
+            h2o.persist.write_bytes("s3://bkt/nope.bin", b"x")
+    assert "/bkt/nope.bin" not in _FlakyStore.store
+
+
+def test_persist_429_honors_retry_after(flaky_store):
+    _FlakyStore.fail_codes = [429]
+    t0 = time.monotonic()
+    h2o.persist.write_bytes("s3://bkt/throttled.bin", b"y")
+    assert _FlakyStore.store["/bkt/throttled.bin"] == b"y"
+    assert time.monotonic() - t0 < 5.0         # waited ~0.01s, not minutes
+
+
+def test_persist_survives_timeout_and_urlerror(flaky_store):
+    with faults.inject("persist.http:timeout;persist.http:urlerror"):
+        h2o.persist.write_bytes("s3://bkt/t.bin", b"z")
+    assert _FlakyStore.store["/bkt/t.bin"] == b"z"
+
+
+def test_persist_survives_truncated_transfer(flaky_store):
+    with faults.inject("persist.http:truncate"):
+        h2o.persist.write_bytes("s3://bkt/trunc.bin", b"w")
+    assert _FlakyStore.store["/bkt/trunc.bin"] == b"w"
+
+
+def test_404_read_is_file_not_found(flaky_store):
+    with pytest.raises(FileNotFoundError):
+        h2o.persist.read_bytes("s3://bkt/missing.bin")
+
+
+def test_404_write_is_ioerror_not_file_not_found(flaky_store):
+    """ISSUE satellite: a 404 on a WRITE (deleted upload session, stale
+    WebHDFS redirect) is a broken write path, not a missing file — a
+    FileNotFoundError here would make the AutoML manifest writer treat
+    a failed checkpoint save as 'fresh run' and clobber state."""
+    _FlakyStore.put_404 = True
+    with pytest.raises(IOError) as ei:
+        h2o.persist.write_bytes("s3://bkt/w.bin", b"v")
+    assert not isinstance(ei.value, FileNotFoundError)
+    assert "404" in str(ei.value)
+
+
+def test_retries_visible_in_timeline(flaky_store):
+    from h2o_kubernetes_tpu.diagnostics import timeline
+
+    with faults.inject("persist.http:http_503"):
+        h2o.persist.write_bytes("s3://bkt/tl.bin", b"t")
+    kinds = [e["kind"] for e in timeline.events()]
+    assert "fault_injected" in kinds and "retry" in kinds
+
+
+# -- heartbeat probe under faults --------------------------------------------
+
+def _probe_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "h2o-tpu-probe" and t.is_alive()]
+
+
+def test_probe_hang_detected_and_no_thread_leak(mesh8):
+    """ISSUE satellite: a wedged probe must (a) trip unhealthy at the
+    deadline, (b) NOT leak one more hung daemon thread per heartbeat
+    call while the previous probe is still in flight."""
+    for t in _probe_threads():           # drain strays from other tests
+        t.join(timeout=5)
+    with faults.inject("health.probe:hang~0.7"):
+        assert health.heartbeat(timeout=0.1) is False
+        assert not health.healthy()
+        n0 = len(_probe_threads())
+        assert n0 == 1
+        # the hung probe is still alive: further heartbeats must skip
+        # spawning, log, and return False — not stack up threads
+        assert health.heartbeat(timeout=0.1) is False
+        assert health.heartbeat(timeout=0.1) is False
+        assert len(_probe_threads()) == 1
+    # restart semantics: once the wedged probe drains and health is
+    # reset, heartbeats succeed again
+    deadline = time.monotonic() + 10
+    while _probe_threads() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert not _probe_threads()
+    health.reset()
+    assert health.heartbeat(timeout=120.0) is True
+
+
+def test_probe_error_trips_unhealthy(mesh8):
+    with faults.inject("health.probe:error"):
+        assert health.heartbeat(timeout=30.0) is False
+    assert not health.healthy()
+    with pytest.raises(health.ClusterHealthError):
+        health.require_healthy()
+
+
+# -- device errors escaping a training step ----------------------------------
+
+def _frame(n=200, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.4, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays({"x": x, "y": y})
+
+
+def test_device_error_mid_train_then_restart(mesh8):
+    """Acceptance: GBM train dies on an injected device error at a
+    chunk boundary, the cloud locks, a retry without restart fails
+    fast, and after reset() (the restart analog) training succeeds."""
+    from h2o_kubernetes_tpu.models import GBM
+
+    fr = _frame()
+    # skip the resolve_xy guard; fire at the boost-loop chunk boundary
+    with faults.inject("train.step:device_error@1"):
+        with pytest.raises(faults.InjectedDeviceError):
+            GBM(ntrees=4, max_depth=2, seed=0).train(
+                y="y", training_frame=fr)
+    assert not health.healthy()
+    # locked cloud: retrying WITHOUT a restart fails fast, cleanly
+    with pytest.raises(health.ClusterHealthError):
+        GBM(ntrees=4, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    # restart → train to completion
+    health.reset()
+    m = GBM(ntrees=4, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    assert np.isfinite(m.predict_raw(fr)).all()
+
+
+def test_doall_device_error_marks_unhealthy(mesh8):
+    import jax.numpy as jnp
+
+    from h2o_kubernetes_tpu.runtime.mrtask import doall
+
+    with faults.inject("mrtask.doall:device_error"):
+        with pytest.raises(faults.InjectedDeviceError):
+            doall(lambda x: {"s": jnp.sum(x)}, jnp.ones(16), reduce="sum")
+    assert not health.healthy()
+    health.reset()
+    out = doall(lambda x: {"s": jnp.sum(x)}, jnp.ones(16), reduce="sum")
+    assert float(out["s"]) == 16.0
+
+
+def test_predict_on_dead_mesh_is_cluster_error(mesh8):
+    from h2o_kubernetes_tpu.models import GBM
+
+    fr = _frame()
+    m = GBM(ntrees=3, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    health.mark_unhealthy("simulated chip loss")
+    with pytest.raises(health.ClusterHealthError):
+        m.predict(fr)
+    health.reset()
+    assert m.predict(fr).nrows == fr.nrows
+
+
+# -- AutoML checkpoint-restart round trip ------------------------------------
+
+def _aml_kwargs(tmp_path=None):
+    kw = dict(max_models=2, nfolds=2, seed=11, verbosity=None,
+              include_algos=["glm", "deeplearning"],
+              project_name="chaos_resume")
+    if tmp_path is not None:
+        kw["checkpoint_dir"] = str(tmp_path)
+    return kw
+
+
+def test_automl_resume_after_mid_run_device_error(mesh8, tmp_path):
+    """ISSUE satellite + acceptance: inject a device error during step
+    2 of an AutoML run with a checkpoint_dir; the job fails with the
+    locked-cloud error; the manifest holds the completed step; after
+    restart the rerun resumes (no retrain of step 1) and its
+    leaderboard matches an uninterrupted run."""
+    fr = _frame(n=160, seed=12)
+
+    # reference run, no interruptions, no checkpointing
+    ref = h2o.AutoML(**_aml_kwargs())
+    ref.train(y="y", training_frame=fr)
+    ref_rows = {r["model_id"]: r for r in ref.leaderboard.rows}
+    assert len(ref_rows) >= 2
+
+    # run 1: step 2 (DeepLearning) hits a device error mid-plan
+    a1 = h2o.AutoML(**_aml_kwargs(tmp_path))
+    with faults.inject("automl.step:device_error@1"):
+        with pytest.raises(health.ClusterHealthError,
+                           match="restart and rerun"):
+            a1.train(y="y", training_frame=fr)
+    assert a1.job.status == "FAILED"
+    assert not health.healthy()
+    manifest = json.loads((tmp_path / "automl_manifest.json").read_text())
+    assert len(manifest) == 1                   # exactly the finished step
+    done_id = next(iter(manifest))
+    assert "GLM" in done_id
+
+    # restart: reset health (new cluster), rerun with the same dir
+    health.reset()
+    a2 = h2o.AutoML(**_aml_kwargs(tmp_path))
+    a2.train(y="y", training_frame=fr)
+    resumed = [m for _, m in a2.event_log if "resumed from checkpoint" in m]
+    assert resumed and done_id in resumed[0]
+    got_rows = {r["model_id"]: r for r in a2.leaderboard.rows}
+    assert set(got_rows) == set(ref_rows)
+    metric = a2.leaderboard.sort_metric
+    for mid in ref_rows:
+        np.testing.assert_allclose(got_rows[mid][metric],
+                                   ref_rows[mid][metric], rtol=1e-5,
+                                   err_msg=f"{mid} {metric} diverged "
+                                   "between resumed and uninterrupted run")
+    # resumed leader predicts
+    assert a2.leader.predict(fr).nrows == fr.nrows
+
+
+def test_automl_escalates_real_device_error(mesh8, monkeypatch):
+    """A REAL XLA runtime error (not the harness's InjectedDeviceError,
+    which flips health itself) escaping a training step must also lock
+    the cloud and fail the job — not get logged as a step failure while
+    the plan grinds on against a dead mesh."""
+    from h2o_kubernetes_tpu import automl as automl_mod
+    from h2o_kubernetes_tpu.runtime.health import is_device_error
+
+    try:
+        from jax.errors import JaxRuntimeError as XErr
+    except ImportError:
+        from jaxlib.xla_extension import XlaRuntimeError as XErr
+    err = XErr("INTERNAL: device halted (test)")
+    assert is_device_error(err)
+    fr = _frame(120)
+
+    class Dying(automl_mod._EST["glm"]):
+        def train(self, *a, **kw):
+            raise err
+
+    monkeypatch.setitem(automl_mod._EST, "glm", Dying)
+    a = h2o.AutoML(max_models=2, nfolds=2, include_algos=["glm", "gbm"],
+                   verbosity=None, project_name="realdev_t")
+    with pytest.raises(health.ClusterHealthError, match="restart and"):
+        a.train(y="y", training_frame=fr)
+    assert a.job.status == "FAILED"
+    assert not health.healthy()
+
+
+# -- REST graceful degradation -----------------------------------------------
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def rest_server(mesh8):
+    from h2o_kubernetes_tpu import rest
+
+    port = _free_port()
+    srv = rest.start_server(port)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    rest.FRAMES.clear()
+    rest.MODELS.clear()
+    rest.AUTOML.clear()
+    rest.GRIDS.clear()
+
+
+def test_rest_degrades_to_503_when_unhealthy(rest_server, tmp_path):
+    fr = _frame(120)
+    csv = tmp_path / "t.csv"
+    h2o.export_file(fr, str(csv))
+    health.mark_unhealthy("ICI link down (drill)")
+    # builds degrade to 503 carrying the health error, not 500/hang
+    body = json.dumps({"training_frame": "t", "response_column": "y"})
+    req = urllib.request.Request(
+        rest_server + "/3/ModelBuilders/gbm", data=body.encode(),
+        method="POST", headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=60)
+    assert ei.value.code == 503
+    payload = json.loads(ei.value.read())
+    assert "ICI link down" in payload["msg"]
+    # reads stay served: /3/Cloud reports the unhealthy cloud
+    with urllib.request.urlopen(rest_server + "/3/Cloud",
+                                timeout=60) as r:
+        assert json.loads(r.read())["cloud_healthy"] is False
+    # restart → builds work again
+    health.reset()
+    with urllib.request.urlopen(rest_server + "/3/Cloud",
+                                timeout=60) as r:
+        assert json.loads(r.read())["cloud_healthy"] is True
+
+
+def test_rest_job_records_failure_not_running_forever(rest_server,
+                                                      tmp_path):
+    """A device error during a REST-driven build must land on the Job
+    (FAILED + message), and the cluster then degrades to 503 — the job
+    must never be left RUNNING for /3/Jobs pollers."""
+    from h2o_kubernetes_tpu import rest
+
+    fr = _frame(150, seed=3)
+    csv = tmp_path / "train.csv"
+    h2o.export_file(fr, str(csv))
+    import urllib.parse
+
+    data = urllib.parse.urlencode(
+        {"path": str(csv), "destination_frame": "train"}).encode()
+    urllib.request.urlopen(
+        urllib.request.Request(rest_server + "/3/ImportFiles",
+                               data=data, method="POST"),
+        timeout=120).read()
+    with faults.inject("train.step:device_error"):
+        body = json.dumps({"training_frame": "train",
+                           "response_column": "y", "ntrees": 3,
+                           "max_depth": 2, "model_id": "doomed"})
+        req = urllib.request.Request(
+            rest_server + "/3/ModelBuilders/gbm", data=body.encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as r:
+            out = json.loads(r.read())
+    assert out["job"]["status"] == "FAILED"
+    assert "injected device error" in out["job"]["msg"]
+    # the failed dispatch locked the cloud: the next build 503s
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=60)
+    assert ei.value.code == 503
+    jobs = json.loads(urllib.request.urlopen(
+        rest_server + "/3/Jobs", timeout=60).read())["jobs"]
+    doomed = [j for j in jobs if j["dest"] == "doomed"]
+    assert doomed and doomed[0]["status"] == "FAILED"
+    health.reset()

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Chaos drill CLI — rehearse failure scenarios on CPU, exit nonzero
+if recovery fails.
+
+Runs a short GBM train (and, for the resume scenario, a small AutoML
+run) under a named fault scenario from the fault-injection harness
+(h2o_kubernetes_tpu/runtime/faults.py) and asserts the system recovers
+the way docs/RESILIENCE.md promises. Intended for CI gates and for
+operators validating a new image before it meets real traffic.
+
+Usage::
+
+    python tools/chaos.py persist-503
+    python tools/chaos.py all            # every scenario, first failure wins
+
+Scenarios:
+
+- ``persist-503``   HTTP 503 burst on the persist path: a model save
+  to s3:// must land after retries — and must FAIL when the retry
+  layer is disabled (proving the fault exercises the path).
+- ``probe-hang``    the heartbeat probe wedges: unhealthy at the
+  deadline, no probe-thread pileup, recovery after reset().
+- ``device-error``  a device error escapes a GBM training step: the
+  cloud locks, retraining without a restart fails fast, restart works.
+- ``resume``        device error mid-AutoML with a checkpoint_dir: the
+  rerun resumes finished steps instead of retraining them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+# chaos drills always run on the virtual-CPU mesh: they rehearse
+# failures, they must not depend on (or wedge) a real chip
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class ChaosFailure(AssertionError):
+    """A scenario's recovery contract was broken."""
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ChaosFailure(msg)
+
+
+def _frame(n=160, seed=7):
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.4, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays({"x": x, "y": y})
+
+
+def _fake_store():
+    """In-process object store for s3:// drills; returns (server, url)."""
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class Store(BaseHTTPRequestHandler):
+        store: dict[str, bytes] = {}
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            key = self.path.split("?", 1)[0]
+            if key not in self.store:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = self.store[key]
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.store[self.path.split("?", 1)[0]] = self.rfile.read(n)
+            self.send_response(200)
+            self.end_headers()
+
+        do_POST = do_PUT
+
+    srv = HTTPServer(("127.0.0.1", 0), Store)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_port}", Store
+
+
+def scenario_persist_503() -> None:
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.runtime import faults
+
+    srv, url, store = _fake_store()
+    saved = {k: os.environ.get(k) for k in
+             ("AWS_ENDPOINT_URL", "AWS_ACCESS_KEY_ID",
+              "AWS_SECRET_ACCESS_KEY", "H2O_TPU_RETRY_BASE")}
+    os.environ["AWS_ENDPOINT_URL"] = url
+    os.environ.pop("AWS_ACCESS_KEY_ID", None)
+    os.environ.pop("AWS_SECRET_ACCESS_KEY", None)
+    os.environ["H2O_TPU_RETRY_BASE"] = "0.02"
+    try:
+        fr = _frame()
+        from h2o_kubernetes_tpu.models import GBM
+
+        m = GBM(ntrees=3, max_depth=2, seed=0).train(
+            y="y", training_frame=fr)
+        with faults.inject("persist.http:http_503*2"):
+            h2o.save_model(m, "s3://bkt/chaos/gbm.model")
+        _check("/bkt/chaos/gbm.model" in store.store,
+               "model save did not land after the 503 burst")
+        m2 = h2o.load_model("s3://bkt/chaos/gbm.model")
+        _check(m2.predict(fr).nrows == fr.nrows,
+               "reloaded model does not predict")
+        # negative control: same burst, retries disabled -> must fail
+        os.environ["H2O_TPU_RETRY_DISABLE"] = "1"
+        try:
+            with faults.inject("persist.http:http_503*2"):
+                try:
+                    h2o.save_model(m, "s3://bkt/chaos/nope.model")
+                except IOError:
+                    pass
+                else:
+                    raise ChaosFailure(
+                        "save survived a 503 burst with retries "
+                        "DISABLED — the fault is not exercising the "
+                        "retry path")
+        finally:
+            os.environ.pop("H2O_TPU_RETRY_DISABLE", None)
+    finally:
+        srv.shutdown()
+        for k, v in saved.items():     # no leaks into later scenarios
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+
+
+def scenario_probe_hang() -> None:
+    from h2o_kubernetes_tpu.runtime import faults, health
+
+    health.reset()
+    with faults.inject("health.probe:hang~0.7"):
+        _check(health.heartbeat(timeout=0.1) is False,
+               "hung probe reported healthy")
+        _check(not health.healthy(), "hang did not trip unhealthy")
+        _check(health.heartbeat(timeout=0.1) is False,
+               "second heartbeat did not skip-and-return-False")
+        alive = [t for t in threading.enumerate()
+                 if t.name == "h2o-tpu-probe" and t.is_alive()]
+        _check(len(alive) <= 1,
+               f"probe threads piled up: {len(alive)}")
+    deadline = time.monotonic() + 10
+    while [t for t in threading.enumerate()
+           if t.name == "h2o-tpu-probe" and t.is_alive()] \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    health.reset()
+    _check(health.heartbeat(timeout=120.0) is True,
+           "heartbeat did not recover after reset")
+
+
+def scenario_device_error() -> None:
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.runtime import faults, health
+
+    health.reset()
+    fr = _frame()
+    with faults.inject("train.step:device_error@1"):
+        try:
+            GBM(ntrees=4, max_depth=2, seed=0).train(
+                y="y", training_frame=fr)
+        except (faults.InjectedDeviceError, health.ClusterHealthError):
+            pass
+        else:
+            raise ChaosFailure("train survived an injected device error")
+    _check(not health.healthy(), "device error did not lock the cloud")
+    try:
+        GBM(ntrees=4, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    except health.ClusterHealthError:
+        pass
+    else:
+        raise ChaosFailure("locked cloud accepted a new train")
+    health.reset()
+    m = GBM(ntrees=4, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    _check(m.predict(fr).nrows == fr.nrows,
+           "post-restart model does not predict")
+
+
+def scenario_resume() -> None:
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.runtime import faults, health
+
+    health.reset()
+    fr = _frame(seed=12)
+    with tempfile.TemporaryDirectory() as ckpt:
+        kw = dict(max_models=2, nfolds=2, seed=11, verbosity=None,
+                  include_algos=["glm", "deeplearning"],
+                  project_name="chaos_cli", checkpoint_dir=ckpt)
+        a1 = h2o.AutoML(**kw)
+        with faults.inject("automl.step:device_error@1"):
+            try:
+                a1.train(y="y", training_frame=fr)
+            except health.ClusterHealthError:
+                pass
+            else:
+                raise ChaosFailure(
+                    "AutoML survived a mid-run device error")
+        manifest = json.load(
+            open(os.path.join(ckpt, "automl_manifest.json")))
+        _check(len(manifest) == 1,
+               f"manifest should hold 1 finished step, has "
+               f"{len(manifest)}")
+        health.reset()
+        a2 = h2o.AutoML(**kw)
+        a2.train(y="y", training_frame=fr)
+        _check(any("resumed from checkpoint" in m
+                   for _, m in a2.event_log),
+               "rerun did not resume from the manifest")
+        _check(len(a2.leaderboard.rows) >= 2,
+               "resumed run did not finish the plan")
+
+
+SCENARIOS = {
+    "persist-503": scenario_persist_503,
+    "probe-hang": scenario_probe_hang,
+    "device-error": scenario_device_error,
+    "resume": scenario_resume,
+}
+
+
+def main(argv: list[str]) -> int:
+    names = argv or ["all"]
+    if names == ["all"]:
+        names = list(SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        print(f"unknown scenario(s): {', '.join(unknown)} — choose from "
+              f"{', '.join(SCENARIOS)} or 'all'", file=sys.stderr)
+        return 2
+    from h2o_kubernetes_tpu.runtime import make_mesh, set_global_mesh
+
+    set_global_mesh(make_mesh())
+    for name in names:
+        t0 = time.monotonic()
+        try:
+            SCENARIOS[name]()
+        except ChaosFailure as e:
+            print(f"[chaos] {name}: FAIL — {e}", file=sys.stderr)
+            return 1
+        except Exception as e:  # noqa: BLE001 — a crash is also a fail
+            import traceback
+
+            traceback.print_exc()
+            print(f"[chaos] {name}: ERROR — {e!r}", file=sys.stderr)
+            return 1
+        print(f"[chaos] {name}: PASS ({time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
