@@ -20,6 +20,7 @@ This build mirrors that plan:
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Sequence
@@ -41,6 +42,12 @@ def jobs() -> list[dict[str, Any]]:
     return [{"dest": j.dest, "description": j.description,
              "status": j.status, "progress": j.progress, "msg": j.msg}
             for j in JOBS.values()]
+
+
+# one lock for all Job status transitions: transitions are rare (start/
+# done/failed/reap), contention is nil, and a shared lock keeps the
+# dataclass pickle-friendly (no per-instance lock field)
+_JOB_STATE_LOCK = threading.Lock()
 
 
 @dataclass
@@ -65,23 +72,39 @@ class Job:
         return self
 
     def update(self, progress: float, msg: str = ""):
-        self.progress = float(progress)
-        if msg:
-            self.msg = msg
+        with _JOB_STATE_LOCK:
+            if self.status in ("DONE", "FAILED"):
+                # terminal: a still-running worker must not overwrite
+                # the reaper's failure message with progress chatter
+                return
+            self.progress = float(progress)
+            if msg:
+                self.msg = msg
 
     def done(self):
-        self.status = "DONE"
-        self.progress = 1.0
-        self.end_time = time.time()
+        with _JOB_STATE_LOCK:
+            if self.status == "FAILED":
+                # FAILED is terminal: a worker completing AFTER the job
+                # was reaped (rest._reap_jobs poll timeout) must not
+                # resurrect it to DONE — pollers already saw and acted
+                # on the failure. The lock closes the check-then-set
+                # window against a concurrent reaper.
+                return
+            self.status = "DONE"
+            self.progress = 1.0
+            self.end_time = time.time()
         from .diagnostics import timeline
 
         timeline.record("job_done", self.description, dest=self.dest,
                         seconds=self.end_time - self.start_time)
 
     def failed(self, msg: str):
-        self.status = "FAILED"
-        self.msg = msg
-        self.end_time = time.time()
+        with _JOB_STATE_LOCK:
+            if self.status == "DONE":
+                return      # same terminality, opposite direction
+            self.status = "FAILED"
+            self.msg = msg
+            self.end_time = time.time()
 
 
 class Leaderboard:

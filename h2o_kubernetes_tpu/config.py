@@ -23,7 +23,11 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_CV_SHAPE_SHARE_ROWS | tpu≤1M | weights-masked CV row threshold; 0 disables, N forces on any backend (models/cv) |
 | H2O_TPU_ARROW_CSV | 1 | 0 disables the pyarrow CSV fast path (frame/parse) |
 | H2O_TPU_PROBE_BUDGET | 600 | backend-probe stubbornness seconds (runtime/backend) |
-| JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset |
+| H2O_TPU_SCORE_BATCH_US | 2000 | REST scoring micro-batcher window, µs; 0 = dispatch immediately (rest.py, docs/SERVING.md) |
+| H2O_TPU_SCORE_TIMEOUT | 60 | seconds a scoring request may wait for its micro-batched result before 503 (rest.py) |
+| H2O_TPU_SCORE_MAX_ROWS | 100000 | per-request row cap on the inline scoring route (413 past it — one oversized dispatch must not lock the cloud) |
+| H2O_TPU_JOB_TIMEOUT | 0 (off) | server-side job-poll timeout: RUNNING jobs older than this read FAILED on /3/Jobs (rest.py) |
+| JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection
 contract, consumed directly by `runtime/mesh.initialize_distributed`.

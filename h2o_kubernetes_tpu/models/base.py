@@ -9,6 +9,7 @@ model a uniform predict / model_performance surface.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Sequence
 
@@ -152,10 +153,52 @@ def resolve_x(frame: Frame, x: Sequence[str] | None = None,
                      "gaussian", fdoms)
 
 
+# ---------------------------------------------------------------------------
+# Jitted-scorer cache (the compiled serving fast path)
+# ---------------------------------------------------------------------------
+#
+# Serving traffic scores the SAME model at a handful of batch shapes
+# thousands of times.  Each model carries one pair of jitted scorer
+# callables (plain / with-offset) on the instance (dropped from pickles),
+# and warm shapes are tracked per (model key, input schema, padded batch
+# shape) so a warm call is zero-compile and zero-retrace: jax.jit keys
+# its executable cache on the callable identity + input shapes, batch
+# sizes are bucketed to powers of two (score_numpy pads), and compiles
+# land in the round-4 persistent XLA cache (runtime/backend.py) so even
+# a fresh process warm-starts from disk.
+
+_SCORE_MIN_BATCH = 128          # smallest padded-batch bucket
+
+_SCORER_STATS = {"hits": 0, "misses": 0, "models": 0}
+# guards cache-entry/jit creation + stats: an HTTP handler thread and
+# the REST micro-batcher thread can first-score one model concurrently
+_SCORER_LOCK = threading.Lock()
+
+
+def scorer_cache_stats() -> dict[str, int]:
+    """Shape-level cache counters: a `miss` is a (model, schema, padded
+    batch) triple seen for the first time — i.e. an expected XLA
+    trace/compile; warm traffic must add only `hits` (the bench's
+    recompile check asserts exactly that)."""
+    return dict(_SCORER_STATS)
+
+
+def _batch_bucket(n: int) -> int:
+    """Next power-of-two batch size >= max(n, _SCORE_MIN_BATCH)."""
+    b = _SCORE_MIN_BATCH
+    while b < n:
+        b *= 2
+    return b
+
+
 class Model:
     """Base trained model: predict() + model_performance()."""
 
     algo = "base"
+    # True on models whose _score_matrix is end-to-end jittable
+    # (GBM/DRF/XGBoost/GLM/DeepLearning): predict/score_numpy route
+    # through the jitted-scorer cache instead of eager op dispatch
+    _serving_jit = False
 
     def __init__(self, data: TrainData):
         self.feature_names = data.feature_names
@@ -182,9 +225,120 @@ class Model:
     def cross_validation_metrics_summary(self):
         return self.cv.metrics_summary if self.cv else None
 
-    # subclasses implement: _score(X) -> margin/probs array
+    # subclasses implement: _score_matrix(X) -> margin/probs array
     def _score_matrix(self, X: jax.Array) -> jax.Array:
         raise NotImplementedError
+
+    # -- compiled serving fast path -----------------------------------------
+
+    def __getstate__(self):
+        # jitted scorer callables are process-local, and the flattened
+        # ensemble is derivable from the trees (GBMModel._flat rebuilds
+        # it lazily): pickling either would bloat artifacts and make
+        # save-before-predict vs save-after-predict differ
+        d = dict(self.__dict__)
+        d.pop("_scorer_cache", None)
+        d.pop("_flat_trees", None)
+        d.pop("_serving_luts", None)    # rest.py enum-code LUT cache
+        return d
+
+    def _serving_prepare(self) -> None:
+        """Hook: materialize host-built serving state (e.g. the GBM
+        flattened ensemble) OUTSIDE the jit trace — device constants
+        created while tracing would leak as tracers."""
+
+    def _cached_score(self, X: jax.Array,
+                      offset: jax.Array | None = None) -> jax.Array:
+        """Score through this model's jitted scorer, tracking warm
+        shapes per (model, schema, padded batch, offset?) key."""
+        self._serving_prepare()
+        with _SCORER_LOCK:
+            ent = self.__dict__.get("_scorer_cache")
+            if ent is None:
+                ent = {"shapes": set()}
+                self._scorer_cache = ent
+                _SCORER_STATS["models"] += 1
+            skey = (X.shape[1], X.shape[0], offset is not None)
+            if skey in ent["shapes"]:
+                _SCORER_STATS["hits"] += 1
+            else:
+                ent["shapes"].add(skey)
+                _SCORER_STATS["misses"] += 1
+            key = "fn_off" if offset is not None else "fn"
+            fn = ent.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    lambda X, off: self._score_matrix(X, offset=off)) \
+                    if offset is not None else \
+                    jax.jit(lambda X: self._score_matrix(X))
+                ent[key] = fn
+        # the (possibly multi-second) trace/compile happens OUTSIDE the
+        # lock — jax's own caches are thread-safe; only our bookkeeping
+        # needs mutual exclusion
+        return fn(X, offset) if offset is not None else fn(X)
+
+    def _score(self, X: jax.Array,
+               offset: jax.Array | None = None) -> jax.Array:
+        """Eager _score_matrix — in-process predict() numerics never
+        depend on serving state (a jitted scorer can fuse float ops
+        differently, so flipping paths mid-process would let invisible
+        REST traffic perturb low-order bits of predict()).
+
+        The jitted-scorer cache belongs to the SERVING entry only
+        (score_numpy, which the REST routes ride): one model, many
+        requests — worth a per-model trace.  Training-time scoring (CV
+        folds, AutoML candidates, validation rounds: many models, a
+        call or two each) stays here, where eager tree scoring still
+        rides the MODULE-level flat_margin jit that same-shaped fold
+        models share."""
+        if offset is not None:
+            return self._score_matrix(X, offset=offset)
+        return self._score_matrix(X)
+
+    def score_numpy(self, X, offset=None) -> np.ndarray:
+        """Serving entry: raw [n, F] ndarray (training value space,
+        enum codes / NaN NAs) -> [n, K] probabilities or [n]
+        predictions, skipping Frame/rollup construction entirely.
+
+        Rows are padded to a power-of-two bucket so warm traffic at
+        ANY batch size <= the bucket reuses one compiled executable
+        (zero retrace); output is trimmed back to n rows."""
+        from ..runtime.health import device_dispatch, require_healthy
+
+        require_healthy(fault_site=None)   # fail fast on a locked cloud
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim != 2 or X.shape[1] != len(self.feature_names):
+            raise ValueError(
+                f"score_numpy expects [n, {len(self.feature_names)}] "
+                f"(features {self.feature_names}), got {X.shape}")
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("score_numpy: empty batch")
+        if getattr(self, "offset_column", None) and offset is None:
+            raise ValueError(
+                f"this model was trained with offset_column="
+                f"'{self.offset_column}'; pass offset= per row")
+        b = _batch_bucket(n)
+        if b != n:
+            Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
+            Xp[:n] = X
+        else:
+            Xp = X
+        offp = None
+        if offset is not None:
+            offset = np.asarray(offset, dtype=np.float32).reshape(-1)
+            if offset.shape[0] != n:
+                raise ValueError(
+                    f"offset has {offset.shape[0]} rows, X has {n}")
+            offp = np.zeros(b, dtype=np.float32)
+            offp[:n] = offset
+            offp = jnp.asarray(offp)
+        with device_dispatch("model scoring"):
+            if self._serving_jit:
+                out = self._cached_score(jnp.asarray(Xp), offp)
+            else:
+                out = self._score(jnp.asarray(Xp), offp)
+            return np.asarray(out)[:n]
 
     def _design_matrix(self, frame: Frame) -> jax.Array:
         """[padded, F] float32 in TRAINING value space.
@@ -240,28 +394,40 @@ class Model:
         # validation below pass through the guard untouched)
         with device_dispatch("model scoring"):
             X = self._design_matrix(frame)
-            if getattr(self, "offset_column", None):
-                # a model trained with an offset needs it at scoring
-                # time too (hex/Model.adaptTestForTrain errors likewise
-                # [U3])
-                if self.offset_column not in frame:
-                    raise ValueError(
-                        f"this model was trained with offset_column="
-                        f"'{self.offset_column}' which is missing from "
-                        "the scoring frame")
-                # NA offsets propagate: a row with no defined base
-                # margin has no defined prediction (training likewise
-                # drops such rows via w=0) — coercing to 0 would return
-                # a confident number for a row the model cannot score
-                off = frame.vec(self.offset_column).as_float()
-                out = np.asarray(self._score_matrix(X, offset=off))
+            off = self._frame_offset(frame)
+            if off is not None:
+                out = np.asarray(self._score(X, off))
                 return out[: frame.nrows]
-            out = np.asarray(self._score_matrix(X))[: frame.nrows]
+            out = np.asarray(self._score(X))[: frame.nrows]
             return out
+
+    def _frame_offset(self, frame: Frame) -> jax.Array | None:
+        """Validated per-row offset column for an offset-trained model
+        (None otherwise) — the ONE offset contract, shared by
+        predict_raw and the REST micro-batcher path.
+
+        A model trained with an offset needs it at scoring time too
+        (hex/Model.adaptTestForTrain errors likewise [U3]); NA offsets
+        propagate: a row with no defined base margin has no defined
+        prediction (training likewise drops such rows via w=0) —
+        coercing to 0 would return a confident number for a row the
+        model cannot score."""
+        if not getattr(self, "offset_column", None):
+            return None
+        if self.offset_column not in frame:
+            raise ValueError(
+                f"this model was trained with offset_column="
+                f"'{self.offset_column}' which is missing from "
+                "the scoring frame")
+        return frame.vec(self.offset_column).as_float()
 
     def predict(self, frame: Frame) -> Frame:
         """H2O-style prediction frame: `predict` (+ per-class probs)."""
-        out = self.predict_raw(frame)
+        return self._prediction_frame(self.predict_raw(frame))
+
+    def _prediction_frame(self, out: np.ndarray) -> Frame:
+        """Raw predictions -> the H2O-style frame (shared by predict()
+        and the REST micro-batcher, which scores raw matrices)."""
         if self.nclasses > 1:
             labels = out.argmax(axis=1).astype(np.int32)
             cols: dict[str, Any] = {"predict": labels}
@@ -291,17 +457,10 @@ class Model:
         # one design-matrix build; each grid step overwrites a single
         # column on device instead of re-sharding the whole frame
         X = self._design_matrix(frame)
-        off = None
-        if getattr(self, "offset_column", None):
-            # PD means must average the model as it actually predicts —
-            # scoring at offset 0 would disagree with predict() on the
-            # same frame
-            if self.offset_column not in frame:
-                raise ValueError(
-                    f"this model was trained with offset_column="
-                    f"'{self.offset_column}' which is missing from the "
-                    "frame")
-            off = frame.vec(self.offset_column).as_float()
+        # PD means must average the model as it actually predicts —
+        # scoring at offset 0 would disagree with predict() on the
+        # same frame
+        off = self._frame_offset(frame)
         for col in cols:
             if col not in self.feature_names:
                 raise ValueError(
@@ -328,9 +487,7 @@ class Model:
             means, sds, sems = [], [], []
             for gv in grid:
                 Xg = _set_col_jit(X, j, float(gv))
-                pred = np.asarray(
-                    self._score_matrix(Xg, offset=off)
-                    if off is not None else self._score_matrix(Xg))[:n]
+                pred = np.asarray(self._score(Xg, off))[:n]
                 resp = pred[:, 1] if self.nclasses == 2 else pred
                 means.append(float(np.mean(resp)))
                 sds.append(float(np.std(resp, ddof=1))

@@ -112,6 +112,7 @@ def _loss_fn(params, xb, yb, wb, act, loss_kind, l1, l2, key, in_drop,
 
 class DeepLearningModel(Model):
     algo = "deeplearning"
+    _serving_jit = True     # predict routes through the jitted-scorer cache
 
     def __init__(self, data: TrainData, params: DeepLearningParams,
                  dinfo, net_params, loss_kind: str):

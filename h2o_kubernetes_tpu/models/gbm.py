@@ -18,6 +18,7 @@ Distributions (hex/genmodel DistributionFamily analogs):
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from dataclasses import dataclass, field
@@ -33,10 +34,10 @@ from ..runtime.health import device_dispatch, require_healthy
 from ..runtime.mesh import global_mesh
 from .base import Model, TrainData, resolve_xy
 from .tree.binning import BinSpec, apply_bins, apply_bins_jit, fit_bins
-from .tree.core import (BoostParams, Tree, TreeParams, _grad_hess,
-                        boost_trees, boost_trees_drf,
-                        boost_trees_multi, descend_tree,
-                        predict_tree)
+from .tree.core import (BoostParams, FlatTrees, Tree, TreeParams,
+                        _grad_hess, boost_trees, boost_trees_drf,
+                        boost_trees_multi, descend_tree, flat_margin,
+                        flatten_trees, predict_tree)
 
 
 @dataclass
@@ -173,6 +174,7 @@ def _stack_leaf_nodes(trees: Tree, binned, max_depth: int, n_bins: int):
 
 class GBMModel(Model):
     algo = "gbm"
+    _serving_jit = True     # predict routes through the jitted-scorer cache
 
     def __init__(self, data: TrainData, params: GBMParams,
                  bin_spec: BinSpec, trees, init_score, varimp):
@@ -201,8 +203,47 @@ class GBMModel(Model):
         self._edges = jnp.asarray(bin_spec.edges_matrix())
         self._enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
 
+    def _flat(self) -> FlatTrees:
+        """The ONE flattening of this ensemble (serving scorer + MOJO
+        export share it): compact reachable-node arrays with raw-
+        feature thresholds, built lazily and cached on the model."""
+        ft = self.__dict__.get("_flat_trees")
+        if ft is None:
+            ft = flatten_trees(self.trees, np.asarray(self._edges),
+                               np.asarray(self._enum_mask),
+                               self.params.max_depth)
+            ft = FlatTrees(*(jnp.asarray(a) for a in ft))
+            self._flat_trees = ft
+        return ft
+
+    # base._cached_score calls this before tracing the jitted scorer
+    _serving_prepare = _flat
+
     def _margins(self, X: jax.Array,
                  offset: jax.Array | None = None) -> jax.Array:
+        """Raw boosting margins via the flattened serving scorer — no
+        re-binning at score time; bitwise-equal to `_margins_binned`
+        (the heap re-descent kept as the parity reference)."""
+        K = self.nclasses if self.nclasses > 2 else 1
+        p = self.params
+        lv = flat_margin(self._flat(), X, self._enum_mask, p.max_depth,
+                         K)                               # [K, rows]
+        if K == 1:
+            m = lv[0]
+            if p._drf_mode:
+                m = m / self.ntrees
+            base = self.init_score if offset is None \
+                else self.init_score + offset
+            return base + getattr(self, "margin_scale", 1.0) * m
+        if p._drf_mode:
+            lv = lv / (self.ntrees // K)
+        return (jnp.asarray(self.init_score)[:, None] + lv).T
+
+    def _margins_binned(self, X: jax.Array,
+                        offset: jax.Array | None = None) -> jax.Array:
+        """Legacy per-tree heap re-descent over binned codes — the
+        training-structure scorer the flat path must match bitwise
+        (tests/test_flat_scorer.py, tools/kernel_gate.py)."""
         binned = apply_bins(X, self._edges, self._enum_mask,
                             self.bin_spec.na_bin)
         K = self.nclasses if self.nclasses > 2 else 1
@@ -327,6 +368,22 @@ class GBMModel(Model):
         top = max(v.values()) if v else 1.0
         return {k: val / top if top > 0 else 0.0
                 for k, val in sorted(v.items(), key=lambda kv: -kv[1])}
+
+
+@contextlib.contextmanager
+def legacy_scoring_path(model: GBMModel):
+    """Route `model.predict()` through the PRE-flattening path —
+    binned heap re-descent, eager op dispatch, no scorer cache — for
+    the duration of the block.  The serving benchmarks (bench.py score
+    mode, bench_suite's gbm_score_rows_per_sec) use this as the ONE
+    definition of the legacy baseline; everything else should never
+    need it."""
+    model._margins = model._margins_binned
+    model._serving_jit = False
+    try:
+        yield model
+    finally:
+        del model._margins, model._serving_jit
 
 
 class GBM:

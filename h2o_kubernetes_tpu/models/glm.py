@@ -351,6 +351,7 @@ def _famspec(p: GLMParams) -> FamSpec:
 
 class GLMModel(Model):
     algo = "glm"
+    _serving_jit = True     # predict routes through the jitted-scorer cache
 
     def __init__(self, data: TrainData, params: GLMParams, dinfo: DataInfo,
                  beta: jax.Array, lambda_used: float,

@@ -645,3 +645,128 @@ def descend_tree(tree: Tree, binned, max_depth: int, n_bins: int):
 def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int):
     """Per-row leaf value (descend + gather)."""
     return tree.value[descend_tree(tree, binned, max_depth, n_bins)]
+
+
+# ---------------------------------------------------------------------------
+# Compiled serving fast path: flattened ensemble scorer
+# ---------------------------------------------------------------------------
+#
+# The MOJO idea (h2o-genmodel SharedTreeMojoModel [U3]): scoring needs
+# none of the training structures.  flatten_trees packs the dense heap
+# into compact per-tree node arrays — only REACHABLE nodes, explicit
+# left-child slots — and converts every split's bin id into a RAW
+# FEATURE threshold, so serving never re-bins: with right-searchsorted
+# binning, `bin(x) <= b  <=>  x < edges[b]`, hence descending right on
+# `x >= thresh` reproduces the heap descent decision bitwise.  These
+# arrays are the single flattening shared by the in-process scorer
+# (flat_margin) and the MOJO artifact (mojo.py serializes them).
+
+class FlatTrees(NamedTuple):
+    """Compact serving ensemble: [T, M] node arrays, M = max reachable
+    nodes per tree (BFS slot order, root = slot 0, right = left + 1)."""
+
+    split_feat: jax.Array   # int32 [T, M]; -1 marks a leaf
+    thresh: jax.Array       # f32   [T, M]; go RIGHT iff x >= thresh
+    left: jax.Array         # int32 [T, M]; left-child slot
+    na_left: jax.Array      # bool  [T, M]; NaN feature goes left
+    value: jax.Array        # f32   [T, M]; leaf value (0 on splits)
+
+
+def flatten_trees(trees: Tree, edges_matrix: np.ndarray,
+                  enum_mask: np.ndarray, max_depth: int) -> FlatTrees:
+    """Host-side flattening of a stacked [T, N] heap Tree pytree.
+
+    Threshold semantics (bitwise-equal to the binned heap descent,
+    models/tree/binning.py `apply_bins`):
+      numeric feature, split_bin b < n_edges: thresh = edges[f, b]
+        (searchsorted(e, x, "right") > b  <=>  x >= e[b], +inf pads
+        included — a padded edge sends every finite x left both ways);
+      numeric, b == n_edges (cut past the last body bin): thresh = NaN
+        — `x >= NaN` is False, so every non-NA row goes left, exactly
+        like `bin <= b` when b is the max body bin;
+      categorical (code IS the bin): thresh = b + 1, since
+        `clip(code) > b  <=>  code >= b + 1` for integer codes.
+    NA routing stays explicit via na_left (callers canonicalize
+    negative enum codes to NaN before descending — apply_bins sends
+    those to the NA bin)."""
+    sf = np.asarray(trees.split_feat)
+    sb = np.asarray(trees.split_bin)
+    nl = np.asarray(trees.na_left).astype(bool)
+    isp = np.asarray(trees.is_split).astype(bool)
+    val = np.asarray(trees.value).astype(np.float32)
+    edges_matrix = np.asarray(edges_matrix)
+    enum_mask = np.asarray(enum_mask).astype(bool)
+    T, N = sf.shape
+    # reachable set, level by level: children of reachable split nodes
+    reach = np.zeros((T, N), dtype=bool)
+    reach[:, 0] = True
+    for d in range(max_depth):
+        lo, hi = 2 ** d - 1, 2 ** (d + 1) - 1
+        if hi > N:
+            break
+        par = reach[:, lo:hi] & isp[:, lo:hi]
+        idx = np.arange(lo, hi)
+        reach[:, 2 * idx + 1] |= par
+        reach[:, 2 * idx + 2] |= par
+    # BFS slot order == heap-index order among reachable nodes (FIFO
+    # BFS emits each level in parent order, i.e. ascending heap index)
+    slot = reach.cumsum(axis=1) - 1                       # [T, N]
+    M = int(reach.sum(axis=1).max())
+    out_feat = np.full((T, M), -1, dtype=np.int32)
+    out_thresh = np.zeros((T, M), dtype=np.float32)
+    out_left = np.zeros((T, M), dtype=np.int32)
+    out_nal = np.zeros((T, M), dtype=bool)
+    out_val = np.zeros((T, M), dtype=np.float32)
+    tt, hh = np.nonzero(reach)
+    ss = slot[tt, hh]
+    sm = isp[tt, hh]                                      # split mask
+    f = np.where(sm, sf[tt, hh], 0)
+    b = sb[tt, hh]
+    width = edges_matrix.shape[1]
+    b_safe = np.minimum(b, width - 1)
+    with np.errstate(invalid="ignore"):
+        th = np.where(
+            enum_mask[f], (b + 1).astype(np.float32),
+            np.where(b < width, edges_matrix[f, b_safe].astype(np.float32),
+                     np.float32(np.nan)))
+    lh = np.minimum(2 * hh + 1, N - 1)                    # guarded gather
+    out_feat[tt, ss] = np.where(sm, sf[tt, hh], -1)
+    out_thresh[tt, ss] = np.where(sm, th, 0.0)
+    out_left[tt, ss] = np.where(sm, slot[tt, lh], 0)
+    out_nal[tt, ss] = nl[tt, hh] & sm
+    out_val[tt, ss] = np.where(sm, 0.0, val[tt, hh])
+    return FlatTrees(out_feat, out_thresh, out_left, out_nal, out_val)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def flat_margin(flat: FlatTrees, X, enum_mask, levels: int, K: int):
+    """[K, rows] per-class leaf-value sums over an interleaved [T*K]
+    flat ensemble, scored on RAW float features (no binning).
+
+    Accumulation is an ordered scan over boosting rounds — the same
+    per-class f32 addition order as the binned `_stack_predict` path,
+    so predictions are bitwise-identical, not merely close."""
+    # negative enum codes are NA (apply_bins sends them to the NA bin);
+    # canonicalize to NaN once so the descent needs only isnan
+    Xc = jnp.where(enum_mask[None, :] & (X < 0), jnp.float32(jnp.nan), X)
+    TK = flat.split_feat.shape[0]
+    per_round = jax.tree.map(
+        lambda a: a.reshape((TK // K, K) + a.shape[1:]), flat)
+
+    def descend(sf, th, lf, nl, val):
+        node = jnp.zeros(Xc.shape[0], dtype=jnp.int32)
+        for _ in range(levels):
+            f = sf[node]
+            x = jnp.take_along_axis(
+                Xc, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+            go_r = jnp.where(jnp.isnan(x), ~nl[node], x >= th[node])
+            node = jnp.where(f >= 0, lf[node] + go_r.astype(jnp.int32),
+                             node)
+        return val[node]
+
+    def body(acc, tr):
+        return acc + jax.vmap(descend)(*tr), None
+
+    init = jnp.zeros((K, Xc.shape[0]), dtype=jnp.float32)
+    total, _ = lax.scan(body, init, tuple(per_round))
+    return total

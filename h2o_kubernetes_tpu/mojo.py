@@ -28,7 +28,12 @@ import numpy as np
 
 __all__ = ["export_mojo", "import_mojo", "MojoModel"]
 
-_FORMAT = "h2o_kubernetes_tpu/mojo/1"
+# format 2: tree ensembles carry the flattened serving arrays
+# (flat_*) instead of heap tree_* + bin edges — bumped so an OLD
+# reader rejects a new artifact cleanly instead of KeyError-ing deep
+# in its scorer; THIS reader accepts both (legacy branch kept)
+_FORMAT = "h2o_kubernetes_tpu/mojo/2"
+_READABLE_FORMATS = ("h2o_kubernetes_tpu/mojo/1", _FORMAT)
 
 
 def _np(a):
@@ -72,11 +77,14 @@ def export_mojo(model, path) -> str:
         meta["na_bin"] = model.bin_spec.na_bin
         meta["margin_scale"] = float(getattr(model, "margin_scale", 1.0))
         arrays["init_score"] = _np(model.init_score)
-        arrays["edges"] = _np(model._edges)
         arrays["enum_mask"] = _np(model._enum_mask)
-        for f in ("split_feat", "split_bin", "na_left", "is_split",
-                  "value"):
-            arrays[f"tree_{f}"] = _np(getattr(model.trees, f))
+        # the SAME flattening the in-process serving scorer descends
+        # (models/tree/core.py flatten_trees, cached on the model):
+        # compact reachable nodes + raw-feature thresholds — the
+        # artifact scores without bin edges or re-binning
+        flat = model._flat()
+        for f in ("split_feat", "thresh", "left", "na_left", "value"):
+            arrays[f"flat_{f}"] = _np(getattr(flat, f))
     elif algo == "glm":
         from .models.glm import _famspec
 
@@ -187,8 +195,9 @@ class MojoModel:
     def __init__(self, path):
         with zipfile.ZipFile(path) as z:
             self.meta = json.loads(z.read("model.json"))
-            if self.meta.get("format") != _FORMAT:
-                raise ValueError(f"{path}: not a {_FORMAT} artifact")
+            if self.meta.get("format") not in _READABLE_FORMATS:
+                raise ValueError(f"{path}: not a {_FORMAT} artifact "
+                                 f"(format={self.meta.get('format')!r})")
             with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
                 self.arrays = {k: npz[k] for k in npz.files}
             if self.meta["algo"] == "stackedensemble":
@@ -517,6 +526,44 @@ class MojoModel:
         return out
 
     def _predict_trees(self, X, off=None):
+        if "flat_split_feat" in self.arrays:
+            totals = self._tree_totals_flat(X)
+        else:            # artifact written by a pre-flattening build
+            totals = self._tree_totals_binned(X)
+        return self._combine_tree_totals(totals, off)
+
+    def _tree_totals_flat(self, X):
+        """[n, K] per-class leaf-value sums over the flattened ensemble
+        (raw-feature thresholds; no binning) — the numpy mirror of
+        models/tree/core.py flat_margin, same descent decisions."""
+        m = self.meta
+        sf = self.arrays["flat_split_feat"]      # [T, M]
+        th = self.arrays["flat_thresh"]
+        lf = self.arrays["flat_left"]
+        nl = self.arrays["flat_na_left"]
+        val = self.arrays["flat_value"]
+        enum_mask = self.arrays["enum_mask"].astype(bool)
+        Xc = np.where(enum_mask[None, :] & (X < 0), np.nan, X)
+        T = sf.shape[0]
+        n = Xc.shape[0]
+        K = m["nclasses"] if m["nclasses"] > 2 else 1
+        totals = np.zeros((n, K), dtype=np.float64)
+        rows = np.arange(n)
+        for t in range(T):
+            node = np.zeros(n, dtype=np.int64)
+            for _ in range(m["max_depth"]):
+                f = sf[t][node]
+                x = Xc[rows, np.maximum(f, 0)]
+                with np.errstate(invalid="ignore"):
+                    go_right = np.where(np.isnan(x), ~nl[t][node],
+                                        x >= th[t][node])
+                child = lf[t][node] + go_right.astype(np.int64)
+                node = np.where(f >= 0, child, node)
+            totals[:, t % K] += val[t][node]
+        return totals
+
+    def _tree_totals_binned(self, X):
+        """Legacy-artifact scorer: re-bin, then heap re-descent."""
         m = self.meta
         binned = self._bin(X)
         sf = self.arrays["tree_split_feat"]      # [T, N]
@@ -527,7 +574,6 @@ class MojoModel:
         T = sf.shape[0]
         n = binned.shape[0]
         na_bin = m["na_bin"]
-        total = np.zeros(n, dtype=np.float64)
         K = m["nclasses"] if m["nclasses"] > 2 else 1
         totals = np.zeros((n, K), dtype=np.float64)
         for t in range(T):
@@ -543,6 +589,14 @@ class MojoModel:
                 child = 2 * node + 1 + go_right.astype(np.int64)
                 node = np.where(split, child, node)
             totals[:, t % K] += val[t][node]
+        return totals
+
+    def _combine_tree_totals(self, totals, off=None):
+        """Totals -> predictions: init/drf averaging/link, shared by
+        the flat and legacy tree scorers."""
+        m = self.meta
+        T = m["ntrees"]            # total stacked trees (K-interleaved)
+        K = m["nclasses"] if m["nclasses"] > 2 else 1
         init = np.atleast_1d(self.arrays["init_score"].astype(np.float64))
         if m["drf_mode"]:
             totals = totals / (T // K)

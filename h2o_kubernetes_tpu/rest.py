@@ -21,12 +21,17 @@ with `h2o_kubernetes_tpu.rest.start_server(port)` or
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 import traceback
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import numpy as np
+
 from .runtime.health import ClusterHealthError
+from .runtime.retry import _env_float
 
 FRAMES: dict[str, object] = {}     # key -> Frame (DKV analog)
 MODELS: dict[str, object] = {}     # key -> Model
@@ -34,6 +39,264 @@ AUTOML: dict[str, object] = {}     # project_name -> AutoML
 GRIDS: dict[str, object] = {}      # grid_id -> GridSearch
 _ID_LOCK = threading.Lock()
 _MODEL_SEQ = 0
+
+
+# ---------------------------------------------------------------------------
+# Scoring micro-batcher
+# ---------------------------------------------------------------------------
+#
+# ThreadingHTTPServer gives every /3/Predictions request its own
+# thread, but each would dispatch its own device program — at serving
+# concurrency that is many small dispatches instead of one full batch.
+# The micro-batcher collects concurrent scoring requests for up to
+# H2O_TPU_SCORE_BATCH_US microseconds (default 2000; 0 = no wait),
+# concatenates same-model requests into ONE padded batch through
+# Model.score_numpy (the jitted-scorer cache), and fans results back
+# out.  Train/build POSTs keep the existing single-dispatch path.
+#
+# Failure contract (docs/RESILIENCE.md): requests NEVER queue behind a
+# dead cloud — submit() and the dispatcher both check cluster health
+# and fail ClusterHealthError (the routes map it to 503), and a result
+# that misses H2O_TPU_SCORE_TIMEOUT seconds (default 60) raises
+# TimeoutError (503) instead of hanging the client.
+
+
+def _score_row_cap() -> int:
+    """H2O_TPU_SCORE_MAX_ROWS as a usable int cap.  <= 0 or inf reads
+    as UNCAPPED (the 0-disables convention of the other H2O_TPU
+    knobs) — and never raises, whatever the env holds: this runs on
+    the dispatcher thread, where an OverflowError would kill the
+    batcher with waiters still queued."""
+    import math
+
+    v = _env_float("H2O_TPU_SCORE_MAX_ROWS", 100_000.0)
+    if not math.isfinite(v) or v <= 0:
+        import sys
+
+        return sys.maxsize
+    return max(1, int(v))
+
+
+class _ScoreJob:
+    __slots__ = ("model", "X", "offset", "event", "out", "err",
+                 "deadline")
+
+    def __init__(self, model, X, offset):
+        self.model = model
+        self.X = X
+        self.offset = offset
+        self.event = threading.Event()
+        self.out = None
+        self.err = None
+        self.deadline = float("inf")
+
+
+class ScoreBatcher:
+    """Collects concurrent scoring requests into per-model batches."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending: list[_ScoreJob] = []
+        self._thread: threading.Thread | None = None
+        self.stats = {"requests": 0, "batches": 0, "batched_rows": 0,
+                      "max_batch_requests": 0}
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="h2o-tpu-score-batcher",
+                daemon=True)
+            self._thread.start()
+
+    def submit(self, model, X: np.ndarray, offset=None,
+               timeout: float | None = None) -> np.ndarray:
+        """Enqueue one scoring request; blocks until its slice of the
+        batched result (or raises: health fail-fast / timeout)."""
+        from .runtime import health
+
+        if not health.healthy():
+            raise ClusterHealthError(
+                "cluster unhealthy: "
+                f"{health.health_status()['error']} — scoring refused "
+                "(fail-fast, not queued)")
+        if timeout is None:
+            timeout = _env_float("H2O_TPU_SCORE_TIMEOUT", 60.0)
+        job = _ScoreJob(model, X, offset)
+        # the dispatcher drops jobs whose waiter has already timed out
+        # (503'd and gone) instead of burning device time on them
+        job.deadline = time.monotonic() + timeout
+        with self._cond:
+            self._ensure_thread()
+            self._pending.append(job)
+            self.stats["requests"] += 1
+            self._cond.notify_all()
+        if not job.event.wait(timeout):
+            raise TimeoutError(
+                f"scoring request timed out after {timeout:.0f}s in "
+                "the micro-batcher (H2O_TPU_SCORE_TIMEOUT)")
+        if job.err is not None:
+            raise job.err
+        return job.out
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    self._cond.wait()
+            win = _env_float("H2O_TPU_SCORE_BATCH_US", 2000.0) / 1e6
+            if win > 0:
+                # clamp: a negative value must not kill the dispatcher
+                # (sleep raises), a huge one must not wedge it
+                time.sleep(min(win, 1.0))    # collect concurrent arrivals
+            with self._cond:
+                batch, self._pending = self._pending, []
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: list[_ScoreJob]) -> None:
+        now = time.monotonic()
+        live = []
+        for job in batch:
+            if now > job.deadline:
+                # the waiter already 503'd and disconnected: scoring
+                # its rows would only delay live requests
+                job.err = TimeoutError("scoring request abandoned "
+                                       "(client wait expired)")
+                job.event.set()
+            else:
+                live.append(job)
+        groups: dict[tuple, list[_ScoreJob]] = {}
+        for job in live:
+            groups.setdefault(
+                (id(job.model), job.offset is not None), []).append(job)
+        # the per-request H2O_TPU_SCORE_MAX_ROWS cap must also bound
+        # the COALESCED dispatch: N capped requests in one window would
+        # otherwise concatenate into an N×-cap device program (the OOM
+        # → locked-cloud outage the cap exists to prevent)
+        cap = _score_row_cap()
+        for jobs in groups.values():
+            while jobs:
+                rows = 0
+                chunk = []
+                while jobs and (not chunk
+                                or rows + jobs[0].X.shape[0] <= cap):
+                    rows += jobs[0].X.shape[0]
+                    chunk.append(jobs.pop(0))
+                self._score_group(chunk)
+
+    def _score_group(self, jobs: list[_ScoreJob]) -> None:
+        from .runtime import health
+
+        try:
+            if not health.healthy():
+                raise ClusterHealthError(
+                    "cluster unhealthy: "
+                    f"{health.health_status()['error']} — queued "
+                    "scoring request dropped (fail-fast)")
+            model = jobs[0].model
+            self.stats["batches"] += 1
+            self.stats["max_batch_requests"] = max(
+                self.stats["max_batch_requests"], len(jobs))
+            if len(jobs) == 1:
+                jobs[0].out = model.score_numpy(
+                    jobs[0].X, offset=jobs[0].offset)
+            else:
+                X = np.concatenate([j.X for j in jobs])
+                off = None
+                if jobs[0].offset is not None:
+                    off = np.concatenate([j.offset for j in jobs])
+                self.stats["batched_rows"] += X.shape[0]
+                out = model.score_numpy(X, offset=off)
+                lo = 0
+                for j in jobs:
+                    hi = lo + j.X.shape[0]
+                    j.out = out[lo:hi]
+                    lo = hi
+        except BaseException as e:  # noqa: BLE001 — every waiter
+            for j in jobs:          # must be released, whatever died
+                j.err = e
+        finally:
+            for j in jobs:
+                j.event.set()
+
+
+BATCHER = ScoreBatcher()
+
+
+def _predict_via_batcher(model, frame):
+    """Frame prediction through the micro-batcher: design matrix ->
+    one (possibly coalesced) scoring dispatch -> prediction Frame.
+    Models outside the jitted serving set keep the classic path."""
+    from .runtime.health import device_dispatch
+
+    # coalescing only pays for many small concurrent requests; a big
+    # (or empty) single-frame predict through the batcher would add a
+    # device->host->device round trip + a padding copy for nothing —
+    # keep those on the classic device-resident predict() path (which
+    # rides the jitted-scorer cache anyway)
+    if not getattr(model, "_serving_jit", False) \
+            or frame.nrows == 0 or frame.nrows > 8192:
+        return model.predict(frame)
+    with device_dispatch("model scoring"):
+        X = np.asarray(model._design_matrix(frame))[: frame.nrows]
+        off = model._frame_offset(frame)   # the predict_raw contract
+        if off is not None:
+            off = np.asarray(off)[: frame.nrows]
+    out = BATCHER.submit(model, X, offset=off)
+    return model._prediction_frame(out)
+
+
+def _rows_to_matrix(model, rows, columns=None):
+    """JSON scoring payload -> [n, F] float32 in TRAINING value space.
+
+    `rows` is a list of per-row dicts (col -> value) or a list of
+    lists with `columns` naming their order. Enum levels map through
+    the training domain (unseen/None -> NaN = NA)."""
+    names = model.feature_names
+    if not isinstance(rows, list) or not rows:
+        raise ValueError("'rows' must be a non-empty list")
+    if isinstance(rows[0], dict):
+        missing = [n for n in names if n not in rows[0]]
+        if missing:
+            raise ValueError(f"missing feature column(s) {missing} "
+                             "(send null for NA, not absence)")
+
+        def get(r, name):
+            # direct indexing: a LATER row omitting a feature must
+            # reject (KeyError -> 400), not silently score it as NA
+            return r[name]
+    else:
+        if not columns:
+            raise ValueError(
+                "list-shaped rows need 'columns' naming their order")
+        pos = {c: i for i, c in enumerate(columns)}
+        missing = [n for n in names if n not in pos]
+        if missing:
+            raise ValueError(f"missing feature column(s) {missing}")
+
+        def get(r, name):
+            return r[pos[name]]
+
+    n = len(rows)
+    X = np.empty((n, len(names)), dtype=np.float32)
+    doms = getattr(model, "feature_domains", {}) or {}
+    # domain->code LUTs are request-invariant: cached per model (and
+    # dropped from pickles, like the jitted scorers) so the serving
+    # hot path does not rebuild an O(domain) dict per request
+    luts = model.__dict__.setdefault("_serving_luts", {})
+    for j, name in enumerate(names):
+        dom = doms.get(name)
+        if dom is not None:
+            lut = luts.get(name)
+            if lut is None:
+                lut = {d: float(i) for i, d in enumerate(dom)}
+                luts[name] = lut
+            X[:, j] = [lut.get(str(v), np.nan)
+                       if (v := get(r, name)) is not None else np.nan
+                       for r in rows]
+        else:
+            X[:, j] = [float(v) if (v := get(r, name)) is not None
+                       else np.nan for r in rows]
+    return X
 
 
 def _runtime_process_index() -> int | None:
@@ -88,6 +351,31 @@ def _is_leader() -> bool:
             return False
         return rt_leader
     return env_leader
+
+def _reap_jobs() -> None:
+    """Terminalize RUNNING jobs whose worker can no longer report.
+
+    A worker thread that dies between /3/Jobs polls (OOM-killed, a
+    non-Exception abort in native code) would leave its Job RUNNING
+    forever and the polling client hanging.  Every /3/Jobs poll first
+    fails (terminally) any RUNNING job whose recorded worker thread is
+    dead, and — when H2O_TPU_JOB_TIMEOUT seconds is set > 0 — any
+    RUNNING job older than the timeout."""
+    from .automl import JOBS
+
+    timeout = _env_float("H2O_TPU_JOB_TIMEOUT", 0.0)
+    for job in list(JOBS.values()):
+        if job.status != "RUNNING":
+            continue
+        th = getattr(job, "_thread", None)
+        if th is not None and not th.is_alive():
+            job.failed("worker thread died between polls without "
+                       "reporting a result")
+        elif timeout > 0 and job.start_time and \
+                time.time() - job.start_time > timeout:
+            job.failed(f"server-side job-poll timeout: still RUNNING "
+                       f"after {timeout:.0f}s (H2O_TPU_JOB_TIMEOUT)")
+
 
 _ALGOS = ("gbm", "drf", "glm", "deeplearning", "xgboost", "kmeans",
           "naivebayes", "pca", "isolationforest", "glrm", "coxph",
@@ -238,7 +526,8 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/3/Jobs":
                 from .automl import jobs
 
-                return self._json({"jobs": jobs()})
+                _reap_jobs()    # dead workers must read as FAILED,
+                return self._json({"jobs": jobs()})  # never hang pollers
             if path == "/3/Frames":
                 return self._json({"frames": [
                     _frame_schema(k, f) for k, f in FRAMES.items()]})
@@ -359,14 +648,19 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._build_model(algo, params)
             if path.startswith("/3/Predictions/models/"):
                 rest = path[len("/3/Predictions/models/"):]
-                mkey, _, fpart = rest.partition("/frames/")
+                mkey, sep, fpart = rest.partition("/frames/")
                 mkey = urllib.parse.unquote(mkey)
                 fpart = urllib.parse.unquote(fpart)
                 if mkey not in MODELS:
                     return self._error(404, f"model '{mkey}' not found")
+                if not sep:
+                    # inline serving route: JSON rows in, predictions
+                    # out — no frame registration, scored through the
+                    # micro-batcher + jitted-scorer cache
+                    return self._score_rows(MODELS[mkey], mkey, params)
                 if fpart not in FRAMES:
                     return self._error(404, f"frame '{fpart}' not found")
-                pred = MODELS[mkey].predict(FRAMES[fpart])
+                pred = _predict_via_batcher(MODELS[mkey], FRAMES[fpart])
                 key = f"prediction_{mkey}_{fpart}"
                 FRAMES[key] = pred
                 return self._json({"predictions_frame": {"name": key},
@@ -374,6 +668,9 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(404, f"no route for POST {path}")
         except ClusterHealthError as e:
             # the cloud died between the up-front gate and the dispatch
+            return self._error(503, str(e))
+        except TimeoutError as e:
+            # a scoring request must never hang behind the batcher
             return self._error(503, str(e))
         except Exception as e:       # noqa: BLE001
             traceback.print_exc()
@@ -421,6 +718,65 @@ class _Handler(BaseHTTPRequestHandler):
             kw[k] = v
         return kw
 
+    def _score_rows(self, model, mkey: str, params: dict):
+        """POST /3/Predictions/models/{key} — serving-shaped scoring:
+        JSON rows in, predictions out, one micro-batched dispatch."""
+        if not getattr(model, "_serving_jit", False):
+            # kmeans/isolationforest/stackedensemble & co. have no raw-
+            # matrix serving contract (predict() overrides / composed
+            # scoring) — reject cleanly instead of 500ing in score_numpy
+            # or leaking unlabeled _score_matrix output
+            return self._error(
+                400, f"model '{mkey}' ({getattr(model, 'algo', '?')}) "
+                "does not support inline row scoring; use "
+                f"/3/Predictions/models/{mkey}/frames/{{frame}}")
+        rows = params.get("rows")
+        if rows is None:
+            return self._error(400, "missing 'rows' (JSON list of "
+                               "row dicts, or lists + 'columns')")
+        max_rows = _score_row_cap()
+        if isinstance(rows, list) and len(rows) > max_rows:
+            # cap the PUBLIC route's dispatch size: one oversized
+            # payload OOM-ing the device would trip the locked-cloud
+            # protocol and 503 every later request — a single bad
+            # request must never become a cluster-wide serving outage
+            return self._error(
+                413, f"{len(rows)} rows exceeds the per-request limit "
+                f"of {max_rows} (H2O_TPU_SCORE_MAX_ROWS); split the "
+                "batch or use the frames route")
+        off = None
+        oc = getattr(model, "offset_column", None)
+        try:
+            X = _rows_to_matrix(model, rows, params.get("columns"))
+            if oc:
+                if not isinstance(rows[0], dict):
+                    raise ValueError(f"offset column '{oc}' needs "
+                                     "dict-shaped rows")
+                # r[oc] (not .get): a row omitting the offset must
+                # reject like any other absent column
+                off = np.asarray(
+                    [float(r[oc]) if r[oc] is not None else np.nan
+                     for r in rows], dtype=np.float32)
+        except (ValueError, TypeError, KeyError, IndexError) as e:
+            return self._error(400, f"bad scoring payload: {e!r}")
+        out = BATCHER.submit(model, X, offset=off)
+        resp: dict = {"model_id": {"name": mkey}, "rows": len(rows)}
+        if getattr(model, "nclasses", 1) > 1:
+            dom = model.response_domain or \
+                [str(i) for i in range(model.nclasses)]
+            labels = out.argmax(axis=1)
+            resp["predict"] = [dom[int(i)] for i in labels]
+            for k, name in enumerate(dom):
+                resp[f"p{name}"] = [float(v) for v in out[:, k]]
+        else:
+            out = np.asarray(out)
+            if out.ndim > 1:     # e.g. autoencoder reconstruction
+                resp["predict"] = [[float(v) for v in row]
+                                   for row in out]
+            else:
+                resp["predict"] = [float(v) for v in out]
+        return self._json(resp)
+
     def _run_job(self, job, fn, sync_timeout: float):
         """Run fn on a worker thread under `job`, waiting up to
         sync_timeout (the Job keeps running past the wait — poll
@@ -438,6 +794,11 @@ class _Handler(BaseHTTPRequestHandler):
 
         t = threading.Thread(target=run, daemon=True)
         t.start()
+        # recorded AFTER start: the /3/Jobs reaper treats a RUNNING job
+        # with a dead recorded thread as failed, and a created-but-not-
+        # yet-started thread reads not-alive — assigning first would
+        # let a concurrent poll reap a healthy build
+        job._thread = t
         t.join(timeout=sync_timeout)
 
     def _build_automl(self, params: dict):

@@ -20,6 +20,39 @@ import time
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
+def host_features_fingerprint(cpuinfo_path: str = "/proc/cpuinfo") -> str:
+    """Stable short hash of this host's CPU feature set (ISA flags).
+
+    XLA:CPU AOT-compiles with the build host's features: BENCH_r05.json
+    caught a cache entry compiled with +amx-*/+avx512* loading on a
+    host WITHOUT them ("could lead to execution errors such as
+    SIGILL").  jax's persistent-cache key does not include host
+    features, so the cache DIRECTORY must — a copied cache dir can then
+    never serve a mismatched binary (the lookup simply misses).
+
+    Order-insensitive over the flag set (kernel flag ordering is not
+    stable across reboots); falls back to the platform tuple where
+    /proc/cpuinfo is unavailable (macOS, containers without procfs)."""
+    import hashlib
+
+    feats = ""
+    try:
+        with open(cpuinfo_path) as f:
+            for line in f:
+                # x86 says "flags", arm64 says "Features"
+                if line.lower().startswith(("flags", "features")):
+                    feats = " ".join(sorted(set(
+                        line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        pass
+    if not feats:
+        import platform
+
+        feats = f"{platform.machine()}|{platform.processor()}"
+    return hashlib.sha1(feats.encode()).hexdigest()[:10]
+
+
 def force_cpu_devices(n: int) -> None:
     """Pin this process to the CPU platform with >= n virtual devices.
 
@@ -84,7 +117,12 @@ def enable_persistent_compile_cache() -> None:
         candidates.append(
             os.path.join(tempfile.gettempdir(), "h2o_tpu_jax_cache"))
         cache_dir = None
+        # key the cache dir by host CPU features: an AOT entry compiled
+        # with +amx/+avx512 must never load on a host without them
+        # (SIGILL class — see host_features_fingerprint)
+        fp = f"hostfp-{host_features_fingerprint()}"
         for cand in candidates:
+            cand = os.path.join(cand, fp)
             try:
                 os.makedirs(cand, exist_ok=True)
                 # pid suffix: two capture tools probing the shared repo

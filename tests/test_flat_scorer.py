@@ -1,0 +1,191 @@
+"""Compiled serving fast path (ISSUE 2 tentpole): the flattened-tree
+scorer must match the binned heap re-descent BITWISE across the parity
+matrix (NAs, categoricals incl. grouped high-cardinality bins, weights,
+offset, multinomial/DRF, laplace margin scaling), the jitted-scorer
+cache must be zero-retrace warm, and MOJO export must reuse the SAME
+flattened arrays (one flattening code path)."""
+
+import io
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import DRF, GBM, GLM, DeepLearning, XGBoost
+from h2o_kubernetes_tpu.models.base import scorer_cache_stats
+from h2o_kubernetes_tpu.mojo import MojoModel, export_mojo
+
+
+def _rich_frame(n=1200, seed=7, nlevels=100):
+    """Numeric-with-NA + low-card enum + HIGH-card enum (grouped code
+    ranges at nbins=64) + weights + offset + binary response."""
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n).astype(np.float32)
+    x0[::17] = np.nan
+    x1 = rng.exponential(2.0, size=n).astype(np.float32)
+    g = np.array([f"L{i}" for i in range(nlevels)])[
+        rng.integers(0, nlevels, n)]
+    c = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    w = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    off = rng.normal(scale=0.1, size=n).astype(np.float32)
+    y = np.where(np.nan_to_num(x0) + (c == "a")
+                 + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays(
+        {"x0": x0, "x1": x1, "g": g, "c": c, "w": w, "off": off, "y": y})
+
+
+def _assert_bitwise(model, frame, offset_col=None):
+    X = model._design_matrix(frame)
+    off = frame.vec(offset_col).as_float() if offset_col else None
+    a = np.asarray(model._margins(X, off) if off is not None
+                   else model._margins(X))
+    b = np.asarray(model._margins_binned(X, off) if off is not None
+                   else model._margins_binned(X))
+    assert a.dtype == b.dtype and a.shape == b.shape
+    assert np.array_equal(a, b), \
+        f"flat scorer diverged: max |d| = {np.abs(a - b).max()}"
+
+
+def test_flat_parity_binomial_weights_offset_highcard(mesh8):
+    fr = _rich_frame()
+    m = GBM(ntrees=8, max_depth=4, nbins=64, seed=1).train(
+        y="y", training_frame=fr, weights_column="w",
+        offset_column="off")
+    _assert_bitwise(m, fr, offset_col="off")
+    # scoring-frame domain remap path too (fresh frame, same data)
+    _assert_bitwise(m, _rich_frame(seed=7), offset_col="off")
+
+
+def test_flat_parity_gaussian_and_laplace(mesh8):
+    rng = np.random.default_rng(3)
+    n = 800
+    x = rng.normal(size=n).astype(np.float32)
+    x[::11] = np.nan
+    y = 2.0 * np.nan_to_num(x) + rng.normal(scale=0.3, size=n)
+    fr = h2o.Frame.from_arrays(
+        {"x": x, "y": y.astype(np.float32)})
+    for dist in ("gaussian", "laplace"):
+        m = GBM(ntrees=6, max_depth=3, distribution=dist, seed=2).train(
+            y="y", training_frame=fr)
+        _assert_bitwise(m, fr)   # laplace: margin_scale != 1 path
+
+
+def test_flat_parity_drf_multinomial(mesh8):
+    rng = np.random.default_rng(5)
+    n = 900
+    x = rng.normal(size=n).astype(np.float32)
+    c = np.array(["u", "v"])[rng.integers(0, 2, n)]
+    y = np.where(x > 0.5, "A", np.where(x < -0.5, "B", "C"))
+    fr = h2o.Frame.from_arrays({"x": x, "c": c, "y": y})
+    m = DRF(ntrees=6, max_depth=4, nbins=32, seed=4).train(
+        y="y", training_frame=fr)
+    _assert_bitwise(m, fr)
+    # GBM multinomial (boosted K-interleaved trees)
+    m2 = GBM(ntrees=4, max_depth=3, seed=4).train(
+        y="y", training_frame=fr)
+    _assert_bitwise(m2, fr)
+
+
+def test_flat_parity_xgboost(mesh8):
+    rng = np.random.default_rng(9)
+    n = 600
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = (x0 - x1 + rng.normal(scale=0.4, size=n)).astype(np.float32)
+    fr = h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
+    m = XGBoost(ntrees=5, max_depth=4, seed=1).train(
+        y="y", training_frame=fr)
+    _assert_bitwise(m, fr)
+
+
+def test_score_numpy_matches_predict_and_is_warm(mesh8):
+    fr = _rich_frame(n=700, seed=11)
+    m = GBM(ntrees=5, max_depth=3, nbins=64, seed=1).train(
+        y="y", training_frame=fr, offset_column="off")
+    pr = m.predict_raw(fr)
+    X = np.asarray(m._design_matrix(fr))[: fr.nrows]
+    off = np.asarray(fr.vec("off").as_float())[: fr.nrows]
+    got = m.score_numpy(X, offset=off)
+    assert np.array_equal(got, pr)
+    # warm repeat: zero new cache misses (miss == new XLA trace key)
+    s0 = scorer_cache_stats()
+    m.score_numpy(X, offset=off)
+    s1 = scorer_cache_stats()
+    assert s1["misses"] == s0["misses"]
+    assert s1["hits"] == s0["hits"] + 1
+    # any batch inside the same power-of-two bucket: still zero miss
+    m.score_numpy(X[:100], offset=off[:100])
+    m.score_numpy(X[:90], offset=off[:90])
+    s2 = scorer_cache_stats()
+    assert s2["misses"] == s1["misses"] + 1   # first 128-bucket compile
+    assert s2["hits"] == s1["hits"] + 1
+
+
+def test_score_numpy_validation(mesh8):
+    rng = np.random.default_rng(1)
+    fr = h2o.Frame.from_arrays(
+        {"x": rng.normal(size=300).astype(np.float32),
+         "y": rng.normal(size=300).astype(np.float32)})
+    m = GBM(ntrees=3, max_depth=2, seed=0).train(
+        y="y", training_frame=fr)
+    with pytest.raises(ValueError, match="expects"):
+        m.score_numpy(np.zeros((5, 3), np.float32))
+    with pytest.raises(ValueError, match="empty"):
+        m.score_numpy(np.zeros((0, 1), np.float32))
+
+
+def test_score_numpy_glm_deeplearning(mesh8):
+    """GLM and DeepLearning ride the same jitted-scorer cache."""
+    rng = np.random.default_rng(2)
+    n = 500
+    x = rng.normal(size=n).astype(np.float32)
+    c = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    y = np.where(x + (c == "a") + rng.normal(scale=0.5, size=n) > 0,
+                 "p", "n")
+    fr = h2o.Frame.from_arrays({"x": x, "c": c, "y": y})
+    for est in (GLM(family="binomial"),
+                DeepLearning(hidden=[8], epochs=1, seed=1)):
+        m = est.train(y="y", training_frame=fr)
+        assert m._serving_jit
+        pr = m.predict_raw(fr)
+        X = np.asarray(m._design_matrix(fr))[: fr.nrows]
+        got = m.score_numpy(X)
+        np.testing.assert_allclose(got, pr, rtol=1e-6, atol=1e-7)
+
+
+def test_mojo_shares_flattening(tmp_path, mesh8):
+    """MOJO export serializes the SAME flat arrays the serving scorer
+    descends — one flattening code path, no edges, no re-binning."""
+    fr = _rich_frame(n=600, seed=13)
+    m = GBM(ntrees=6, max_depth=4, nbins=64, seed=3).train(
+        y="y", training_frame=fr)
+    buf = io.BytesIO()
+    export_mojo(m, buf)
+    buf.seek(0)
+    mj = MojoModel(buf)
+    flat = m._flat()
+    for f in ("split_feat", "thresh", "left", "na_left", "value"):
+        assert np.array_equal(mj.arrays[f"flat_{f}"],
+                              np.asarray(getattr(flat, f))), f
+    assert "edges" not in mj.arrays
+    assert "tree_split_feat" not in mj.arrays
+    got = mj.predict(fr)
+    want = m.predict_raw(fr)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_flat_cache_survives_pickle(tmp_path, mesh8):
+    from h2o_kubernetes_tpu.persist import load_model, save_model
+
+    fr = _rich_frame(n=400, seed=17)
+    m = GBM(ntrees=4, max_depth=3, nbins=64, seed=5).train(
+        y="y", training_frame=fr)
+    want = m.predict_raw(fr)       # populates _flat_trees + scorer
+    p = str(tmp_path / "m.model")
+    save_model(m, p)
+    m2 = load_model(p)
+    # derivable serving state is NOT pickled (rebuilt lazily): the
+    # artifact must not depend on whether the model served first
+    assert "_flat_trees" not in m2.__dict__
+    assert "_scorer_cache" not in m2.__dict__
+    assert np.array_equal(m2.predict_raw(fr), want)

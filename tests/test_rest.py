@@ -305,6 +305,177 @@ def test_mojo_download_route(server, tmp_path):
         assert e.code == 404
 
 
+def test_inline_scoring_row_cap(server, tmp_path, monkeypatch):
+    """H2O_TPU_SCORE_MAX_ROWS: an oversized inline payload is a clean
+    413, never a device dispatch that could trip the locked cloud."""
+    _mkframe(server, tmp_path, n=300, name="captrain")
+    _post(server, "/3/ModelBuilders/gbm", training_frame="captrain",
+          response_column="y", ntrees="3", max_depth="2",
+          model_id="cap_gbm")
+    monkeypatch.setenv("H2O_TPU_SCORE_MAX_ROWS", "2")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(server, "/3/Predictions/models/cap_gbm",
+                   {"rows": [{"x": 0.1}, {"x": 0.2}, {"x": 0.3}]})
+    assert e.value.code == 413
+    out = _post_json(server, "/3/Predictions/models/cap_gbm",
+                     {"rows": [{"x": 0.1}, {"x": 0.2}]})
+    assert out["rows"] == 2
+    # 0 / inf / garbage read as UNCAPPED, never a dead dispatcher
+    for raw in ("0", "inf", "-3"):
+        monkeypatch.setenv("H2O_TPU_SCORE_MAX_ROWS", raw)
+        out = _post_json(server, "/3/Predictions/models/cap_gbm",
+                         {"rows": [{"x": 0.1}, {"x": 0.2}, {"x": 0.3}]})
+        assert out["rows"] == 3, raw
+
+
+def test_inline_scoring_route(server, tmp_path):
+    """POST /3/Predictions/models/{key} with JSON rows: the serving
+    fast path (no frame registration) — predictions match
+    score_numpy, unseen levels/nulls read as NA."""
+    _mkframe(server, tmp_path, n=300, name="srvtrain")
+    _post(server, "/3/ModelBuilders/gbm", training_frame="srvtrain",
+          response_column="y", ntrees="4", max_depth="3",
+          model_id="srv_gbm")
+    out = _post_json(server, "/3/Predictions/models/srv_gbm", {
+        "rows": [{"x": 0.5}, {"x": -1.0}, {"x": None}]})
+    assert out["rows"] == 3
+    assert set(out["predict"]) <= {"p", "n"}
+    m = rest.MODELS["srv_gbm"]
+    want = m.score_numpy(
+        np.array([[0.5], [-1.0], [np.nan]], np.float32))
+    np.testing.assert_allclose(out["pp"], want[:, 1], rtol=1e-6)
+    # list-shaped rows with explicit column order
+    out2 = _post_json(server, "/3/Predictions/models/srv_gbm", {
+        "rows": [[0.5], [-1.0]], "columns": ["x"]})
+    assert out2["predict"] == out["predict"][:2]
+    # malformed payloads stay clean 400s
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(server, "/3/Predictions/models/srv_gbm", {})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(server, "/3/Predictions/models/srv_gbm",
+                   {"rows": [[1.0]]})     # list rows, no columns
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        # a LATER row omitting a feature: 400, not silent NA scoring
+        _post_json(server, "/3/Predictions/models/srv_gbm",
+                   {"rows": [{"x": 1.0}, {}]})
+    assert e.value.code == 400
+    # models without the raw-matrix serving contract: clean 400
+    rest.MODELS["noserve"] = type("M", (), {"algo": "kmeans"})()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(server, "/3/Predictions/models/noserve",
+                       {"rows": [{"x": 1.0}]})
+        assert e.value.code == 400
+    finally:
+        rest.MODELS.pop("noserve", None)
+
+
+def test_concurrent_predictions_smoke(server, tmp_path):
+    """Tier-1 micro-batcher smoke: a threaded server serving 2+
+    concurrent predict requests through the batching path."""
+    import threading
+
+    _mkframe(server, tmp_path, n=300, name="conctrain")
+    _post(server, "/3/ModelBuilders/gbm", training_frame="conctrain",
+          response_column="y", ntrees="3", max_depth="2",
+          model_id="conc_gbm")
+    s0 = dict(rest.BATCHER.stats)
+    results = [None, None]
+
+    def hit(i):
+        results[i] = _post_json(
+            server, "/3/Predictions/models/conc_gbm",
+            {"rows": [{"x": float(i)}, {"x": -float(i)}]})
+
+    ts = [threading.Thread(target=hit, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(r is not None and r["rows"] == 2 for r in results)
+    s1 = rest.BATCHER.stats
+    assert s1["requests"] >= s0["requests"] + 2
+    assert s1["batches"] >= s0["batches"] + 1
+    # per-request results are the per-request slices, not the batch
+    m = rest.MODELS["conc_gbm"]
+    for i, r in enumerate(results):
+        want = m.score_numpy(
+            np.array([[float(i)], [-float(i)]], np.float32))
+        np.testing.assert_allclose(r["pp"], want[:, 1], rtol=1e-6)
+
+
+def test_job_poll_reaps_dead_worker(server):
+    """A worker thread that dies without reporting must read as FAILED
+    on the next /3/Jobs poll — clients can never hang forever."""
+    import threading
+
+    from h2o_kubernetes_tpu.automl import JOBS, Job
+
+    job = Job(dest="reap_dead", description="doomed worker").start()
+    t = threading.Thread(target=lambda: None)
+    t.start()
+    t.join()
+    job._thread = t                  # dead thread, job still RUNNING
+    try:
+        jobs = _get(server, "/3/Jobs")["jobs"]
+        mine = [j for j in jobs if j["dest"] == "reap_dead"]
+        assert mine and mine[0]["status"] == "FAILED"
+        assert "died" in mine[0]["msg"]
+    finally:
+        JOBS.pop("reap_dead", None)
+
+
+def test_job_poll_timeout(server, monkeypatch):
+    """H2O_TPU_JOB_TIMEOUT: a RUNNING job older than the timeout is
+    terminally FAILED on poll (worker unaccounted for)."""
+    import time as _time
+
+    from h2o_kubernetes_tpu.automl import JOBS, Job
+
+    job = Job(dest="reap_old", description="stuck").start()
+    job.start_time = _time.time() - 3600
+    try:
+        # no timeout configured: stays RUNNING
+        jobs = _get(server, "/3/Jobs")["jobs"]
+        assert [j for j in jobs
+                if j["dest"] == "reap_old"][0]["status"] == "RUNNING"
+        monkeypatch.setenv("H2O_TPU_JOB_TIMEOUT", "60")
+        jobs = _get(server, "/3/Jobs")["jobs"]
+        mine = [j for j in jobs if j["dest"] == "reap_old"]
+        assert mine[0]["status"] == "FAILED"
+        assert "timeout" in mine[0]["msg"]
+        # FAILED is terminal: the (still live) worker finishing later
+        # must not resurrect the job to DONE under pollers' feet
+        job.done()
+        assert job.status == "FAILED"
+    finally:
+        JOBS.pop("reap_old", None)
+
+
+@pytest.mark.slow
+def test_rest_scoring_load(server, tmp_path):
+    """Closed-loop REST scoring load (tools/score_load.py) against a
+    live server: no errors, and concurrent requests coalesce into
+    fewer micro-batches than requests."""
+    from tools.score_load import run_load
+
+    _mkframe(server, tmp_path, n=500, name="loadtrain")
+    _post(server, "/3/ModelBuilders/gbm", training_frame="loadtrain",
+          response_column="y", ntrees="5", max_depth="3",
+          model_id="load_gbm")
+    s0 = dict(rest.BATCHER.stats)
+    out = run_load(server, "load_gbm", ["x"], concurrency=6,
+                   rows_per_request=16, seconds=2.0)
+    assert out["errors"] == 0, out
+    assert out["requests"] > 0
+    s1 = rest.BATCHER.stats
+    new_req = s1["requests"] - s0["requests"]
+    new_bat = s1["batches"] - s0["batches"]
+    assert new_req > new_bat, (new_req, new_bat)   # coalescing happened
+
+
 def test_encoded_keys_across_routes(server):
     """Registry keys are percent-decoded on the Frames GET/summary/
     DELETE routes and the Models detail route — clients URL-encode ids

@@ -178,3 +178,43 @@ def test_doall_cache_key_reuses_jit(mesh8):
         logging.getLogger("jax").removeHandler(h)
     assert msgs == [], msgs
     assert np.isfinite(r["mean"])
+
+
+def test_host_features_fingerprint(tmp_path):
+    """The persistent-XLA-cache dir is keyed by a host CPU feature
+    fingerprint: a cache copied from an +amx/+avx512 build host can
+    never serve a mismatched AOT binary (SIGILL class, BENCH_r05)."""
+    from h2o_kubernetes_tpu.runtime.backend import (
+        host_features_fingerprint)
+
+    fp = host_features_fingerprint()
+    assert len(fp) == 10 and all(c in "0123456789abcdef" for c in fp)
+    assert fp == host_features_fingerprint()          # deterministic
+    # flag-set keyed: different features -> different fingerprint,
+    # flag ORDER does not matter (kernel ordering isn't stable)
+    a = tmp_path / "a"
+    a.write_text("flags\t\t: fpu avx2 avx512f amx-tile\n")
+    b = tmp_path / "b"
+    b.write_text("flags\t\t: fpu avx2\n")
+    c = tmp_path / "c"
+    c.write_text("flags\t\t: amx-tile avx512f avx2 fpu\n")
+    fa = host_features_fingerprint(str(a))
+    fb = host_features_fingerprint(str(b))
+    fc = host_features_fingerprint(str(c))
+    assert fa != fb
+    assert fa == fc
+    # arm64 spelling
+    d = tmp_path / "d"
+    d.write_text("Features\t: fp asimd sve\n")
+    assert host_features_fingerprint(str(d)) != fa
+    # unreadable cpuinfo still fingerprints (platform fallback)
+    assert len(host_features_fingerprint(str(tmp_path / "nope"))) == 10
+
+
+def test_compile_cache_dir_keyed_by_host_features(monkeypatch):
+    from h2o_kubernetes_tpu.runtime import backend
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    backend.enable_persistent_compile_cache()
+    got = __import__("os").environ.get("JAX_COMPILATION_CACHE_DIR", "")
+    assert f"hostfp-{backend.host_features_fingerprint()}" in got

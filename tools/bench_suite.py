@@ -13,7 +13,17 @@ beyond the headline GBM number (bench.py):
 - config #3b lambdarank on the MSLR shape (qid groups, graded rel);
 - config #4  DeepLearning MLP (model-averaging allreduce) — rows/sec
   through one epoch;
-- config #4b Word2Vec skip-gram, Zipf corpus.
+- config #4b Word2Vec skip-gram, Zipf corpus;
+- config #5  gbm_score_rows_per_sec — the compiled SERVING fast path
+  (flattened-tree scorer + jitted-predict cache, docs/SERVING.md):
+  warm ``score_numpy`` rows/s on a 100k-row batch, recorded next to
+  the per-call ``predict()`` Frame path it replaces, with a
+  recompile check (warm repeat must add 0 scorer-cache misses).
+
+``BENCH_SUITE_CONFIGS`` (comma list of config names) restricts the run
+to a subset — e.g. ``BENCH_SUITE_CONFIGS=gbm_score_rows_per_sec`` for
+a quick serving capture; partial runs write to a ``_partial`` file so
+they never clobber a full-suite artifact.
 
 Every config reports BOTH timings: ``compile_seconds`` (the first
 call — what a cold user pays, XLA compile included) and ``seconds``
@@ -68,6 +78,20 @@ def main() -> int:
     rows = int(os.environ.get("BENCH_SUITE_ROWS",
                               1_000_000 if on_tpu else 30_000))
     results = []
+    only = {c.strip() for c in os.environ.get(
+        "BENCH_SUITE_CONFIGS", "").split(",") if c.strip()}
+
+    def _want(name: str) -> bool:
+        return not only or name in only
+
+    _higgs_cache: dict = {}
+
+    def _higgs(nr, seed=None):
+        key = (nr, seed)
+        if key not in _higgs_cache:
+            _higgs_cache[key] = (D.higgs_frame(nr) if seed is None
+                                 else D.higgs_frame(nr, seed=seed))
+        return _higgs_cache[key]
 
     def record(config, value, unit, seconds, calls, compile_s, **extra):
         row = {"config": config, "value": round(value, 1), "unit": unit,
@@ -77,112 +101,152 @@ def main() -> int:
         results.append(row)
         print(json.dumps(row), flush=True)
 
-    # ingest: airlines-shaped CSV through import_file (arrow fast path)
-    import tempfile
-    ing_rows = min(max(rows, 100_000), 2_000_000)
-    with tempfile.TemporaryDirectory() as td:
-        csv_path = os.path.join(td, "air.csv")
-        D.airlines_csv(csv_path, ing_rows, chunk=1_000_000)
-        mb = os.path.getsize(csv_path) / 1e6
-        fr_ing, dt, calls, cdt = _timed(
-            lambda: h2o.import_file(csv_path), on_tpu)
-        ncells = ing_rows * fr_ing.ncols
-        record("ingest_airlines_csv", ing_rows / dt, "rows/s", dt, calls,
-               cdt, rows_ingest=ing_rows, mb=round(mb, 1),
-               cells_per_s=round(ncells / dt, 1),
-               mb_per_s=round(mb / dt, 2))
+    if _want("ingest_airlines_csv"):
+        # ingest: airlines-shaped CSV through import_file (arrow fast
+        # path)
+        import tempfile
+        ing_rows = min(max(rows, 100_000), 2_000_000)
+        with tempfile.TemporaryDirectory() as td:
+            csv_path = os.path.join(td, "air.csv")
+            D.airlines_csv(csv_path, ing_rows, chunk=1_000_000)
+            mb = os.path.getsize(csv_path) / 1e6
+            fr_ing, dt, calls, cdt = _timed(
+                lambda: h2o.import_file(csv_path), on_tpu)
+            ncells = ing_rows * fr_ing.ncols
+            record("ingest_airlines_csv", ing_rows / dt, "rows/s", dt,
+                   calls, cdt, rows_ingest=ing_rows, mb=round(mb, 1),
+                   cells_per_s=round(ncells / dt, 1),
+                   mb_per_s=round(mb / dt, 2))
 
-    # config #2a: GLM binomial IRLSM — north-star "GLM iters/sec".
-    # 50 iterations on >=100k rows: the r04 number (4 iters on 15k
-    # rows, 0.024 s) measured dispatch, not the Gram path.
-    fr_glm = D.higgs_frame(rows if on_tpu else max(rows, 100_000))
-    # epsilons at 0 force the full 50 iterations — the benchmark wants
-    # a fixed, comparable amount of Gram work, not a convergence race
-    m, dt, calls, cdt = _timed(lambda: GLM(
-        family="binomial", solver="IRLSM", lambda_=0.0,
-        max_iterations=50, objective_epsilon=0.0, beta_epsilon=0.0,
-        seed=1).train(y="y", training_frame=fr_glm), on_tpu)
-    record("glm_binomial_irlsm", m.n_iterations / dt, "iters/s", dt,
-           calls, cdt, iterations=m.n_iterations, rows_glm=fr_glm.nrows,
-           auc=round(float(m.model_performance(fr_glm, y="y")["auc"]), 5))
+    if _want("glm_binomial_irlsm"):
+        # config #2a: GLM binomial IRLSM — north-star "GLM iters/sec".
+        # 50 iterations on >=100k rows: the r04 number (4 iters on 15k
+        # rows, 0.024 s) measured dispatch, not the Gram path.
+        fr_glm = _higgs(rows if on_tpu else max(rows, 100_000))
+        # epsilons at 0 force the full 50 iterations — the benchmark
+        # wants a fixed, comparable amount of Gram work, not a
+        # convergence race
+        m, dt, calls, cdt = _timed(lambda: GLM(
+            family="binomial", solver="IRLSM", lambda_=0.0,
+            max_iterations=50, objective_epsilon=0.0, beta_epsilon=0.0,
+            seed=1).train(y="y", training_frame=fr_glm), on_tpu)
+        record("glm_binomial_irlsm", m.n_iterations / dt, "iters/s", dt,
+               calls, cdt, iterations=m.n_iterations,
+               rows_glm=fr_glm.nrows,
+               auc=round(float(
+                   m.model_performance(fr_glm, y="y")["auc"]), 5))
 
-    fr = fr_glm if on_tpu else D.higgs_frame(rows)
-
-    # config #2b: DRF (unit-hessian 2-channel histograms)
     ntrees, depth = 10, 8
-    m, dt, calls, cdt = _timed(lambda: DRF(
-        ntrees=ntrees, max_depth=depth, seed=1).train(
-        y="y", training_frame=fr), on_tpu)
-    record("drf_higgs", fr.nrows * ntrees / dt, "rows*trees/s",
-           dt, calls, cdt, ntrees=ntrees, max_depth=depth)
+    if _want("drf_higgs"):
+        # config #2b: DRF (unit-hessian 2-channel histograms)
+        fr = _higgs(rows)
+        m, dt, calls, cdt = _timed(lambda: DRF(
+            ntrees=ntrees, max_depth=depth, seed=1).train(
+            y="y", training_frame=fr), on_tpu)
+        record("drf_higgs", fr.nrows * ntrees / dt, "rows*trees/s",
+               dt, calls, cdt, ntrees=ntrees, max_depth=depth)
 
-    # config #3: XGBoost hist semantics
-    m, dt, calls, cdt = _timed(lambda: XGBoost(
-        ntrees=ntrees, max_depth=6, learn_rate=0.2, seed=1).train(
-        y="y", training_frame=fr), on_tpu)
-    record("xgboost_hist", fr.nrows * ntrees / dt, "rows*trees/s",
-           dt, calls, cdt, ntrees=ntrees, max_depth=6)
+    if _want("xgboost_hist"):
+        # config #3: XGBoost hist semantics
+        fr = _higgs(rows)
+        m, dt, calls, cdt = _timed(lambda: XGBoost(
+            ntrees=ntrees, max_depth=6, learn_rate=0.2, seed=1).train(
+            y="y", training_frame=fr), on_tpu)
+        record("xgboost_hist", fr.nrows * ntrees / dt, "rows*trees/s",
+               dt, calls, cdt, ntrees=ntrees, max_depth=6)
 
-    # multinomial GBM: K class trees per round through the
-    # class-flattened batching rule (custom_vmap lowers the class axis
-    # into the node axis — the round-4 Mosaic fix; K x fuller MXU M)
-    mn_rows = min(fr.nrows, 500_000)
-    rngm = np.random.default_rng(3)
-    Xm = rngm.normal(size=(mn_rows, 10)).astype(np.float32)
-    score = Xm[:, 0] + 0.5 * Xm[:, 1]
-    ym = np.where(score > 0.6, "a",
-                  np.where(score < -0.6, "b",
-                           np.where(Xm[:, 2] > 0, "c", "d")))
-    mcols = {f"f{i}": Xm[:, i] for i in range(10)}
-    mcols["y"] = ym
-    fr_mn = h2o.Frame.from_arrays(mcols)
-    mn_ntrees = 5
-    m, dt, calls, cdt = _timed(lambda: GBM(
-        ntrees=mn_ntrees, max_depth=5, learn_rate=0.2, seed=1).train(
-        y="y", training_frame=fr_mn), on_tpu)
-    record("gbm_multinomial", mn_rows * mn_ntrees * m.nclasses / dt,
-           "rows*classtrees/s", dt, calls, cdt, rows_mn=mn_rows,
-           classes=m.nclasses,
-           logloss=round(float(
-               m.scoring_history[-1].get("train_logloss",
-                                         float("nan"))), 5))
+    if _want("gbm_multinomial"):
+        # multinomial GBM: K class trees per round through the
+        # class-flattened batching rule (custom_vmap lowers the class
+        # axis into the node axis — the round-4 Mosaic fix; K x fuller
+        # MXU M)
+        mn_rows = min(rows, 500_000)
+        rngm = np.random.default_rng(3)
+        Xm = rngm.normal(size=(mn_rows, 10)).astype(np.float32)
+        score = Xm[:, 0] + 0.5 * Xm[:, 1]
+        ym = np.where(score > 0.6, "a",
+                      np.where(score < -0.6, "b",
+                               np.where(Xm[:, 2] > 0, "c", "d")))
+        mcols = {f"f{i}": Xm[:, i] for i in range(10)}
+        mcols["y"] = ym
+        fr_mn = h2o.Frame.from_arrays(mcols)
+        mn_ntrees = 5
+        m, dt, calls, cdt = _timed(lambda: GBM(
+            ntrees=mn_ntrees, max_depth=5, learn_rate=0.2, seed=1).train(
+            y="y", training_frame=fr_mn), on_tpu)
+        record("gbm_multinomial", mn_rows * mn_ntrees * m.nclasses / dt,
+               "rows*classtrees/s", dt, calls, cdt, rows_mn=mn_rows,
+               classes=m.nclasses,
+               logloss=round(float(
+                   m.scoring_history[-1].get("train_logloss",
+                                             float("nan"))), 5))
 
-    # config #3b: lambdarank (MSLR-WEB30K shape — graded relevance over
-    # query groups, rank:ndcg LambdaMART)
-    rk_rows = min(fr.nrows, 200_000)
-    fr_rk = D.mslr_frame(rk_rows, seed=4, n_features=20)
-    m, dt, calls, cdt = _timed(lambda: XGBoost(
-        ntrees=10, max_depth=6, objective="rank:ndcg", seed=1).train(
-        y="rel", training_frame=fr_rk, group_column="qid"), on_tpu)
-    ndcg = m.model_performance(fr_rk, y="rel")
-    record("xgboost_lambdarank", rk_rows * 10 / dt, "rows*trees/s", dt,
-           calls, cdt, rows_rank=rk_rows,
-           ndcg10=round(float(ndcg.get("ndcg@10", float("nan"))), 5))
+    if _want("xgboost_lambdarank"):
+        # config #3b: lambdarank (MSLR-WEB30K shape — graded relevance
+        # over query groups, rank:ndcg LambdaMART)
+        rk_rows = min(rows, 200_000)
+        fr_rk = D.mslr_frame(rk_rows, seed=4, n_features=20)
+        m, dt, calls, cdt = _timed(lambda: XGBoost(
+            ntrees=10, max_depth=6, objective="rank:ndcg", seed=1).train(
+            y="rel", training_frame=fr_rk, group_column="qid"), on_tpu)
+        ndcg = m.model_performance(fr_rk, y="rel")
+        record("xgboost_lambdarank", rk_rows * 10 / dt, "rows*trees/s",
+               dt, calls, cdt, rows_rank=rk_rows,
+               ndcg10=round(float(ndcg.get("ndcg@10", float("nan"))), 5))
 
-    # config #4: DeepLearning MLP, one pass (model-averaging allreduce)
-    dl_rows = min(fr.nrows, 200_000)
-    fr_dl = D.higgs_frame(dl_rows, seed=2)
-    m, dt, calls, cdt = _timed(lambda: DeepLearning(
-        hidden=[64, 64], epochs=1, seed=1).train(
-        y="y", training_frame=fr_dl), on_tpu)
-    record("deeplearning_mlp", dl_rows / dt, "rows/s", dt, calls, cdt,
-           rows_dl=dl_rows, hidden=[64, 64])
+    if _want("deeplearning_mlp"):
+        # config #4: DeepLearning MLP, one pass (model-averaging
+        # allreduce)
+        dl_rows = min(rows, 200_000)
+        fr_dl = _higgs(dl_rows, seed=2)
+        m, dt, calls, cdt = _timed(lambda: DeepLearning(
+            hidden=[64, 64], epochs=1, seed=1).train(
+            y="y", training_frame=fr_dl), on_tpu)
+        record("deeplearning_mlp", dl_rows / dt, "rows/s", dt, calls,
+               cdt, rows_dl=dl_rows, hidden=[64, 64])
 
-    # config #4b: Word2Vec skip-gram over a Zipf NA-delimited corpus
-    n_tok = 200_000
-    toks = D.text8_like_tokens(n_tok, vocab_size=5_000, seed=5)
-    fr_w2v = h2o.Frame.from_arrays({"words": np.array(toks)})
-    m, dt, calls, cdt = _timed(lambda: Word2Vec(
-        vec_size=32, epochs=1, min_word_freq=2, seed=1).train(fr_w2v),
-        on_tpu)
-    record("word2vec_skipgram", n_tok / dt, "tokens/s", dt, calls, cdt,
-           tokens=n_tok, vec_size=32)
+    if _want("word2vec_skipgram"):
+        # config #4b: Word2Vec skip-gram over a Zipf NA-delimited corpus
+        n_tok = 200_000
+        toks = D.text8_like_tokens(n_tok, vocab_size=5_000, seed=5)
+        fr_w2v = h2o.Frame.from_arrays({"words": np.array(toks)})
+        m, dt, calls, cdt = _timed(lambda: Word2Vec(
+            vec_size=32, epochs=1, min_word_freq=2, seed=1).train(
+            fr_w2v), on_tpu)
+        record("word2vec_skipgram", n_tok / dt, "tokens/s", dt, calls,
+               cdt, tokens=n_tok, vec_size=32)
+
+    if _want("gbm_score_rows_per_sec"):
+        # config #5: the compiled serving fast path (ISSUE 2 tentpole)
+        # on a HIGGS-shaped table: warm score_numpy at the full batch
+        # AND the "100k×1" per-call shape, against the pre-flattening
+        # per-call predict() baseline, with the warm-repeat recompile
+        # check. THE harness lives in bench.py::measure_scoring (one
+        # protocol for bench.py score mode and this config — no drift).
+        from bench import measure_scoring
+
+        sc_rows = int(os.environ.get("BENCH_SCORE_ROWS", 100_000))
+        fr_sc = _higgs(sc_rows, seed=6)
+        m_sc = GBM(ntrees=20, max_depth=5, learn_rate=0.2, seed=1).train(
+            y="y", training_frame=fr_sc)
+        X_sc = np.asarray(m_sc._design_matrix(fr_sc))[:sc_rows]
+        fr_1 = h2o.Frame.from_arrays(
+            {n_: fr_sc.vec(n_).to_numpy()[:1]
+             for n_ in fr_sc.names if n_ != "y"})
+        out = measure_scoring(m_sc, fr_sc, fr_1, X_sc, sc_rows,
+                              reps_full=1 if on_tpu else 3)
+        record("gbm_score_rows_per_sec", out.pop("value"),
+               out.pop("unit"), out.pop("seconds"), out.pop("calls"),
+               out.pop("compile_seconds"),
+               rows_score=out.pop("rows"), ntrees=20, max_depth=5,
+               **out)
 
     out = {"suite": results, "captured_at":
            time.strftime("%Y-%m-%dT%H:%M:%S")}
+    suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r05.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r06{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

@@ -24,6 +24,9 @@ Scenarios:
   cloud locks, retraining without a restart fails fast, restart works.
 - ``resume``        device error mid-AutoML with a checkpoint_dir: the
   rerun resumes finished steps instead of retraining them.
+- ``score-under-fault``  REST scoring during a probe-hang unhealthy
+  episode: requests must fail FAST with 503 (never queue behind the
+  micro-batcher indefinitely) and recover after ``health.reset()``.
 """
 
 from __future__ import annotations
@@ -235,11 +238,84 @@ def scenario_resume() -> None:
                "resumed run did not finish the plan")
 
 
+def scenario_score_under_fault() -> None:
+    """Scoring during an unhealthy episode: 503 fast, then recovery.
+
+    The serving contract (docs/SERVING.md): a request must NEVER wait
+    out H2O_TPU_SCORE_TIMEOUT behind the micro-batcher while the cloud
+    is locked — the health gate rejects it up front."""
+    import json as _json
+    import socket
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu import rest
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.runtime import faults, health
+
+    health.reset()
+    fr = _frame()
+    m = GBM(ntrees=3, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    rest.MODELS["chaos_scorer"] = m
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = rest.start_server(port)
+    url = f"http://127.0.0.1:{port}/3/Predictions/models/chaos_scorer"
+
+    def score(timeout=30.0):
+        req = urllib.request.Request(
+            url, data=_json.dumps(
+                {"rows": [{"x": 0.3}, {"x": -0.7}]}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return _json.loads(r.read())
+
+    try:
+        out = score()
+        _check(len(out["predict"]) == 2, "healthy scoring broken")
+        with faults.inject("health.probe:hang~0.7"):
+            _check(health.heartbeat(timeout=0.1) is False,
+                   "hung probe reported healthy")
+            _check(not health.healthy(), "hang did not trip unhealthy")
+            t0 = time.monotonic()
+            try:
+                score()
+            except urllib.error.HTTPError as e:
+                dt = time.monotonic() - t0
+                _check(e.code == 503,
+                       f"unhealthy scoring returned {e.code}, want 503")
+                _check(dt < 5.0,
+                       f"503 took {dt:.1f}s — request queued behind "
+                       "the micro-batcher instead of failing fast")
+            else:
+                raise ChaosFailure(
+                    "scoring succeeded on an unhealthy cloud")
+        # drain the hung probe thread, then recover
+        deadline = time.monotonic() + 10
+        while [t for t in threading.enumerate()
+               if t.name == "h2o-tpu-probe" and t.is_alive()] \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        health.reset()
+        out = score()
+        _check(len(out["predict"]) == 2,
+               "scoring did not recover after health.reset()")
+    finally:
+        srv.shutdown()
+        rest.MODELS.pop("chaos_scorer", None)
+        health.reset()
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
     "device-error": scenario_device_error,
     "resume": scenario_resume,
+    "score-under-fault": scenario_score_under_fault,
 }
 
 

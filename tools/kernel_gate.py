@@ -147,6 +147,28 @@ def main() -> int:
     checks.append({"check": "boost_scan_multinomial",
                    "ok": m3.ntrees == 9})
 
+    # 4. flattened serving scorer (models/tree/core.py flat_margin)
+    # must match the binned heap re-descent BITWISE on chip — the
+    # serving fast path and MOJO export both descend these arrays.
+    # NA + categorical + high-cardinality grouped bins in one frame.
+    xna = x.copy()
+    xna[::13] = np.nan
+    gg = np.array([f"L{i}" for i in range(80)])[
+        rng.integers(0, 80, size=n)]
+    yf = np.where(np.nan_to_num(xna) > 0, "p", "n")
+    frf = h2o.Frame.from_arrays({"x": xna, "g": gg, "y": yf})
+    mf = GBM(ntrees=4, max_depth=4, nbins=64, seed=0).train(
+        y="y", training_frame=frf)
+    Xf = mf._design_matrix(frf)
+    flat_ok = bool(np.array_equal(np.asarray(mf._margins(Xf)),
+                                  np.asarray(mf._margins_binned(Xf))))
+    checks.append({"check": "flat_scorer_parity", "ok": flat_ok})
+    X3 = m3._design_matrix(fr3)
+    flat3_ok = bool(np.array_equal(np.asarray(m3._margins(X3)),
+                                   np.asarray(m3._margins_binned(X3))))
+    checks.append({"check": "flat_scorer_parity_multinomial",
+                   "ok": flat3_ok})
+
     ok = all(c["ok"] for c in checks)
     print(json.dumps({"gate": "pass" if ok else "fail",
                       "platform": platform, "checks": checks}))

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Closed-loop REST scoring load generator (docs/SERVING.md).
+
+Hammers POST /3/Predictions/models/{key} (the inline serving route:
+JSON rows in, predictions out, micro-batched server-side) with N
+concurrent closed-loop workers — each worker keeps exactly one request
+in flight, so offered load tracks service capacity, the way a fleet of
+synchronous clients behaves.  Reports rows/s + latency percentiles as
+ONE JSON line, plus the server's micro-batcher stats when the server
+runs in-process.
+
+Usage::
+
+    python tools/score_load.py                      # self-contained:
+        # starts an in-process REST server with a synthetic GBM
+    python tools/score_load.py --url http://host:54321 --model gbm1
+    python tools/score_load.py --concurrency 16 --rows 32 --seconds 10
+
+The gain this measures is recorded by ``bench_suite``'s
+``gbm_score_rows_per_sec`` config; this tool is the REST-level
+closed-loop view of the same fast path (request coalescing included).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _post_json(url: str, payload: dict, timeout: float = 120.0) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _self_server(port: int = 0):
+    """Start an in-process server + synthetic GBM; returns
+    (server, base_url, model_key, feature_columns, row_maker)."""
+    import socket
+
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu import rest
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.runtime import make_mesh, set_global_mesh
+
+    set_global_mesh(make_mesh())
+    rng = np.random.default_rng(0)
+    n = 20_000
+    cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+            for i in range(8)}
+    cols["c1"] = np.array(["a", "b", "c", "d"])[rng.integers(0, 4, n)]
+    cols["y"] = np.where(cols["x0"] - cols["x1"] > 0, "late", "ontime")
+    fr = h2o.Frame.from_arrays(cols)
+    model = GBM(ntrees=20, max_depth=5, learn_rate=0.2, seed=1).train(
+        y="y", training_frame=fr)
+    rest.MODELS["score_load_gbm"] = model
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+    srv = rest.start_server(port)
+    return (srv, f"http://127.0.0.1:{port}", "score_load_gbm",
+            [f"x{i}" for i in range(8)] + ["c1"])
+
+
+def run_load(url: str, model_key: str, columns: list[str],
+             concurrency: int = 8, rows_per_request: int = 32,
+             seconds: float = 10.0, seed: int = 0) -> dict:
+    """Closed-loop drive; returns the result record (also printable)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    route = f"{url}/3/Predictions/models/{model_key}"
+    # pre-generate a pool of request bodies (list-shaped rows) so the
+    # workers spend their loop on HTTP + scoring, not on JSON building
+    bodies = []
+    for _ in range(16):
+        rows = [[(float(rng.normal()) if c != "c1" else
+                  ["a", "b", "c", "d"][int(rng.integers(0, 4))])
+                 for c in columns] for _ in range(rows_per_request)]
+        bodies.append({"rows": rows, "columns": columns})
+    deadline = time.perf_counter() + seconds
+    lock = threading.Lock()
+    latencies: list[float] = []
+    errors: list[str] = []
+
+    def worker(wid: int) -> None:
+        i = wid
+        while time.perf_counter() < deadline:
+            body = bodies[i % len(bodies)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                out = _post_json(route, body)
+                ok = len(out["predict"]) == rows_per_request
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                with lock:
+                    errors.append(repr(e)[:200])
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                if ok:
+                    latencies.append(dt)
+                else:
+                    errors.append("short response")
+
+    # one warm-up request so the timed window measures steady state,
+    # not the first XLA compile
+    _post_json(route, bodies[0])
+    t_start = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    lat = sorted(latencies)
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2) \
+            if lat else None
+
+    return {
+        "metric": "rest_score_rows_per_sec",
+        "value": round(len(lat) * rows_per_request / wall, 1),
+        "unit": "rows/s",
+        "requests": len(lat),
+        "requests_per_s": round(len(lat) / wall, 1),
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "p50_ms": pct(0.50),
+        "p95_ms": pct(0.95),
+        "p99_ms": pct(0.99),
+        "concurrency": concurrency,
+        "rows_per_request": rows_per_request,
+        "seconds": round(wall, 2),
+    }
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", default=None,
+                    help="server base URL; omit to self-host")
+    ap.add_argument("--model", default=None, help="model key to score")
+    ap.add_argument("--columns", default=None,
+                    help="comma list of feature columns (remote mode)")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=32,
+                    help="rows per request")
+    ap.add_argument("--seconds", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    srv = None
+    if args.url is None:
+        srv, url, model_key, columns = _self_server()
+    else:
+        url = args.url.rstrip("/")
+        if not args.model or not args.columns:
+            print("--url mode needs --model and --columns",
+                  file=sys.stderr)
+            return 2
+        model_key, columns = args.model, args.columns.split(",")
+    try:
+        out = run_load(url, model_key, columns,
+                       concurrency=args.concurrency,
+                       rows_per_request=args.rows,
+                       seconds=args.seconds)
+        if srv is not None:
+            from h2o_kubernetes_tpu import rest
+
+            out["batcher"] = dict(rest.BATCHER.stats)
+        print(json.dumps(out))
+        return 0 if out["errors"] == 0 and out["requests"] > 0 else 1
+    finally:
+        if srv is not None:
+            srv.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
