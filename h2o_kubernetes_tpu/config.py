@@ -22,6 +22,13 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_HIST_BYTES_BUDGET | 2³⁰ | deep-tree level-histogram memory budget (models/gbm validation + grouped-DRF sizing) |
 | H2O_TPU_CV_SHAPE_SHARE_ROWS | tpu≤1M | weights-masked CV row threshold; 0 disables, N forces on any backend (models/cv) |
 | H2O_TPU_ARROW_CSV | 1 | 0 disables the pyarrow CSV fast path (frame/parse) |
+| H2O_TPU_INGEST_CHUNK_BYTES | 16 MiB | pyarrow record-batch size for streamed CSV ingest (frame/parse, docs/SCALING.md) |
+| H2O_TPU_DEVICE_GATHER_MIN | 65536 | row threshold for the on-device Vec.select_rows gather; 0 forces it, below it the host path wins (frame/frame) |
+| H2O_TPU_BIN_BLOCK_COLS | derived | columns binned per block in Frame.binned (≤256 MB f32 transient; models/tree/binning) |
+| H2O_TPU_OOC | auto | out-of-core tree training: 1 force, 0 never, auto = binned matrix past the budget headroom (models/gbm, docs/SCALING.md) |
+| H2O_TPU_OOC_CHUNK_ROWS | derived | rows per host-pinned binned chunk in out-of-core mode (models/tree/ooc) |
+| H2O_TPU_OOC_RESIDENT | 0 | debug: keep out-of-core chunks device-resident (the bitwise streamed-vs-resident parity harness) |
+| H2O_TPU_SCORER_CACHE_MAX | 64 | LRU cap on models with live jitted-scorer caches; evictions counted in scorer_cache_stats() (models/base) |
 | H2O_TPU_PROBE_BUDGET | 600 | backend-probe stubbornness seconds (runtime/backend) |
 | H2O_TPU_SCORE_BATCH_US | 2000 | REST scoring micro-batcher window, µs; 0 = dispatch immediately (rest.py, docs/SERVING.md) |
 | H2O_TPU_SCORE_TIMEOUT | 60 | seconds a scoring request may wait for its micro-batched result before 503 (rest.py) |

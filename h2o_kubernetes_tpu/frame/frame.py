@@ -25,6 +25,7 @@ water/fvec/RollupStats.java, SURVEY.md §2b C6) are computed by one MRTask
 
 from __future__ import annotations
 
+import os
 from typing import Any, Iterable, Mapping, Sequence
 
 import jax
@@ -35,6 +36,24 @@ from ..runtime import mesh as meshlib
 from ..runtime.mrtask import doall, shard_rows
 
 NA_ENUM = -1  # NA/pad sentinel for enum codes
+
+
+# jitted row gather for Vec.select_rows: an eager fancy-index on a
+# committed multi-device array is the XLA:CPU rendezvous flake pattern;
+# pad rows resolve to the NA sentinel so they behave like shard_rows pads
+_gather_rows_jit = jax.jit(
+    lambda data, idx, valid, na: jnp.where(valid, data[idx], na))
+
+
+def _device_gather_min() -> int:
+    """Row threshold for the on-device select_rows gather (below it
+    the host path wins — the jitted gather traces once per result
+    shape, and CV fold slices on toy frames would pay a compile each).
+    H2O_TPU_DEVICE_GATHER_MIN overrides (tests force 0)."""
+    try:
+        return int(os.environ.get("H2O_TPU_DEVICE_GATHER_MIN", "65536"))
+    except ValueError:
+        return 65536
 
 
 def _rollup_map(x):
@@ -186,18 +205,65 @@ class Vec:
     # -- row/type ops --------------------------------------------------------
 
     def select_rows(self, idx: np.ndarray) -> "Vec":
-        """New Vec of rows at `idx` (host gather → fresh sharded column).
+        """New Vec of rows at `idx` — gathered ON DEVICE.
 
-        Row selection is a reshard, so it goes through the host; CV and
-        similar row-masked training paths should prefer weight masks,
-        which stay on device (see models/cv.py).
+        The round-5 path round-tripped the whole column through the
+        host per selection (one fetch + re-shard per fold slice for
+        sliced CV). Now the gather is a jitted `jnp.take` inside the
+        source sharding followed by ONE reshard (device-to-device
+        `device_put`); the host only ever holds the index vector.
+        Values pass through bit-exactly (time columns keep their
+        origin, so the stored f32 offsets are untouched). CV and
+        similar row-masked training paths should still prefer weight
+        masks, which skip even the reshard (see models/cv.py).
         """
-        a = np.asarray(self.data)[: self.nrows][idx]
-        if self.kind == "time":
-            return Vec.from_numpy(a.astype(np.float64) + self.origin,
-                                  self.name, kind="time")
-        return Vec.from_numpy(a, self.name, domain=self.domain,
-                              kind=self.kind)
+        idx = np.asarray(idx)
+        if idx.dtype == bool:
+            idx = np.flatnonzero(idx)
+        elif not np.issubdtype(idx.dtype, np.integer):
+            # match numpy fancy-index semantics: float indices are an
+            # error, not a silent truncation
+            raise IndexError(
+                f"select_rows: indices must be integers or booleans, "
+                f"got {idx.dtype}")
+        idx = idx.astype(np.int64)
+        n = len(idx)
+        # normalize negative indices and bounds-check like numpy (the
+        # device gather clamps silently, which would corrupt selections)
+        idx = np.where(idx < 0, idx + self.nrows, idx)
+        if n and (idx.min() < 0 or idx.max() >= self.nrows):
+            raise IndexError(
+                f"select_rows: index out of range for {self.nrows} rows")
+        mesh = meshlib.global_mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh, P(meshlib.ROWS))
+        if n == 0 or n < _device_gather_min() \
+                or not sharding.is_fully_addressable:
+            # small selections and multi-host (DCN) meshes take the
+            # host path: device_put cannot target other processes'
+            # devices, and below the threshold the jitted gather's
+            # trace cost (one per result shape — CV fold sizes vary)
+            # outweighs the host round trip it removes
+            a = np.asarray(self.data)[: self.nrows][idx]
+            if self.kind == "time":
+                return Vec.from_numpy(a.astype(np.float64) + self.origin,
+                                      self.name, kind="time")
+            return Vec.from_numpy(a, self.name, domain=self.domain,
+                                  kind=self.kind)
+        shards = mesh.shape[meshlib.ROWS]
+        m = ((n + shards - 1) // shards) * shards
+        na = NA_ENUM if self.kind == "enum" else np.nan
+        idx_p = np.zeros(m, dtype=np.int32)
+        idx_p[:n] = idx
+        valid = np.zeros(m, dtype=bool)
+        valid[:n] = True
+        out = _gather_rows_jit(self.data, jnp.asarray(idx_p),
+                               jnp.asarray(valid),
+                               jnp.asarray(na, dtype=self.data.dtype))
+        out = jax.device_put(out, sharding)      # the ONE reshard
+        return Vec(out, nrows=n, kind=self.kind, domain=self.domain,
+                   name=self.name, origin=self.origin)
 
     def asfactor(self) -> "Vec":
         """Numeric → enum, domain = sorted distinct values (h2o asfactor)."""
@@ -368,6 +434,8 @@ class Frame:
         ns = {v.nrows for v in self._vecs.values()}
         if len(ns) > 1:
             raise ValueError(f"ragged columns: nrows {ns}")
+        # binned-matrix cache (Frame.binned): {key: uint8 device array}
+        self._binned_cache: dict = {}
 
     # -- construction -------------------------------------------------------
 
@@ -436,6 +504,9 @@ class Frame:
         if self._vecs and vec.nrows != self.nrows:
             raise ValueError("nrows mismatch")
         self._vecs[name] = vec
+        # column set changed: binned stale (setdefault: frames from old
+        # pickles predate the cache attribute)
+        self.__dict__.setdefault("_binned_cache", {}).clear()
 
     def __contains__(self, name: str) -> bool:
         return name in self._vecs
@@ -455,6 +526,43 @@ class Frame:
         """[padded_rows, k] float32 matrix (enums as raw codes, NA→NaN)."""
         cols = [v.as_float() for v in self.columns(names)]
         return jnp.stack(cols, axis=1)
+
+    def binned(self, bin_spec) -> jax.Array:
+        """[padded_rows, F] uint8 bin codes for this frame under
+        ``bin_spec`` (models/tree/binning.BinSpec), cached per frame.
+
+        This is the chunked training data path's device working set:
+        the tree learners train from it directly — the full-width
+        float32 ``to_matrix`` is never materialized (binning happens
+        column-block-wise straight from the Frame columns, see
+        binning.bin_frame). Bitwise-identical to
+        ``apply_bins_jit(self.to_matrix(bin_spec.names), ...)``.
+
+        The cache key includes a content fingerprint of the edge
+        matrix, so a checkpoint's BinSpec (edges fit on ANOTHER frame)
+        never collides with this frame's own fit. Mutating the frame
+        (``__setitem__``) invalidates. At most two entries are kept
+        (e.g. a 256-bin GBM and a 64-bin DRF working set side by side).
+        """
+        import hashlib
+
+        from ..models.tree.binning import bin_frame
+
+        edges = np.asarray(bin_spec.edges_matrix())
+        fp = hashlib.sha1(edges.tobytes()
+                          + np.array(bin_spec.is_enum).tobytes()
+                          ).hexdigest()[:16]
+        key = (tuple(bin_spec.names), bin_spec.n_bins, fp)
+        cache = self.__dict__.setdefault("_binned_cache", {})
+        hit = cache.pop(key, None)
+        if hit is not None:
+            cache[key] = hit          # true LRU: a hit refreshes recency
+            return hit
+        out = bin_frame(self, bin_spec)
+        while len(cache) >= 2:                  # tiny LRU: drop oldest
+            cache.pop(next(iter(cache)))
+        cache[key] = out
+        return out
 
     def valid_mask(self) -> jax.Array:
         """float32 [padded_rows]: 1.0 for logical rows, 0.0 for padding."""

@@ -940,13 +940,18 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
                             it = itertools.chain([first], it)
             for lineno, ln in enumerate(it, start=1):
                 toks = _split_line(ln, setup["sep"])
-                if len(toks) > ncol:
-                    # fail loudly like ParseDataset on column-count breaks
+                if len(toks) != ncol:
+                    # fail loudly like ParseDataset on column-count
+                    # breaks — BOTH directions: a short row is how a
+                    # stream truncated mid-record presents, and
+                    # silently padding it with NAs would ship a
+                    # corrupted frame (tools/chaos.py
+                    # ingest-truncated-csv rehearses exactly this)
                     raise ValueError(
                         f"{fp}:{lineno}: {len(toks)} columns, expected "
                         f"{ncol}")
                 for c in range(ncol):
-                    raw[c].append(toks[c] if c < len(toks) else "")
+                    raw[c].append(toks[c])
 
     vecs: dict[str, Vec] = {}
     for c, (name, typ) in enumerate(zip(names, types)):
@@ -956,18 +961,98 @@ def import_file(path: str | Sequence[str], sep: str | None = None,
     return Frame(vecs)
 
 
+class _EnumAcc:
+    """Streaming categorical interner: per-batch dictionary-encoded
+    chunks remapped through a growing first-seen LUT of STRIPPED
+    tokens; finalize() sorts the domain and remaps once — exactly the
+    strip + lowercase-NA + sorted-domain semantics of the pure-Python
+    `_materialize`, paid per batch dictionary (small) instead of per
+    row."""
+
+    def __init__(self, nas: set[str]):
+        self.nas = nas
+        self.lut: dict[str, int] = {}
+        self.chunks: list[np.ndarray] = []
+
+    def add(self, col) -> None:
+        enc = col.dictionary_encode()
+        codes = np.nan_to_num(
+            enc.indices.to_numpy(zero_copy_only=False).astype(
+                np.float64), nan=-1).astype(np.int64)
+        remap = np.empty(len(enc.dictionary) + 1, dtype=np.int32)
+        remap[-1] = NA_ENUM
+        for old, tok in enumerate(enc.dictionary.to_pylist()):
+            tok = str(tok).strip()
+            if tok.lower() in self.nas:
+                remap[old] = NA_ENUM
+            else:
+                remap[old] = self.lut.setdefault(tok, len(self.lut))
+        self.chunks.append(remap[codes])
+
+    def finalize(self, name: str) -> Vec:
+        codes = np.concatenate(self.chunks) if self.chunks else \
+            np.empty(0, dtype=np.int32)
+        self.chunks = []
+        return _lut_to_vec(codes, self.lut, name)
+
+
+class _TimeAcc:
+    """Streaming time-column parser: per-batch host parse through the
+    shared _parse_time_ms formats into float64 epoch-ms chunks."""
+
+    def __init__(self, nas: set[str]):
+        self.nas = nas
+        self.chunks: list[np.ndarray] = []
+
+    def add(self, col) -> None:
+        vals = col.to_pylist()
+        out = np.empty(len(vals), dtype=np.float64)
+        for i, v in enumerate(vals):
+            tok = "" if v is None else v
+            ms = None if _is_na(tok, self.nas) else _parse_time_ms(tok)
+            out[i] = np.nan if ms is None else ms
+        self.chunks.append(out)
+
+    def finalize(self, name: str) -> Vec:
+        a = np.concatenate(self.chunks) if self.chunks else \
+            np.empty(0, dtype=np.float64)
+        self.chunks = []
+        return Vec.from_numpy(a, name, kind="time")
+
+
+class _NumAcc:
+    def __init__(self):
+        self.chunks: list[np.ndarray] = []
+
+    def add(self, col) -> None:
+        self.chunks.append(np.asarray(
+            col.to_numpy(zero_copy_only=False), dtype=np.float32))
+
+    def finalize(self, name: str) -> Vec:
+        a = np.concatenate(self.chunks) if self.chunks else \
+            np.empty(0, dtype=np.float32)
+        self.chunks = []
+        return Vec.from_numpy(a, name)
+
+
 def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
                       skipped: set[str]) -> Frame:
-    """10M-row-capable CSV fast path: pyarrow's multithreaded C++ CSV
-    reader does tokenizing + numeric conversion, our preview pass keeps
-    type-inference semantics (the reference's analog is the
-    chunk-parallel ParseDataset over NewChunks, water/parser/ [U3] —
-    here the chunk parallelism lives inside arrow's reader).
+    """10M-row-capable CSV fast path, STREAMED: pyarrow's C++ CSV
+    reader tokenizes and converts one record batch at a time
+    (`pacsv.open_csv`), and each batch lands chunk-wise in per-column
+    accumulators — host peak beyond the final typed columns is
+    O(batch), never a whole-file pyarrow Table (the round-5 monolithic
+    `read_csv` held the table + pylists + numpy copies at once). Our
+    preview pass keeps type-inference semantics (the reference's
+    analog is the chunk-parallel ParseDataset over NewChunks,
+    water/parser/ [U3]). Batch bytes: H2O_TPU_INGEST_CHUNK_BYTES
+    (default 16 MiB).
 
     Eligibility is decided by the caller; any arrow-level failure
-    (ragged rows, unparseable numerics, unsupported codec) raises and
-    the caller falls back to the pure-Python path, which defines the
-    parse semantics."""
+    (ragged rows, unparseable numerics, unsupported codec — including
+    a stream TRUNCATED mid-record) raises and the caller falls back to
+    the pure-Python path, which defines the parse semantics and fails
+    a truncated file loudly rather than shipping a short frame."""
     import pyarrow as pa
     import pyarrow.csv as pacsv
 
@@ -977,7 +1062,7 @@ def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
     null_values = sorted({v for t in nas for v in
                           (t, t.upper(), t.capitalize(), t.title())})
     col_types: dict[str, pa.DataType] = {}
-    time_cols = []
+    time_cols = set()
     for name, typ in zip(names, types):
         if typ == "numeric":
             col_types[name] = pa.float32()
@@ -987,9 +1072,24 @@ def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
             # — the 10M-row cost is numeric/enum, which stay in C++)
             col_types[name] = pa.string()
             if typ == "time":
-                time_cols.append(name)
+                time_cols.add(name)
 
-    tables = []
+    keep = [n for n in names if n not in skipped]
+    acc: dict[str, object] = {}
+    for name, typ in zip(names, types):
+        if name in skipped:
+            continue
+        acc[name] = _NumAcc() if typ == "numeric" else \
+            _TimeAcc(nas) if name in time_cols else _EnumAcc(nas)
+
+    try:
+        block = int(os.environ.get("H2O_TPU_INGEST_CHUNK_BYTES",
+                                   16 << 20))
+    except ValueError:
+        # a typo'd knob must not silently demote every ingest to the
+        # ~10x-slower pure-Python fallback (the caller's blanket
+        # except would eat the ValueError as "arrow failed")
+        block = 16 << 20
     for fi, fp in enumerate(setup["files"]):
         # arrow's skip_rows counts PHYSICAL lines while the slow path
         # skips blank lines anywhere — count the leading blank/
@@ -1017,11 +1117,11 @@ def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
         # pa.input_stream decompresses gz/bz2 by extension; xz is
         # rejected by the caller's eligibility check
         with pa.input_stream(fp, compression="detect") as stream:
-            tables.append(pacsv.read_csv(
+            reader = pacsv.open_csv(
                 stream,
                 read_options=pacsv.ReadOptions(
                     column_names=names, skip_rows=skip,
-                    block_size=16 << 20),
+                    block_size=block),
                 parse_options=pacsv.ParseOptions(
                     delimiter=setup["sep"], newlines_in_values=True),
                 convert_options=pacsv.ConvertOptions(
@@ -1030,40 +1130,19 @@ def _import_csv_arrow(setup: dict, names: list[str], types: list[str],
                     quoted_strings_can_be_null=False,
                     # drop skipped columns inside the reader — at 10M
                     # rows their C++ conversion is real money
-                    include_columns=[n for n in names
-                                     if n not in skipped])))
-    table = tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+                    include_columns=keep))
+            with reader:
+                for batch in reader:
+                    for name in keep:
+                        acc[name].add(
+                            batch.column(batch.schema.get_field_index(
+                                name)))
 
     vecs: dict[str, Vec] = {}
-    for name, typ in zip(names, types):
+    for name in names:
         if name in skipped:
             continue
-        col = table.column(name).combine_chunks()
-        if typ == "numeric":
-            a = col.to_numpy(zero_copy_only=False)
-            vecs[name] = Vec.from_numpy(
-                np.asarray(a, dtype=np.float32), name)
-        elif name in time_cols:
-            vals = ["" if v is None else v for v in col.to_pylist()]
-            vecs[name] = _materialize(vals, "time", name, nas)
-        else:
-            enc = col.dictionary_encode()
-            dom_raw = [str(v) for v in enc.dictionary.to_pylist()]
-            codes = np.nan_to_num(
-                enc.indices.to_numpy(zero_copy_only=False).astype(
-                    np.float64), nan=-1).astype(np.int64)
-            # arrow keeps surrounding whitespace and matches NA tokens
-            # exactly; re-apply the slow path's strip + lowercase-NA
-            # semantics on the (small) dictionary, not the rows
-            stripped = [s.strip() for s in dom_raw]
-            keep = sorted({s for s in stripped
-                           if s.lower() not in nas})
-            order = {tok: i for i, tok in enumerate(keep)}
-            remap = np.empty(len(dom_raw) + 1, dtype=np.int32)
-            remap[-1] = NA_ENUM
-            for old, tok in enumerate(stripped):
-                remap[old] = order.get(tok, NA_ENUM)
-            vecs[name] = Vec.from_numpy(remap[codes], name, domain=keep)
+        vecs[name] = acc.pop(name).finalize(name)
     return Frame(vecs)
 
 

@@ -30,10 +30,15 @@ _set_col_jit = jax.jit(
 
 @dataclass
 class TrainData:
-    """Device-ready training inputs resolved from a Frame."""
+    """Device-ready training inputs resolved from a Frame.
+
+    ``X`` is None when resolved with ``materialize_x=False`` — the
+    histogram tree learners bin straight from the Frame columns
+    (Frame.binned) and never touch a full float32 design matrix;
+    gradients come from y/w/offset alone."""
 
     feature_names: list[str]
-    X: jax.Array                 # [padded, F] float32, NA→NaN, sharded
+    X: jax.Array | None          # [padded, F] float32, NA→NaN, sharded
     y: jax.Array                 # [padded] float32 (class id for enums)
     w: jax.Array                 # [padded] float32 weights, 0 on padding
     nrows: int
@@ -71,7 +76,8 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
                ignored: Sequence[str] | None = None,
                weights_column: str | None = None,
                distribution: str = "auto",
-               offset_column: str | None = None) -> TrainData:
+               offset_column: str | None = None,
+               materialize_x: bool = True) -> TrainData:
     from ..runtime.health import require_healthy
 
     require_healthy()   # fail fast before training on a broken cloud
@@ -110,7 +116,7 @@ def resolve_xy(frame: Frame, y: str, x: Sequence[str] | None = None,
         raise ValueError(f"{distribution} needs a categorical response; "
                          f"'{y}' is numeric (use .asfactor()-style enum)")
 
-    X = frame.to_matrix(names)
+    X = frame.to_matrix(names) if materialize_x else None
     y_arr = yv.as_float()
     w = frame.valid_mask()
     if weights_column:
@@ -169,17 +175,43 @@ def resolve_x(frame: Frame, x: Sequence[str] | None = None,
 
 _SCORE_MIN_BATCH = 128          # smallest padded-batch bucket
 
-_SCORER_STATS = {"hits": 0, "misses": 0, "models": 0}
+_SCORER_STATS = {"hits": 0, "misses": 0, "models": 0, "evictions": 0}
 # guards cache-entry/jit creation + stats: an HTTP handler thread and
 # the REST micro-batcher thread can first-score one model concurrently
 _SCORER_LOCK = threading.Lock()
+
+# LRU over models holding a live jitted-scorer cache. Without a cap a
+# long-lived REST server scoring many models/shapes grows the set of
+# per-model jitted callables (and jax's per-callable executable caches)
+# without bound; evicting the least-recently-scored model's cache frees
+# its executables while the model itself stays loaded — the next score
+# just pays one re-trace (a normal `miss`).
+import collections
+import os
+import weakref
+
+_SCORER_LRU: "collections.OrderedDict[int, weakref.ref]" = \
+    collections.OrderedDict()
+
+
+def _scorer_cache_cap() -> int:
+    """H2O_TPU_SCORER_CACHE_MAX (default 64), read per call so a live
+    server can be re-tuned without a restart."""
+    try:
+        cap = int(os.environ.get("H2O_TPU_SCORER_CACHE_MAX", "64"))
+    except ValueError:
+        cap = 64
+    return max(1, cap)
 
 
 def scorer_cache_stats() -> dict[str, int]:
     """Shape-level cache counters: a `miss` is a (model, schema, padded
     batch) triple seen for the first time — i.e. an expected XLA
     trace/compile; warm traffic must add only `hits` (the bench's
-    recompile check asserts exactly that)."""
+    recompile check asserts exactly that). `evictions` counts models
+    whose jitted-scorer cache was dropped by the LRU cap
+    (H2O_TPU_SCORER_CACHE_MAX); `models` counts cache CREATIONS, so an
+    evicted model scoring again increments it again."""
     return dict(_SCORER_STATS)
 
 
@@ -258,6 +290,20 @@ class Model:
                 ent = {"shapes": set()}
                 self._scorer_cache = ent
                 _SCORER_STATS["models"] += 1
+            # LRU bookkeeping + cap: evict the least-recently-scored
+            # model's cache so the jitted-callable population stays
+            # bounded on long-lived servers
+            mid = id(self)
+            _SCORER_LRU[mid] = weakref.ref(self)
+            _SCORER_LRU.move_to_end(mid)
+            cap = _scorer_cache_cap()
+            while len(_SCORER_LRU) > cap:
+                _, ref = _SCORER_LRU.popitem(last=False)
+                victim = ref()
+                if victim is None:
+                    continue      # model already GC'd: just reclaim
+                victim.__dict__.pop("_scorer_cache", None)
+                _SCORER_STATS["evictions"] += 1
             skey = (X.shape[1], X.shape[0], offset is not None)
             if skey in ent["shapes"]:
                 _SCORER_STATS["hits"] += 1

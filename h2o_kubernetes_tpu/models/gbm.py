@@ -417,8 +417,15 @@ class GBM:
         if self.cv_args.fold_column:
             ignored_columns = list(ignored_columns or []) + \
                 [self.cv_args.fold_column]
+        # materialize_x=False: the tree learners never touch a full
+        # [n, F] float32 design matrix — binning happens column-block-
+        # wise straight from the Frame columns (Frame.binned), and
+        # gradients come from the y/weights/offset columns alone. The
+        # uint8 binned matrix is the only full-width training-resident
+        # array (docs/SCALING.md).
         data = resolve_xy(training_frame, y, x, ignored_columns,
-                          weights_column, p.distribution, offset_column)
+                          weights_column, p.distribution, offset_column,
+                          materialize_x=False)
         if offset_column and data.distribution in ("multinomial",
                                                    "laplace"):
             raise ValueError("offset_column is not supported for "
@@ -462,9 +469,6 @@ class GBM:
         else:
             bin_spec = fit_bins(training_frame, data.feature_names,
                                 n_bins=p.nbins)
-        edges = jnp.asarray(bin_spec.edges_matrix())
-        enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
-        binned = apply_bins_jit(data.X, edges, enum_mask, bin_spec.na_bin)
 
         K = data.nclasses if data.nclasses > 2 else 1
         tp = TreeParams(max_depth=p.max_depth, n_bins=p.nbins,
@@ -509,6 +513,17 @@ class GBM:
                 f"histograms (> budget {budget / 2 ** 20:.0f} MiB). "
                 "Lower max_depth or nbins, drop features, or raise "
                 "H2O_TPU_HIST_BYTES_BUDGET if the device has room.")
+
+        # out-of-core mode: when the uint8 binned matrix would not fit
+        # the headroom the histogram budget leaves, keep it host-
+        # resident in chunks and stream per boosting iteration
+        # (models/tree/ooc.py). `binned` is only materialized on device
+        # for the in-HBM path.
+        ooc_chunk = _ooc_chunk_rows(p, data, K, F, hist_bytes, budget,
+                                    ckpt)
+        binned = None
+        if ooc_chunk is None:
+            binned = training_frame.binned(bin_spec)
 
         off = data.offset if data.offset is not None \
             else jnp.zeros_like(data.y)
@@ -598,6 +613,70 @@ class GBM:
             sample_rate=p.sample_rate,
             col_sample_rate_per_tree=p.col_sample_rate_per_tree,
             drf_mode=p._drf_mode)
+        if ooc_chunk is not None:
+            # chunk-streamed boosting: host-pinned binned chunks,
+            # double-buffered device_put per level, chunk-accumulated
+            # histograms (models/tree/ooc.py). Metrics land once at
+            # the end — models with a score_every cadence never reach
+            # this branch (_ooc_chunk_rows gates them in-HBM).
+            from ..runtime.mrtask import shard_rows
+            from .tree.ooc import boost_trees_chunked, make_chunks
+
+            require_healthy()
+            with device_dispatch("gbm out-of-core boost"):
+                cks = make_chunks(training_frame, bin_spec, data.y,
+                                  data.w, margin, ooc_chunk)
+                margin_np, trees = boost_trees_chunked(
+                    cks, key, p.ntrees, tp, bp)
+            margin = shard_rows(margin_np)
+        else:
+            trees, margin, history = self._boost_in_hbm(
+                p, tp, bp, data, binned, margin, key, K, F, ckpt,
+                start_t, history)
+        if isinstance(init, jax.Array):
+            # read the device init back AFTER the boost chunks are
+            # enqueued (async dispatch: this blocks only on the tiny
+            # init computation, not on training)
+            init = jax.device_get(init)
+            init = init if init.ndim else float(init)
+            if not np.all(np.isfinite(np.atleast_1d(init))):
+                # 0/0 on device (every row weight zero / every response
+                # NA) must surface as an error, not a silently-NaN model
+                raise ValueError(
+                    "no rows with positive weight and a non-NA response "
+                    "— cannot fit a prior")
+        model = self.model_cls(data, p, bin_spec, trees,
+                               init_score=init, varimp=None)
+        model.margin_scale = margin_scale
+        model.offset_column = offset_column
+        model._varimp = _stacked_varimp(model.trees, data.feature_names)
+        if p._drf_mode:
+            perf = model.model_performance(training_frame, y)
+            history.append({"ntrees": p.ntrees,
+                            **{f"train_{k}": v for k, v in perf.items()}})
+        elif not (history and history[-1].get("ntrees") == p.ntrees):
+            # (when score_every divides ntrees the loop already scored
+            # the final round — don't duplicate the row)
+            history.append({"ntrees": p.ntrees, **_margin_metrics(
+                data.distribution, margin, data.y, data.w)})
+        if margin_scale != 1.0 and history:
+            # report rmse in ORIGINAL units, not MAD units
+            for hrow in history:
+                if "train_rmse" in hrow:
+                    hrow["train_rmse"] *= margin_scale
+        model.scoring_history = history
+        from .cv import finalize_train
+
+        return finalize_train(
+            self, model, y, training_frame,
+            {"x": x, "ignored_columns": ignored_columns,
+             "weights_column": weights_column,
+             "offset_column": offset_column},
+            validation_frame)
+
+    def _boost_in_hbm(self, p, tp, bp, data, binned, margin, key, K, F,
+                      ckpt, start_t, history):
+        """The fused in-HBM boosting loop (all rows device-resident)."""
         chunks: list[Tree] = [] if ckpt is None else [ckpt.trees]
         # cap ONE compiled dispatch's work: the TPU worker (behind
         # its RPC deadline) kills executions that run for minutes —
@@ -656,47 +735,52 @@ class GBM:
         trees = jax.tree.map(
             lambda *xs: jnp.concatenate(xs), *chunks) \
             if len(chunks) > 1 else chunks[0]
+        return trees, margin, history
 
-        if isinstance(init, jax.Array):
-            # read the device init back AFTER the boost chunks are
-            # enqueued (async dispatch: this blocks only on the tiny
-            # init computation, not on training)
-            init = jax.device_get(init)
-            init = init if init.ndim else float(init)
-            if not np.all(np.isfinite(np.atleast_1d(init))):
-                # 0/0 on device (every row weight zero / every response
-                # NA) must surface as an error, not a silently-NaN model
-                raise ValueError(
-                    "no rows with positive weight and a non-NA response "
-                    "— cannot fit a prior")
-        model = self.model_cls(data, p, bin_spec, trees,
-                               init_score=init, varimp=None)
-        model.margin_scale = margin_scale
-        model.offset_column = offset_column
-        model._varimp = _stacked_varimp(model.trees, data.feature_names)
-        if p._drf_mode:
-            perf = model.model_performance(training_frame, y)
-            history.append({"ntrees": p.ntrees,
-                            **{f"train_{k}": v for k, v in perf.items()}})
-        elif not (history and history[-1].get("ntrees") == p.ntrees):
-            # (when score_every divides ntrees the loop already scored
-            # the final round — don't duplicate the row)
-            history.append({"ntrees": p.ntrees, **_margin_metrics(
-                data.distribution, margin, data.y, data.w)})
-        if margin_scale != 1.0 and history:
-            # report rmse in ORIGINAL units, not MAD units
-            for hrow in history:
-                if "train_rmse" in hrow:
-                    hrow["train_rmse"] *= margin_scale
-        model.scoring_history = history
-        from .cv import finalize_train
 
-        return finalize_train(
-            self, model, y, training_frame,
-            {"x": x, "ignored_columns": ignored_columns,
-             "weights_column": weights_column,
-             "offset_column": offset_column},
-            validation_frame)
+def _ooc_chunk_rows(p: GBMParams, data: TrainData, K: int, F: int,
+                    hist_bytes: int, budget: float,
+                    ckpt) -> int | None:
+    """Rows per host-pinned chunk when out-of-core mode engages, None
+    for the in-HBM path.
+
+    Trigger: H2O_TPU_OOC=1 forces it (where eligible), =0 disables;
+    otherwise it engages when the uint8 binned matrix would exceed the
+    headroom H2O_TPU_HIST_BYTES_BUDGET leaves after the level
+    histograms. Eligibility is pointwise single-output boosting —
+    multinomial, DRF voting, huber (global residual quantile per
+    round), checkpoint continuation, a scoring cadence
+    (score_every: the stream scores once at the end, and a parameter
+    must never be dropped silently), and row/column/per-node feature
+    sampling (sample_rate / col_sample_rate_per_tree < 1, mtries > 0:
+    the streamed key schedule differs from the fused core's, so the
+    MODEL would depend on the chunk-size perf knob or on which path
+    engaged) stay in-HBM
+    (docs/SCALING.md). Multi-host (DCN) meshes stay in-HBM too:
+    the chunk staging `device_put` cannot target other processes'
+    devices (same guard as Vec.select_rows).
+    """
+    env = os.environ.get("H2O_TPU_OOC", "auto")
+    if env == "0":
+        return None
+    if K != 1 or p._drf_mode or ckpt is not None or \
+            data.distribution == "huber" or p.score_every or \
+            p.sample_rate < 1.0 or p.col_sample_rate_per_tree < 1.0 \
+            or p.mtries > 0:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..runtime import mesh as meshlib
+
+    sharding = NamedSharding(meshlib.global_mesh(), P(meshlib.ROWS))
+    if not sharding.is_fully_addressable:
+        return None
+    binned_bytes = data.y.shape[0] * F
+    if env != "1" and binned_bytes <= max(budget - hist_bytes, 0):
+        return None
+    from .tree.ooc import chunk_rows_for
+
+    return chunk_rows_for(data.y.shape[0], F, budget, hist_bytes)
 
 
 def _heap_path(i: int) -> str:

@@ -81,16 +81,34 @@ def _device_quantiles(Xn: jax.Array, n_q: int) -> jax.Array:
     """Per-column quantile edges on device: [n, Fn] → [Fn, n_q].
 
     Device-side (round 3: no host round-trip before the first training
-    dispatch); past _QUANTILE_SAMPLE rows the quantiles are taken over
-    a with-replacement uniform sample under a FIXED key — deterministic
-    edges for a given shape, no full-data sort."""
-    n = Xn.shape[0]
-    if n > _QUANTILE_SAMPLE:          # static shape: trace-time branch
-        idx = jax.random.randint(jax.random.key(0x51BB),
-                                 (_QUANTILE_SAMPLE,), 0, n)
-        Xn = Xn[idx]
+    dispatch). Sampling is the CALLER's job: fit_bins feeds this the
+    `_sampled_feature_matrix` gather (≤ _QUANTILE_SAMPLE rows), the
+    one place the fixed-key sample draw lives."""
     qs = jnp.linspace(0.0, 1.0, n_q + 2)[1:-1]
     return jax.vmap(lambda c: jnp.nanquantile(c, qs))(Xn.T)
+
+
+# per-column sample gather for the sketch path: fit_bins used to stack
+# the FULL [n, Fn] f32 matrix just to sample 64k rows from it inside
+# _device_quantiles — at 10M rows that transient alone is ~1.1 GB and
+# was one of the ~5x-working-set peaks the chunked training path
+# removes. Gathering the sample per column keeps the peak at O(sample).
+_col_sample_jit = jax.jit(lambda c, idx: c[idx])
+
+
+def _sampled_feature_matrix(num_cols: list) -> jax.Array:
+    """Stack numeric columns into the [min(n, S), Fn] matrix
+    _device_quantiles sees — bitwise the same rows the old full-matrix
+    path sampled (same fixed key, same with-replacement index draw
+    over the PADDED length), without ever materializing [n, Fn]. The
+    ONLY sample-draw site — edges for a given shape stay
+    deterministic."""
+    n = num_cols[0].shape[0]
+    if n > _QUANTILE_SAMPLE:
+        idx = jax.random.randint(jax.random.key(0x51BB),
+                                 (_QUANTILE_SAMPLE,), 0, n)
+        num_cols = [_col_sample_jit(c, idx) for c in num_cols]
+    return jnp.stack(num_cols, axis=1)
 
 
 def fit_bins(frame, feature_names: list[str],
@@ -138,7 +156,8 @@ def fit_bins(frame, feature_names: list[str],
     # whole base can stay at the +inf padding
     M = jnp.full((F, n_bins - 2), jnp.inf, dtype=jnp.float32)
     if num_cols:
-        Q = _device_quantiles(jnp.stack(num_cols, axis=1), n_bins - 3)
+        Q = _device_quantiles(_sampled_feature_matrix(num_cols),
+                              n_bins - 3)
         Q = jnp.where(jnp.isnan(Q), jnp.inf, Q.astype(jnp.float32))
         M = M.at[jnp.asarray(num_idx, dtype=jnp.int32),
                  : n_bins - 3].set(Q)
@@ -175,3 +194,90 @@ def apply_bins(X: jax.Array, edges_matrix: jax.Array, enum_mask: jax.Array,
 # retrace the binning program on every model fit (grid search / AutoML
 # build many models per process)
 apply_bins_jit = jax.jit(apply_bins, static_argnums=3)
+
+
+# ---------------------------------------------------------------------------
+# Binning straight from Frame columns (the chunked training data path)
+# ---------------------------------------------------------------------------
+#
+# The round-5 tree train paths materialized the full [n, F] float32
+# design matrix (data.X) only to bin it to uint8 — a transient ~5x the
+# binned working set at 10M rows. `bin_frame` applies the bins
+# column-BLOCK-wise directly from the Frame's device columns, so the
+# largest float32 transient is one block; the uint8 matrix is the only
+# full-width array that survives. Bitwise-identical to
+# `apply_bins_jit(frame.to_matrix(names), ...)`: apply_bins is
+# per-feature independent (vmap over columns), so blocking the column
+# axis cannot change a single bin code.
+
+import os as _os
+
+# f32 bytes one column block may occupy while being binned
+_BIN_BLOCK_BYTES = 256 << 20
+
+
+def _bin_block_cols(padded_rows: int, F: int) -> int:
+    env = _os.environ.get("H2O_TPU_BIN_BLOCK_COLS")
+    if env:
+        return max(1, min(int(env), F))
+    return max(1, min(F, _BIN_BLOCK_BYTES // max(padded_rows * 4, 1)))
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _bin_block_jit(cols: tuple, edges_block, na_bin: int, enum_block):
+    return apply_bins(jnp.stack(cols, axis=1), edges_block, enum_block,
+                      na_bin)
+
+
+_concat_blocks_jit = jax.jit(
+    lambda *blocks: jnp.concatenate(blocks, axis=1))
+
+
+def bin_frame(frame, bin_spec: BinSpec) -> jax.Array:
+    """[padded, F] uint8 bin codes from Frame columns, block-wise.
+
+    All device dispatches are jitted (an eager op over committed
+    multi-device arrays is the XLA:CPU rendezvous flake pattern)."""
+    names = bin_spec.names
+    edges = jnp.asarray(bin_spec.edges_matrix())
+    enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
+    padded = frame.vec(names[0]).padded_len
+    F = len(names)
+    block = _bin_block_cols(padded, F)
+    out = []
+    for lo in range(0, F, block):
+        hi = min(lo + block, F)
+        cols = tuple(frame.vec(n).as_float() for n in names[lo:hi])
+        out.append(_bin_block_jit(cols, edges[lo:hi], bin_spec.na_bin,
+                                  enum_mask[lo:hi]))
+    return out[0] if len(out) == 1 else _concat_blocks_jit(*out)
+
+
+def bin_frame_host_chunks(frame, bin_spec: BinSpec,
+                          chunk_rows: int) -> list[np.ndarray]:
+    """Row-chunked HOST-resident uint8 binned matrix (out-of-core mode).
+
+    Bins one column at a time on device (peak device transient: one f32
+    column + one uint8 column), fetches it, and scatters the bytes into
+    per-chunk [chunk_rows, F] buffers. Rows past the padded length in
+    the final chunk get the NA bin and are dead (w=0) downstream.
+    Chunk c's rows are EXACTLY rows [c*chunk_rows, (c+1)*chunk_rows) of
+    `bin_frame`'s output — the chunk-parity tests rely on it."""
+    names = bin_spec.names
+    edges = jnp.asarray(bin_spec.edges_matrix())
+    enum_mask = np.array(bin_spec.is_enum)
+    padded = frame.vec(names[0]).padded_len
+    F = len(names)
+    n_chunks = -(-padded // chunk_rows)
+    bufs = [np.full((chunk_rows, F), bin_spec.na_bin, dtype=np.uint8)
+            for _ in range(n_chunks)]
+    for j, name in enumerate(names):
+        col = frame.vec(name).as_float()
+        b = np.asarray(_bin_block_jit(
+            (col,), edges[j: j + 1], bin_spec.na_bin,
+            jnp.asarray(enum_mask[j: j + 1])))[:, 0]
+        for c in range(n_chunks):
+            lo = c * chunk_rows
+            hi = min(lo + chunk_rows, padded)
+            bufs[c][: hi - lo, j] = b[lo:hi]
+    return bufs

@@ -36,7 +36,7 @@ from ..frame import Frame
 from ..runtime.health import require_healthy
 from .base import resolve_xy
 from .gbm import GBM, GBMModel, _stacked_varimp
-from .tree.binning import apply_bins, apply_bins_jit, fit_bins
+from .tree.binning import fit_bins
 from .tree.core import TreeParams
 
 _OBJECTIVE_ALIASES = {
@@ -288,8 +288,10 @@ class XGBoost(GBM):
             raise ValueError(
                 "offset_column is not supported for rank:* objectives")
         ignored = list(ignored_columns or []) + [group_column]
+        # no full f32 design matrix: the ranker bins straight from the
+        # Frame columns like the pointwise tree paths (Frame.binned)
         data = resolve_xy(frame, y, x, ignored, weights_column,
-                          distribution="gaussian")
+                          distribution="gaussian", materialize_x=False)
         data.distribution = p.distribution   # rank:* carried through
         # graded relevance stored as an enum: codes ARE the grades —
         # score as a single-output ranker, never the multinomial path
@@ -310,9 +312,7 @@ class XGBoost(GBM):
         layout = _GroupLayout(gfull, padded)
 
         bin_spec = fit_bins(frame, data.feature_names, n_bins=p.nbins)
-        edges = jnp.asarray(bin_spec.edges_matrix())
-        enum_mask = jnp.asarray(np.array(bin_spec.is_enum))
-        binned = apply_bins_jit(data.X, edges, enum_mask, bin_spec.na_bin)
+        binned = frame.binned(bin_spec)
 
         y_dense, maxdcg = _dense_layout_jit(data.y, layout.idx,
                                             layout.mask)

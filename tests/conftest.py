@@ -90,6 +90,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_runtest_logreport(report):
+    """Per-test wall-clock lines (opt-in via H2O_TPU_TEST_TIMINGS):
+    tools/run_tests.py turns these into a "slowest 5 tests" digest when
+    a module TIMES OUT — pytest's own --durations only prints at
+    session end, which a killed module never reaches (the known
+    XLA:CPU rendezvous stalls present exactly like that)."""
+    if report.when == "call" and os.environ.get("H2O_TPU_TEST_TIMINGS"):
+        print(f"[time] {report.duration:.2f}s {report.nodeid}",
+              flush=True)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches():
     """Free compiled executables after every test module.

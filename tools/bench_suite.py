@@ -18,7 +18,21 @@ beyond the headline GBM number (bench.py):
   (flattened-tree scorer + jitted-predict cache, docs/SERVING.md):
   warm ``score_numpy`` rows/s on a 100k-row batch, recorded next to
   the per-call ``predict()`` Frame path it replaces, with a
-  recompile check (warm repeat must add 0 scorer-cache misses).
+  recompile check (warm repeat must add 0 scorer-cache misses);
+- config #6  the 10M-row chunked-data-path proofs (docs/SCALING.md):
+  ``ingest_airlines_csv_10m`` — streamed pyarrow record-batch CSV
+  ingest of a ~1.5 GB airlines-shaped file; ``gbm_higgs_10m`` — GBM
+  training where the uint8 binned matrix is the only full-width
+  training-resident array. Row counts via ``BENCH_ROWS_10M``
+  (default 10M), tree count via ``BENCH_GBM_10M_TREES`` (default 5).
+  Both are single-shot (no warm repeat: one call IS minutes of work).
+
+Every config row carries memory watermarks — ``peak_rss_mb`` (VmHWM:
+process-lifetime peak, so a regression anywhere shows in the BENCH
+trajectory), ``rss_before_mb``/``rss_after_mb`` (per-config
+attribution) and ``device_peak_mb`` (sum of per-device
+``memory_stats()`` peaks where the backend reports them; None on
+CPU builds that don't).
 
 ``BENCH_SUITE_CONFIGS`` (comma list of config names) restricts the run
 to a subset — e.g. ``BENCH_SUITE_CONFIGS=gbm_score_rows_per_sec`` for
@@ -60,6 +74,42 @@ def _timed(fn, on_tpu: bool, min_secs: float = 1.0):
     return out, total / calls, calls, compile_dt
 
 
+def _rss_mb() -> float:
+    """Current VmRSS in MiB (Linux /proc; 0.0 where unavailable)."""
+    try:
+        with open("/proc/self/status") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return round(int(ln.split()[1]) / 1024, 1)
+    except OSError:
+        pass
+    return 0.0
+
+
+def _mem_watermarks() -> dict:
+    """Host + device memory watermarks recorded with EVERY config so
+    memory regressions show in the BENCH trajectory, not just wall
+    clock. peak_rss_mb is ru_maxrss (process-lifetime high-water)."""
+    import resource
+
+    import jax
+
+    peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    dev, have = 0, False
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            dev += ms.get("peak_bytes_in_use",
+                          ms.get("bytes_in_use", 0))
+            have = True
+    return {"peak_rss_mb": round(peak_kb / 1024, 1),
+            "rss_after_mb": _rss_mb(),
+            "device_peak_mb": round(dev / 2 ** 20, 1) if have else None}
+
+
 def main() -> int:
     from h2o_kubernetes_tpu.runtime.backend import ensure_live_backend
 
@@ -93,11 +143,16 @@ def main() -> int:
                                  else D.higgs_frame(nr, seed=seed))
         return _higgs_cache[key]
 
+    rss_mark = [_rss_mb()]
+
     def record(config, value, unit, seconds, calls, compile_s, **extra):
         row = {"config": config, "value": round(value, 1), "unit": unit,
                "seconds": round(seconds, 3), "calls": calls,
                "compile_seconds": round(compile_s, 3), "rows": rows,
-               "platform": platform, **extra}
+               "platform": platform,
+               "rss_before_mb": rss_mark[0], **_mem_watermarks(),
+               **extra}
+        rss_mark[0] = row["rss_after_mb"]
         results.append(row)
         print(json.dumps(row), flush=True)
 
@@ -241,12 +296,65 @@ def main() -> int:
                rows_score=out.pop("rows"), ntrees=20, max_depth=5,
                **out)
 
+    # -- config #6: the 10M-row chunked-path proofs --------------------
+    rows_10m = int(os.environ.get("BENCH_ROWS_10M", 10_000_000))
+
+    if _want("ingest_airlines_csv_10m"):
+        import gc
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            csv_path = os.path.join(td, "air10m.csv")
+            t0 = time.perf_counter()
+            D.airlines_csv(csv_path, rows_10m, chunk=1_000_000)
+            gen_dt = time.perf_counter() - t0
+            mb = os.path.getsize(csv_path) / 1e6
+            t0 = time.perf_counter()
+            fr10 = h2o.import_file(csv_path)
+            dt = time.perf_counter() - t0
+            assert fr10.nrows == rows_10m, fr10.nrows
+            record("ingest_airlines_csv_10m", rows_10m / dt, "rows/s",
+                   dt, 1, 0.0, rows_ingest=rows_10m, mb=round(mb, 1),
+                   mb_per_s=round(mb / dt, 2),
+                   csv_gen_seconds=round(gen_dt, 1),
+                   cells_per_s=round(rows_10m * fr10.ncols / dt, 1))
+            del fr10
+            gc.collect()
+
+    if _want("gbm_higgs_10m"):
+        import gc
+
+        nt10 = int(os.environ.get("BENCH_GBM_10M_TREES", 5))
+        t0 = time.perf_counter()
+        fr10 = D.higgs_frame(rows_10m, seed=8)
+        gen_dt = time.perf_counter() - t0
+        F10 = fr10.ncols - 1
+        padded10 = fr10.vec("f0").padded_len
+        binned_mb = round(padded10 * F10 / 2 ** 20, 1)
+        budget_b = float(os.environ.get("H2O_TPU_HIST_BYTES_BUDGET",
+                                        2 ** 30))
+        t0 = time.perf_counter()
+        m10 = GBM(ntrees=nt10, max_depth=6, seed=1).train(
+            y="y", training_frame=fr10)
+        dt = time.perf_counter() - t0
+        record("gbm_higgs_10m", rows_10m * nt10 / dt, "rows*trees/s",
+               dt, 1, 0.0, rows_gbm=rows_10m, ntrees=nt10, max_depth=6,
+               binned_matrix_mb=binned_mb,
+               hist_budget_mb=round(budget_b / 2 ** 20, 1),
+               ooc=os.environ.get("H2O_TPU_OOC", "auto"),
+               frame_gen_seconds=round(gen_dt, 1),
+               train_auc=round(float(
+                   m10.scoring_history[-1].get("train_auc",
+                                               float("nan"))), 5))
+        del fr10, m10
+        gc.collect()
+
     out = {"suite": results, "captured_at":
            time.strftime("%Y-%m-%dT%H:%M:%S")}
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r06{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r07{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

@@ -27,6 +27,9 @@ Scenarios:
 - ``score-under-fault``  REST scoring during a probe-hang unhealthy
   episode: requests must fail FAST with 503 (never queue behind the
   micro-batcher indefinitely) and recover after ``health.reset()``.
+- ``ingest-truncated-csv``  a CSV stream aborts mid-file: the parse
+  must fail cleanly on BOTH the streamed arrow reader and the
+  pure-Python parser — never ship a short frame.
 """
 
 from __future__ import annotations
@@ -310,12 +313,81 @@ def scenario_score_under_fault() -> None:
         health.reset()
 
 
+def _mid_record_cut(blob: bytes, near: int, sep: bytes = b",") -> int:
+    """Byte offset near ``near`` that truncates ``blob`` two fields
+    into a record: the partial trailing line then has fewer columns
+    than any complete row, so BOTH parsers must reject it. (A cut at a
+    record boundary — or inside the last field — yields a legally
+    parseable shorter/equal row and cannot distinguish 'truncated'
+    from 'complete shorter file'.)"""
+    line_start = blob.rindex(b"\n", 0, near) + 1
+    return blob.index(sep, line_start) + 1
+
+
+def scenario_ingest_truncated_csv() -> None:
+    """A CSV stream aborting mid-file must FAIL the parse cleanly —
+    never ship a short frame (docs/SCALING.md §ingest). Rehearsed on
+    both the streamed pyarrow record-batch reader (forced into many
+    small batches) and the pure-Python parser that defines the parse
+    semantics. The cut lands two fields into a record so the trailing
+    partial line can never parse as a complete row — a cut exactly at
+    a record boundary (or inside the LAST field) is indistinguishable
+    from a complete shorter file and would false-alarm the drill."""
+    import tempfile
+
+    import h2o_kubernetes_tpu as h2o
+    from tools import datasets as D
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "air.csv")
+        D.airlines_csv(path, 20_000, chunk=20_000)
+        fr = h2o.import_file(path)
+        _check(fr.nrows == 20_000, "control parse lost rows")
+        with open(path, "rb") as f:
+            blob = f.read()
+        cut = _mid_record_cut(blob, int(len(blob) * 0.6))
+        with open(path, "r+b") as f:
+            f.truncate(cut)
+        saved = {k: os.environ.get(k) for k in
+                 ("H2O_TPU_ARROW_CSV", "H2O_TPU_INGEST_CHUNK_BYTES")}
+        try:
+            # streamed arrow reader, tiny batches (stream abort lands
+            # mid-iteration, not on the first block)
+            os.environ.pop("H2O_TPU_ARROW_CSV", None)
+            os.environ["H2O_TPU_INGEST_CHUNK_BYTES"] = str(64 << 10)
+            try:
+                h2o.import_file(path)
+                _check(False, "streamed parse shipped a short frame "
+                       "from a truncated CSV")
+            except ChaosFailure:
+                raise
+            except Exception:
+                pass                         # loud failure: correct
+            # pure-Python definition path
+            os.environ["H2O_TPU_ARROW_CSV"] = "0"
+            try:
+                h2o.import_file(path)
+                _check(False, "python parse shipped a short frame "
+                       "from a truncated CSV")
+            except ChaosFailure:
+                raise
+            except ValueError:
+                pass                         # loud failure: correct
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
     "device-error": scenario_device_error,
     "resume": scenario_resume,
     "score-under-fault": scenario_score_under_fault,
+    "ingest-truncated-csv": scenario_ingest_truncated_csv,
 }
 
 

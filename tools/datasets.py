@@ -112,10 +112,36 @@ def airlines_arrays(rows: int, seed: int = 0, na_frac: float = 0.02):
     return cols, domains
 
 
-def airlines_frame(rows: int, seed: int = 0, na_frac: float = 0.02):
+_GEN_CHUNK = 2_000_000
+
+
+def _chunked_arrays(gen, rows: int, chunk: int, **kw):
+    """Generate `rows` via per-chunk calls to `gen(n, seed=...)` and
+    concatenate per column — bounds the generator's transient working
+    set at 10M+ rows (each chunk draws under seed+k, matching
+    airlines_csv's chunking scheme). Below one chunk this is byte-
+    identical to a direct call."""
+    seed = kw.pop("seed", 0)
+    if rows <= chunk:
+        return gen(rows, seed=seed, **kw)
+    parts = []
+    done, ck = 0, 0
+    while done < rows:
+        n = min(chunk, rows - done)
+        parts.append(gen(n, seed=seed + ck, **kw))
+        done += n
+        ck += 1
+    cols = {name: np.concatenate([p[0][name] for p in parts])
+            for name in parts[0][0]}
+    return cols, parts[0][1]
+
+
+def airlines_frame(rows: int, seed: int = 0, na_frac: float = 0.02,
+                   chunk: int = _GEN_CHUNK):
     import h2o_kubernetes_tpu as h2o
 
-    cols, domains = airlines_arrays(rows, seed, na_frac)
+    cols, domains = _chunked_arrays(airlines_arrays, rows, chunk,
+                                    seed=seed, na_frac=na_frac)
     return h2o.Frame.from_arrays(cols, domains=domains)
 
 
@@ -133,10 +159,11 @@ def higgs_arrays(rows: int, seed: int = 0):
     return cols, {"y": ["b", "s"]}
 
 
-def higgs_frame(rows: int, seed: int = 0):
+def higgs_frame(rows: int, seed: int = 0, chunk: int = _GEN_CHUNK):
     import h2o_kubernetes_tpu as h2o
 
-    cols, domains = higgs_arrays(rows, seed)
+    cols, domains = _chunked_arrays(higgs_arrays, rows, chunk,
+                                    seed=seed)
     return h2o.Frame.from_arrays(cols, domains=domains)
 
 

@@ -36,7 +36,7 @@ def main() -> int:
                     help="run only the slow-marked tier")
     ap.add_argument("--all", action="store_true",
                     help="run both tiers (fast then slow)")
-    ap.add_argument("--timeout", type=float, default=1800.0,
+    ap.add_argument("--timeout", type=float, default=1500.0,
                     help="per-module wall cap (a starved rendezvous "
                     "hangs forever; this converts it into a named "
                     "module failure)")
@@ -47,6 +47,11 @@ def main() -> int:
              else ["slow"] if args.slow else ["not slow"])
     results = []
     t0 = time.monotonic()
+    # per-test timing lines ([time] …, tests/conftest.py hook): on a
+    # module TIMEOUT the partial output still carries every COMPLETED
+    # test's duration, so the cap failure names the slow tests instead
+    # of just the module
+    env = dict(os.environ, H2O_TPU_TEST_TIMINGS="1")
     for tier in tiers:
         for mod in modules:
             name = os.path.basename(mod)
@@ -57,7 +62,7 @@ def main() -> int:
             # pytest's grandchildren (test_distributed's DCN workers)
             # would otherwise survive and starve every later module
             # into a cascade of timeouts
-            proc = subprocess.Popen(cmd, cwd=REPO,
+            proc = subprocess.Popen(cmd, cwd=REPO, env=env,
                                     stdout=subprocess.PIPE,
                                     stderr=subprocess.PIPE,
                                     start_new_session=True)
@@ -84,6 +89,20 @@ def main() -> int:
                 status = "TIMEOUT"
                 tail = partial.strip().splitlines()[-1] \
                     if partial.strip() else ""
+                # keep the per-module cap honest: name the slowest 5
+                # COMPLETED tests (and by elimination, the stuck one is
+                # whatever started after the last [time] line)
+                times = []
+                for ln in partial.splitlines():
+                    if ln.startswith("[time] "):
+                        parts = ln.split(maxsplit=2)
+                        try:
+                            times.append((float(parts[1].rstrip("s")),
+                                          parts[2]))
+                        except (IndexError, ValueError):
+                            pass
+                for secs, node in sorted(times, reverse=True)[:5]:
+                    print(f"    [slow] {secs:8.2f}s {node}", flush=True)
             dt = time.monotonic() - start
             results.append({"module": name, "tier": tier,
                             "status": status, "seconds": round(dt, 1),
