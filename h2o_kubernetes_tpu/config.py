@@ -34,6 +34,11 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_SCORE_TIMEOUT | 60 | seconds a scoring request may wait for its micro-batched result before 503 (rest.py) |
 | H2O_TPU_SCORE_MAX_ROWS | 100000 | per-request row cap on the inline scoring route (413 past it — one oversized dispatch must not lock the cloud) |
 | H2O_TPU_JOB_TIMEOUT | 0 (off) | server-side job-poll timeout: RUNNING jobs older than this read FAILED on /3/Jobs (rest.py) |
+| H2O_TPU_SCORE_QUEUE_MAX | 256 | scoring admission-queue bound: requests past it are load-shed with 429 + Retry-After; <=0 unbounded (rest.py, docs/RESILIENCE.md) |
+| H2O_TPU_DRAIN_TIMEOUT | 30 | seconds the SIGTERM drain waits for RUNNING jobs / batcher flush before failing them (runtime/lifecycle.py) |
+| H2O_TPU_BREAKER_FAILURES | 5 | consecutive device-dispatch errors that trip the serving circuit breaker open (runtime/lifecycle.py) |
+| H2O_TPU_BREAKER_COOLDOWN | 30 | seconds the breaker stays open before admitting the half-open probe (runtime/lifecycle.py) |
+| H2O_TPU_RETRY_MAX_ELAPSED_S | 0 (off) | hard cap on a retry loop's total elapsed time, attempts included (runtime/retry.py) |
 | JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection

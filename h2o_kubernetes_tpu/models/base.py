@@ -348,8 +348,16 @@ class Model:
 
         Rows are padded to a power-of-two bucket so warm traffic at
         ANY batch size <= the bucket reuses one compiled executable
-        (zero retrace); output is trimmed back to n rows."""
+        (zero retrace); output is trimmed back to n rows.
+
+        The dispatch runs under the serving circuit breaker
+        (runtime/lifecycle.py): consecutive device-dispatch errors trip
+        it open and every call is then rejected instantly with
+        CircuitOpenError (503 over REST) until the half-open probe
+        succeeds — a persistently failing device gets a cooldown, not
+        the full brunt of serving traffic."""
         from ..runtime.health import device_dispatch, require_healthy
+        from ..runtime.lifecycle import breaker_guard
 
         require_healthy(fault_site=None)   # fail fast on a locked cloud
         X = np.asarray(X, dtype=np.float32)
@@ -379,7 +387,13 @@ class Model:
             offp = np.zeros(b, dtype=np.float32)
             offp[:n] = offset
             offp = jnp.asarray(offp)
-        with device_dispatch("model scoring"):
+        from ..runtime import faults
+
+        with breaker_guard("model scoring"), \
+                device_dispatch("model scoring", locking=False):
+            # the one rehearsable serving fault point: dispatch_error
+            # here feeds the breaker without locking the cloud
+            faults.fire("score.dispatch")
             if self._serving_jit:
                 out = self._cached_score(jnp.asarray(Xp), offp)
             else:

@@ -26,12 +26,24 @@ import threading
 import time
 import traceback
 import urllib.parse
+import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from .runtime import lifecycle
 from .runtime.health import ClusterHealthError
+from .runtime.lifecycle import CircuitOpenError, NodeDrainingError
 from .runtime.retry import _env_float
+
+
+class QueueFullError(RuntimeError):
+    """The scoring admission queue is full — load shed (REST: 429 +
+    Retry-After) instead of queueing into latency collapse."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
 
 FRAMES: dict[str, object] = {}     # key -> Frame (DKV analog)
 MODELS: dict[str, object] = {}     # key -> Model
@@ -97,9 +109,11 @@ class ScoreBatcher:
     def __init__(self):
         self._cond = threading.Condition()
         self._pending: list[_ScoreJob] = []
+        self._inflight: list[_ScoreJob] = []
         self._thread: threading.Thread | None = None
+        self._stopped = False
         self.stats = {"requests": 0, "batches": 0, "batched_rows": 0,
-                      "max_batch_requests": 0}
+                      "max_batch_requests": 0, "shed": 0}
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -108,49 +122,143 @@ class ScoreBatcher:
                 daemon=True)
             self._thread.start()
 
+    @staticmethod
+    def _queue_max() -> int:
+        """H2O_TPU_SCORE_QUEUE_MAX admission bound (requests pending
+        behind the dispatcher); <= 0 reads as unbounded."""
+        v = _env_float("H2O_TPU_SCORE_QUEUE_MAX", 256.0)
+        import sys
+
+        return sys.maxsize if v <= 0 else max(1, int(v))
+
     def submit(self, model, X: np.ndarray, offset=None,
-               timeout: float | None = None) -> np.ndarray:
+               timeout: float | None = None,
+               deadline: float | None = None) -> np.ndarray:
         """Enqueue one scoring request; blocks until its slice of the
-        batched result (or raises: health fail-fast / timeout)."""
+        batched result (or raises: health/breaker/drain fail-fast,
+        queue-full load shed, timeout).
+
+        ``deadline`` is an absolute ``time.monotonic()`` instant (the
+        per-request X-H2O-Deadline-Ms contract): the waiter stops
+        waiting there, and the dispatcher drops the job unscored if it
+        only reaches it afterwards."""
         from .runtime import health
 
+        if self._stopped or not lifecycle.accepting():
+            raise NodeDrainingError(
+                f"node {lifecycle.state()}: draining — new scoring "
+                "requests are not admitted (finish in-flight work, "
+                "then route to a ready replica)")
         if not health.healthy():
             raise ClusterHealthError(
                 "cluster unhealthy: "
                 f"{health.health_status()['error']} — scoring refused "
                 "(fail-fast, not queued)")
+        # an OPEN breaker must reject at the front door — before the
+        # queue, before the batch window. check() never claims the
+        # half-open probe slot; that belongs to the dispatch itself.
+        lifecycle.BREAKER.check()
         if timeout is None:
             timeout = _env_float("H2O_TPU_SCORE_TIMEOUT", 60.0)
         job = _ScoreJob(model, X, offset)
         # the dispatcher drops jobs whose waiter has already timed out
         # (503'd and gone) instead of burning device time on them
         job.deadline = time.monotonic() + timeout
+        if deadline is not None:
+            job.deadline = min(job.deadline, deadline)
+        wait_s = max(0.0, job.deadline - time.monotonic())
         with self._cond:
+            # re-check under the lock: stop() may have completed its
+            # flush between the fast-path gate above and here, and an
+            # append now would respawn the dispatcher on a batcher the
+            # drain already declared flushed (racing os._exit)
+            if self._stopped or not lifecycle.accepting():
+                raise NodeDrainingError(
+                    f"node {lifecycle.state()}: draining — new scoring "
+                    "requests are not admitted (finish in-flight work, "
+                    "then route to a ready replica)")
+            if len(self._pending) >= self._queue_max():
+                # load shedding: a full queue means latency is already
+                # past the batch window × depth — a fast 429 beats a
+                # slow 503 (and the OOM that unbounded queueing risks)
+                self.stats["shed"] += 1
+                raise QueueFullError(
+                    f"scoring admission queue is full "
+                    f"({len(self._pending)} pending, "
+                    f"H2O_TPU_SCORE_QUEUE_MAX={self._queue_max()}); "
+                    "shed — retry with backoff", retry_after=1.0)
             self._ensure_thread()
             self._pending.append(job)
             self.stats["requests"] += 1
             self._cond.notify_all()
-        if not job.event.wait(timeout):
+        if not job.event.wait(wait_s):
+            if deadline is not None and time.monotonic() >= deadline:
+                # the CLIENT's budget ran out while queued: 504, same
+                # status as pre-admission expiry — a 503 would invite
+                # a retry of a request whose budget is already spent
+                raise _DeadlineExpired(
+                    "request deadline expired while queued in the "
+                    "micro-batcher (X-H2O-Deadline-Ms) — dropped "
+                    "unscored")
             raise TimeoutError(
-                f"scoring request timed out after {timeout:.0f}s in "
-                "the micro-batcher (H2O_TPU_SCORE_TIMEOUT)")
+                f"scoring request timed out after {wait_s:.0f}s in "
+                "the micro-batcher (H2O_TPU_SCORE_TIMEOUT / "
+                "X-H2O-Deadline-Ms)")
         if job.err is not None:
             raise job.err
         return job.out
 
+    def stop(self, timeout: float | None = 30.0) -> None:
+        """Drain-path shutdown: refuse new submits, let the dispatcher
+        flush everything already queued (every in-flight waiter gets a
+        terminal response), then stop the dispatcher thread. Jobs still
+        pending past ``timeout`` are failed, never left hanging."""
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        with self._cond:
+            leftovers, self._pending = self._pending, []
+            # a batch the dispatcher already popped but never finished
+            # (wedged dispatch) holds waiters too — fail them, don't
+            # leave them to time out after os._exit
+            stuck = [j for j in self._inflight if not j.event.is_set()]
+        for job in leftovers + stuck:   # dispatcher died/overran: fail loud
+            job.err = NodeDrainingError(
+                "node draining: scoring request could not be flushed "
+                "before the drain deadline")
+            job.event.set()
+
+    def reset(self) -> None:
+        """Back to accepting (tests / in-process cluster restart); the
+        dispatcher thread respawns lazily on the next submit."""
+        with self._cond:
+            self._stopped = False
+
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._pending:
+                while not self._pending and not self._stopped:
                     self._cond.wait()
+                if self._stopped and not self._pending:
+                    return           # drained: exit cleanly
             win = _env_float("H2O_TPU_SCORE_BATCH_US", 2000.0) / 1e6
-            if win > 0:
+            if win > 0 and not self._stopped:
                 # clamp: a negative value must not kill the dispatcher
-                # (sleep raises), a huge one must not wedge it
+                # (sleep raises), a huge one must not wedge it; a
+                # draining batcher skips the collect wait entirely
                 time.sleep(min(win, 1.0))    # collect concurrent arrivals
             with self._cond:
                 batch, self._pending = self._pending, []
+                # tracked so stop() can fail these waiters too if this
+                # dispatch wedges past the drain deadline — a popped
+                # batch is otherwise invisible to the flush
+                self._inflight = batch
             self._dispatch(batch)
+            with self._cond:
+                self._inflight = []
 
     def _dispatch(self, batch: list[_ScoreJob]) -> None:
         now = time.monotonic()
@@ -222,7 +330,7 @@ class ScoreBatcher:
 BATCHER = ScoreBatcher()
 
 
-def _predict_via_batcher(model, frame):
+def _predict_via_batcher(model, frame, deadline=None):
     """Frame prediction through the micro-batcher: design matrix ->
     one (possibly coalesced) scoring dispatch -> prediction Frame.
     Models outside the jitted serving set keep the classic path."""
@@ -241,8 +349,38 @@ def _predict_via_batcher(model, frame):
         off = model._frame_offset(frame)   # the predict_raw contract
         if off is not None:
             off = np.asarray(off)[: frame.nrows]
-    out = BATCHER.submit(model, X, offset=off)
+    out = BATCHER.submit(model, X, offset=off, deadline=deadline)
     return model._prediction_frame(out)
+
+
+class _DeadlineExpired(Exception):
+    """The request's X-H2O-Deadline-Ms budget ran out before dispatch
+    (REST: 504 — the client stopped caring; don't score it)."""
+
+
+def _request_deadline(headers) -> float | None:
+    """Absolute monotonic deadline from X-H2O-Deadline-Ms, or None.
+
+    The header carries the client's REMAINING budget in milliseconds
+    (a relative deadline propagates across machines; an absolute wall
+    time would need synchronized clocks). Unparseable values raise
+    ValueError (400); a budget that is already <= 0 raises
+    _DeadlineExpired (504) so the request is dropped before it wastes
+    a queue slot or a device dispatch."""
+    raw = headers.get("X-H2O-Deadline-Ms")
+    if raw is None:
+        return None
+    try:
+        ms = float(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"bad X-H2O-Deadline-Ms {raw!r} (want milliseconds)") \
+            from None
+    if ms <= 0:
+        raise _DeadlineExpired(
+            f"request deadline already expired (X-H2O-Deadline-Ms="
+            f"{ms:g}) — rejected without a dispatch")
+    return time.monotonic() + ms / 1000.0
 
 
 def _rows_to_matrix(model, rows, columns=None):
@@ -421,7 +559,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ------------------------------------------------------------
 
-    def _json(self, obj, code: int = 200):
+    def _json(self, obj, code: int = 200, headers: dict | None = None):
         # metrics can be NaN (single-class CV folds, zero-weight rmse);
         # json.dumps would emit bare `NaN` — invalid JSON that strict
         # parsers (fetch, jsonlite) reject. Null them out instead.
@@ -429,12 +567,36 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, msg: str):
+    def _error(self, code: int, msg: str,
+               retry_after: float | None = None):
+        hdrs = None
+        if retry_after is not None:
+            # whole seconds, min 1: the header is delta-seconds and a
+            # zero would read as "hammer immediately"
+            hdrs = {"Retry-After": str(max(1, int(retry_after + 0.999)))}
         self._json({"__schema": "H2OErrorV3", "http_status": code,
-                    "msg": msg}, code)
+                    "msg": msg}, code, headers=hdrs)
+
+    def _discard_body(self) -> None:
+        """Read and drop an unread request body before an early error
+        reply: closing the connection with unread bytes still in the
+        receive buffer makes the kernel send RST, which can discard the
+        buffered error response client-side — and the drain contract
+        promises every client a terminal HTTP response, not a reset."""
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            return
+        while n > 0:
+            chunk = self.rfile.read(min(n, 1 << 20))
+            if not chunk:
+                break
+            n -= len(chunk)
 
     def _unhealthy_503(self) -> bool:
         """Send 503 + the health error when the cloud is locked-
@@ -468,6 +630,36 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         try:
             path = urllib.parse.urlparse(self.path).path.rstrip("/")
+            if path == "/healthz":
+                # LIVENESS: true for the whole STARTING→DRAINING span —
+                # the kubelet must not kill a pod that is busy draining.
+                # Only a TERMINATED process (drain done, exit pending —
+                # or wedged) should be restarted. Never touches the
+                # device: the probe must not hang on what it probes.
+                st = lifecycle.status()
+                if st["state"] == lifecycle.TERMINATED:
+                    return self._json({"alive": False, **st}, 503)
+                return self._json({"alive": True, **st})
+            if path == "/readyz":
+                # READINESS = SERVING ∧ breaker-not-open ∧ cloud
+                # healthy: flips the instant a drain begins (or the
+                # breaker trips), while /healthz stays green — the
+                # Service stops routing long before the kubelet kills
+                st = lifecycle.status()
+                ready = (st["state"] == lifecycle.SERVING
+                         and st["breaker"]["state"] != "open"
+                         and st["healthy"])
+                if ready:
+                    return self._json({"ready": True, **st})
+                reasons = []
+                if st["state"] != lifecycle.SERVING:
+                    reasons.append(f"state={st['state']}")
+                if st["breaker"]["state"] == "open":
+                    reasons.append("breaker=open")
+                if not st["healthy"]:
+                    reasons.append("cloud unhealthy")
+                return self._json({"ready": False,
+                                   "reasons": reasons, **st}, 503)
             if path in ("", "/flow", "/flow/index.html"):
                 # the h2o-web Flow analog (SURVEY §2b C19): one
                 # self-contained page, same REST verbs as any client
@@ -613,7 +805,27 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         try:
             path = urllib.parse.urlparse(self.path).path.rstrip("/")
-            params = self._params()
+            # drain admission gate BEFORE parsing the body: a draining
+            # node admits no new work of any kind (in-flight requests
+            # already past this line run to completion and respond).
+            # The unread body is still drained off the socket first so
+            # the 503 arrives as a response, not a connection reset
+            if not lifecycle.accepting():
+                self._discard_body()
+                return self._error(
+                    503, f"node {lifecycle.state()}: draining — not "
+                    "accepting new work; route to a ready replica",
+                    retry_after=lifecycle.remaining_drain_budget())
+            try:
+                params = self._params()
+                # per-request deadline: parsed up front so an expired
+                # budget is rejected before any queue slot or dispatch
+                deadline = _request_deadline(self.headers)
+            except ValueError as e:
+                # bad request envelope only: malformed JSON body or an
+                # unparseable X-H2O-Deadline-Ms — a ValueError from a
+                # route handler below is a server bug and must 500
+                return self._error(400, str(e))
             # every POST verb does device work (parse shards onto the
             # mesh, builds/predictions dispatch collectives): on a dead
             # cloud degrade to 503 up front — reads (GET) stay served
@@ -657,15 +869,28 @@ class _Handler(BaseHTTPRequestHandler):
                     # inline serving route: JSON rows in, predictions
                     # out — no frame registration, scored through the
                     # micro-batcher + jitted-scorer cache
-                    return self._score_rows(MODELS[mkey], mkey, params)
+                    return self._score_rows(MODELS[mkey], mkey, params,
+                                            deadline=deadline)
                 if fpart not in FRAMES:
                     return self._error(404, f"frame '{fpart}' not found")
-                pred = _predict_via_batcher(MODELS[mkey], FRAMES[fpart])
+                pred = _predict_via_batcher(MODELS[mkey], FRAMES[fpart],
+                                            deadline=deadline)
                 key = f"prediction_{mkey}_{fpart}"
                 FRAMES[key] = pred
                 return self._json({"predictions_frame": {"name": key},
                                    **_frame_schema(key, pred)})
             return self._error(404, f"no route for POST {path}")
+        except _DeadlineExpired as e:
+            # the client's budget ran out before we dispatched: 504,
+            # zero device work wasted on an answer nobody is awaiting
+            return self._error(504, str(e))
+        except QueueFullError as e:
+            # load shedding: the admission queue is full — fast 429 +
+            # Retry-After beats queueing into latency collapse
+            return self._error(429, str(e), retry_after=e.retry_after)
+        except CircuitOpenError as e:
+            # breaker open: instant 503, Retry-After = cooldown left
+            return self._error(503, str(e), retry_after=e.retry_after)
         except ClusterHealthError as e:
             # the cloud died between the up-front gate and the dispatch
             return self._error(503, str(e))
@@ -718,7 +943,8 @@ class _Handler(BaseHTTPRequestHandler):
             kw[k] = v
         return kw
 
-    def _score_rows(self, model, mkey: str, params: dict):
+    def _score_rows(self, model, mkey: str, params: dict,
+                    deadline: float | None = None):
         """POST /3/Predictions/models/{key} — serving-shaped scoring:
         JSON rows in, predictions out, one micro-batched dispatch."""
         if not getattr(model, "_serving_jit", False):
@@ -759,7 +985,7 @@ class _Handler(BaseHTTPRequestHandler):
                      for r in rows], dtype=np.float32)
         except (ValueError, TypeError, KeyError, IndexError) as e:
             return self._error(400, f"bad scoring payload: {e!r}")
-        out = BATCHER.submit(model, X, offset=off)
+        out = BATCHER.submit(model, X, offset=off, deadline=deadline)
         resp: dict = {"model_id": {"name": mkey}, "rows": len(rows)}
         if getattr(model, "nclasses", 1) > 1:
             dom = model.response_domain or \
@@ -911,10 +1137,46 @@ class _Handler(BaseHTTPRequestHandler):
                                    "msg": job.msg}})
 
 
+_SERVERS: "weakref.WeakSet[ThreadingHTTPServer]" = weakref.WeakSet()
+
+
+def _shutdown_servers() -> None:
+    """Drain-path hook: stop every live REST server's accept loop AND
+    close its listening socket — a TERMINATED in-process node must
+    refuse connections instantly, not accept ones it will never serve
+    (in-flight handler threads keep their own sockets and finish)."""
+    for srv in list(_SERVERS):
+        try:
+            srv.shutdown()
+            srv.server_close()
+        except Exception:  # noqa: BLE001 — drain must not die on one
+            pass
+        _SERVERS.discard(srv)
+
+
 def start_server(port: int = 54321, host: str = "127.0.0.1",
-                 background: bool = True) -> ThreadingHTTPServer:
-    """Start the REST server (:54321 is the reference's default port)."""
+                 background: bool = True,
+                 install_signals: bool = False) -> ThreadingHTTPServer:
+    """Start the REST server (:54321 is the reference's default port).
+
+    The node goes SERVING (``/readyz`` can pass) and the server's
+    shutdown is registered on the drain path, so SIGTERM → drain stops
+    accepting connections only AFTER the micro-batcher flushed and
+    jobs settled. ``install_signals=True`` (the ``__main__``/pod entry)
+    installs the SIGTERM handler and exits the process when the drain
+    completes — inside ``terminationGracePeriodSeconds``, ahead of the
+    kubelet's SIGKILL."""
     srv = ThreadingHTTPServer((host, port), _Handler)
+    lifecycle.mark_serving()
+    # one module-level hook over the set of live servers (not one hook
+    # per start_server call): register_shutdown is idempotent by
+    # identity, and dead servers fall out of the WeakSet, so a process
+    # that restarts the REST server many times neither leaks server
+    # objects nor replays stale shutdowns at drain time
+    _SERVERS.add(srv)
+    lifecycle.register_shutdown(_shutdown_servers)
+    if install_signals:
+        lifecycle.install_sigterm(exit_on_drain=True)
     if background:
         t = threading.Thread(target=srv.serve_forever,
                              name="h2o-tpu-rest", daemon=True)
@@ -928,4 +1190,4 @@ if __name__ == "__main__":
     import sys
 
     start_server(int(sys.argv[1]) if len(sys.argv) > 1 else 54321,
-                 background=False)
+                 background=False, install_signals=True)

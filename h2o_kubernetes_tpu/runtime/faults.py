@@ -25,6 +25,14 @@ Fault points wired through the runtime:
 - ``mrtask.doall``  — MRTask dispatch. Kind ``device_error`` as above.
 - ``automl.step``   — one AutoML plan step about to train (resumed
   steps don't count). Kind ``device_error`` kills the run mid-plan.
+- ``score.dispatch`` — the serving dispatch inside Model.score_numpy
+  (every REST scoring request rides it). Kind ``dispatch_error``
+  raises InjectedDeviceError WITHOUT locking the cloud — the circuit
+  breaker's food: a per-dispatch device failure, not a dead mesh.
+  ``device_error``/``hang`` also work here.
+- ``lifecycle.drain`` — drain entry (SIGTERM path). Kinds ``hang``
+  (a slow drain step) and ``error`` (a failing one); the drain must
+  complete either way.
 
 Spec grammar (documented in docs/RESILIENCE.md)::
 
@@ -241,6 +249,13 @@ def _trigger(fault: Fault, site: str, ctx: dict) -> None:
                "(fault harness, kind=device_error)")
         health.mark_unhealthy(msg)
         raise InjectedDeviceError(msg)
+    if kind == "dispatch_error":
+        # a device error confined to ONE dispatch: the circuit
+        # breaker's signature. Does NOT lock the cloud — tripping vs.
+        # locking is exactly the distinction the breaker exists for.
+        raise InjectedDeviceError(
+            f"injected dispatch error at {site} "
+            "(fault harness, kind=dispatch_error)")
     if kind == "error":
         raise FaultError(f"injected error at {site}")
     raise ValueError(f"unknown fault kind {kind!r} (site {site})")

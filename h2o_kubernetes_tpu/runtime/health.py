@@ -191,22 +191,40 @@ def _device_error_types() -> tuple[type, ...]:
 
 
 @contextlib.contextmanager
-def device_dispatch(desc: str):
+def device_dispatch(desc: str, locking: bool = True):
     """Guard a device dispatch: a runtime error escaping it (a halted
     chip, a dead ICI link, an injected device_error) marks the cluster
     unhealthy and re-surfaces as ClusterHealthError, so callers see the
-    locked-cloud protocol instead of a raw XLA traceback."""
+    locked-cloud protocol instead of a raw XLA traceback.
+
+    The serving scoring path passes ``locking=False``: a real device
+    error there still surfaces as ClusterHealthError (and feeds the
+    circuit breaker, which gives the device a cooldown and auto-recovers
+    through the half-open probe) but does NOT lock the cloud — one bad
+    scoring dispatch corrupts no training state and must not demand a
+    manual cluster restart. Training dispatches keep ``locking=True``."""
     from . import faults
 
     try:
         yield
     except faults.InjectedDeviceError as e:
-        # the fault handler already flipped health; keep the error type
-        # callers recover from uniform
+        # kind=device_error already flipped health (locked cloud);
+        # kind=dispatch_error deliberately did NOT — that one is a
+        # single failed dispatch feeding the circuit breaker, and its
+        # message must not tell operators to restart a healthy cluster
+        if healthy():
+            raise ClusterHealthError(
+                f"{desc}: {e} — transient dispatch failure "
+                "(circuit breaker territory, cloud not locked)") from e
         raise ClusterHealthError(
             f"{desc}: {e} — restart the cluster and resume from the "
             "last checkpoint") from e
     except _device_error_types() as e:
+        if not locking:
+            raise ClusterHealthError(
+                f"{desc}: device runtime error ({e}) — transient "
+                "dispatch failure (circuit breaker territory, cloud "
+                "not locked)") from e
         mark_unhealthy(f"{desc}: {e}")
         raise ClusterHealthError(
             f"{desc}: device runtime error ({e}) — restart the cluster "
@@ -252,5 +270,12 @@ def start_heartbeat(interval: float = 30.0, timeout: float = 60.0) -> None:
     _thread.start()
 
 
-def stop_heartbeat() -> None:
+def stop_heartbeat(join: bool = False, timeout: float = 5.0) -> None:
+    """Stop the background loop. The drain path passes ``join=True`` so
+    interpreter exit never races a heartbeat mid-probe; the join is
+    bounded (the loop thread is a daemon — a probe wedged in a
+    collective cannot be joined and must not block the drain)."""
     _stop.set()
+    t = _thread
+    if join and t is not None and t.is_alive():
+        t.join(timeout)

@@ -1,0 +1,604 @@
+"""Node lifecycle + circuit breaking — the Kubernetes-grade serving envelope.
+
+The reference project is an operator whose whole job is keeping H2O
+pods alive behind StatefulSet readiness/liveness probes; this module is
+the in-process half of that contract for the TPU node:
+
+- a **lifecycle state machine** — STARTING → SERVING → DRAINING →
+  TERMINATED — with a SIGTERM drain path: stop admitting new work, let
+  the REST micro-batcher flush its in-flight scoring requests, wait for
+  RUNNING jobs up to ``H2O_TPU_DRAIN_TIMEOUT`` seconds (then fail them
+  cleanly), join the heartbeat thread, run registered shutdown hooks
+  (the REST server), and only then terminate. The kubelet's
+  ``terminationGracePeriodSeconds`` should exceed the drain timeout.
+- a **circuit breaker** (closed / open / half-open) over device
+  dispatch: ``H2O_TPU_BREAKER_FAILURES`` *consecutive* device-dispatch
+  errors trip it open; while open every guarded dispatch is rejected
+  instantly with ``CircuitOpenError`` (a ``ClusterHealthError``, so the
+  REST layer 503s) without touching the device; after
+  ``H2O_TPU_BREAKER_COOLDOWN`` seconds the next call is admitted as the
+  half-open probe — success closes the breaker, failure re-opens it
+  with a fresh cooldown.
+
+The breaker complements the health layer rather than replacing it: a
+*locked* cloud (failed heartbeat, device error escaping a training
+step) still needs an explicit ``health.reset()``; the breaker handles
+the other shape of failure — a device that keeps erroring per dispatch
+without the mesh being declared dead — where hammering it with every
+request only digs the hole deeper.
+
+Readiness (rest.py ``/readyz``) is the conjunction: state == SERVING
+∧ breaker not open ∧ cloud healthy. Liveness (``/healthz``) stays true
+through DRAINING so the kubelet does not kill a draining pod early.
+
+Env knobs (read at use time, like the other robustness switches):
+
+- ``H2O_TPU_DRAIN_TIMEOUT``     seconds to wait for RUNNING jobs +
+  batcher flush before failing them (default 30)
+- ``H2O_TPU_BREAKER_FAILURES``  consecutive dispatch errors that trip
+  the breaker (default 5)
+- ``H2O_TPU_BREAKER_COOLDOWN``  seconds open before the half-open
+  probe (default 30)
+
+Rehearsal: the ``lifecycle.drain`` fault point fires at drain entry
+(kinds ``hang``/``error`` — a slow or failing drain step must never
+leave the node undrained), and ``score.dispatch`` (models/base.py)
+feeds the breaker deterministically via kind ``dispatch_error``.
+``tools/chaos.py drain-under-load`` and ``breaker-trip`` drill both
+paths end-to-end; tests/test_lifecycle.py is the tier-1 coverage.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import signal
+import threading
+import time
+from typing import Callable, Iterator
+
+from .health import ClusterHealthError
+from .retry import _env_float
+
+__all__ = [
+    "STARTING", "SERVING", "DRAINING", "TERMINATED",
+    "CircuitBreaker", "CircuitOpenError", "NodeDrainingError", "BREAKER",
+    "breaker_guard", "state", "accepting", "mark_serving", "begin_drain",
+    "drain", "install_sigterm", "remaining_drain_budget", "status",
+    "register_shutdown", "terminated", "wait_terminated", "reset",
+]
+
+STARTING = "STARTING"
+SERVING = "SERVING"
+DRAINING = "DRAINING"
+TERMINATED = "TERMINATED"
+
+
+class CircuitOpenError(ClusterHealthError):
+    """The dispatch circuit breaker is open — the device is being given
+    its cooldown, not another doomed dispatch. Subclasses
+    ClusterHealthError so every existing locked-cloud handler (REST 503
+    mapping, training loops' fail-fast) treats it uniformly."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg)
+        self.retry_after = retry_after
+
+
+class NodeDrainingError(ClusterHealthError):
+    """New work refused because the node is DRAINING/TERMINATED."""
+
+
+class CircuitBreaker:
+    """Closed / open / half-open breaker over device dispatch.
+
+    State transitions (all under one lock):
+
+    - closed → open: ``H2O_TPU_BREAKER_FAILURES`` consecutive failures.
+    - open → half-open: reads half-open once the cooldown elapses; the
+      next admitted call *claims* the single probe slot.
+    - half-open → closed: the probe succeeds (consecutive count reset).
+    - half-open → open: the probe fails; fresh cooldown.
+
+    ``check()`` is the non-claiming admission test (queue front doors);
+    ``allow()`` is the claiming one (the dispatch itself) — only
+    ``allow()`` may take the half-open probe slot, so a front-door
+    check can never burn the probe admission.
+    """
+
+    def __init__(self, name: str = "device-dispatch"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+        self.stats = {"trips": 0, "short_circuited": 0, "probes": 0,
+                      "closes": 0, "failures": 0}
+
+    @staticmethod
+    def _threshold() -> int:
+        return max(1, int(_env_float("H2O_TPU_BREAKER_FAILURES", 5.0)))
+
+    @staticmethod
+    def _cooldown() -> float:
+        return max(0.0, _env_float("H2O_TPU_BREAKER_COOLDOWN", 30.0))
+
+    # -- state ----------------------------------------------------------------
+
+    def _effective_locked(self) -> str:
+        if self._state == "open" and not self._probing and \
+                time.monotonic() - self._opened_at >= self._cooldown():
+            return "half-open"
+        return self._state
+
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_locked()
+
+    def status(self) -> dict:
+        with self._lock:
+            st = self._effective_locked()
+            rem = 0.0
+            if st == "open":
+                rem = max(0.0, self._cooldown()
+                          - (time.monotonic() - self._opened_at))
+            return {"state": st, "consecutive_failures": self._consecutive,
+                    "cooldown_remaining_s": round(rem, 3), **self.stats}
+
+    def reset(self) -> None:
+        """Force-close (tests / explicit operator recovery)."""
+        with self._lock:
+            self._state = "closed"
+            self._consecutive = 0
+            self._probing = False
+
+    def release_probe(self) -> None:
+        """Free a claimed half-open probe slot without recording an
+        outcome — the guarded dispatch died for non-device reasons
+        (caller bug, KeyboardInterrupt), which says nothing about the
+        device. The breaker stays open with its original cooldown (by
+        now elapsed), so the NEXT dispatch becomes the probe; without
+        this release the slot would leak and every later ``allow()``
+        would reject forever on a healthy device."""
+        with self._lock:
+            if self._probing:
+                self._state = "open"
+                self._probing = False
+
+    # -- admission ------------------------------------------------------------
+
+    def _reject_locked(self) -> CircuitOpenError:
+        self.stats["short_circuited"] += 1
+        rem = max(0.0, self._cooldown()
+                  - (time.monotonic() - self._opened_at))
+        return CircuitOpenError(
+            f"{self.name} circuit breaker is open "
+            f"({self._consecutive} consecutive dispatch failures); "
+            f"retry in {max(rem, 0.1):.1f}s",
+            retry_after=max(rem, 0.1))
+
+    def check(self) -> None:
+        """Raise CircuitOpenError while firmly open; never claims the
+        half-open probe slot (safe at queue front doors)."""
+        with self._lock:
+            st = self._effective_locked()
+            if st == "open":
+                raise self._reject_locked()
+
+    def allow(self) -> None:
+        """Admission for one dispatch: passes when closed, claims THE
+        half-open probe when the cooldown has elapsed, raises
+        CircuitOpenError otherwise."""
+        with self._lock:
+            st = self._effective_locked()
+            if st == "closed":
+                return
+            if st == "half-open" and not self._probing:
+                self._state = "half-open"
+                self._probing = True
+                self.stats["probes"] += 1
+                return
+            raise self._reject_locked()
+
+    # -- outcomes -------------------------------------------------------------
+
+    def record_success(self) -> None:
+        closed_now = False
+        with self._lock:
+            if self._state != "closed":
+                closed_now = True
+                self.stats["closes"] += 1
+            self._state = "closed"
+            self._consecutive = 0
+            self._probing = False
+        if closed_now:
+            from ..diagnostics import log, timeline
+
+            timeline.record("breaker_closed", self.name)
+            log.warning("circuit breaker %s: half-open probe succeeded "
+                        "— closed", self.name)
+
+    def record_failure(self, err: str = "") -> None:
+        tripped = False
+        with self._lock:
+            self._consecutive += 1
+            self.stats["failures"] += 1
+            if self._state in ("open", "half-open"):
+                # failed probe (or a straggler dispatch admitted before
+                # the trip): stay/return open with a fresh cooldown
+                self._state = "open"
+                self._opened_at = time.monotonic()
+                self._probing = False
+            elif self._consecutive >= self._threshold():
+                self._state = "open"
+                self._opened_at = time.monotonic()
+                self.stats["trips"] += 1
+                tripped = True
+        if tripped:
+            from ..diagnostics import log, timeline
+
+            timeline.record("breaker_open", err[:200],
+                            consecutive=self._consecutive)
+            log.error("circuit breaker %s: OPEN after %d consecutive "
+                      "dispatch failures (last: %s)", self.name,
+                      self._consecutive, err[:200])
+
+
+BREAKER = CircuitBreaker()
+
+
+@contextlib.contextmanager
+def breaker_guard(desc: str = "device dispatch") -> Iterator[None]:
+    """Run one device dispatch under the breaker: admission check on
+    entry, outcome recording on exit. Only device-shaped failures
+    (ClusterHealthError — what health.device_dispatch converts runtime
+    errors into — and raw XLA/injected device errors) count against the
+    breaker; a caller's bad inputs (ValueError & co.) say nothing about
+    the device and pass through untallied."""
+    from .health import is_device_error
+
+    BREAKER.allow()
+    try:
+        yield
+    except BaseException as e:
+        if isinstance(e, CircuitOpenError):
+            raise                     # our own rejection is not evidence
+        if isinstance(e, ClusterHealthError) or is_device_error(e):
+            BREAKER.record_failure(repr(e))
+        else:
+            # non-device failure: no evidence either way, but a claimed
+            # half-open probe slot must be released or it leaks forever
+            BREAKER.release_probe()
+        raise
+    else:
+        BREAKER.record_success()
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle state machine
+# ---------------------------------------------------------------------------
+
+
+class _Lifecycle:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = STARTING
+        self._drain_deadline: float | None = None
+        self._drain_thread: threading.Thread | None = None
+        self._terminated = threading.Event()
+        self._callbacks: list[Callable[[], None]] = []
+        self._exit_on_drain = False
+        self._exit_code = 0
+        self._installed = False
+        self._prev_sigterm = None
+        # bumped by reset(): a drain thread still in flight from the
+        # previous epoch sees the mismatch and abandons instead of
+        # clobbering the restarted node (forcing TERMINATED over
+        # SERVING, shutting down the new server, os._exit-ing)
+        self._epoch = 0
+
+    # -- queries --------------------------------------------------------------
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def accepting(self) -> bool:
+        """True while new work may be admitted (STARTING covers
+        library-only use that never calls mark_serving)."""
+        with self._lock:
+            return self._state in (STARTING, SERVING)
+
+    def remaining_drain_budget(self) -> float | None:
+        """Seconds left in the drain window: None while not draining,
+        0.0 once TERMINATED. Retry sleeps consult this so a retried
+        persist write can never outlive the drain timeout."""
+        with self._lock:
+            if self._state == TERMINATED:
+                return 0.0
+            if self._state != DRAINING or self._drain_deadline is None:
+                return None
+            return max(0.0, self._drain_deadline - time.monotonic())
+
+    # -- transitions ----------------------------------------------------------
+
+    def mark_serving(self) -> None:
+        with self._lock:
+            if self._state == STARTING:
+                self._state = SERVING
+
+    def register_shutdown(self, cb: Callable[[], None]) -> None:
+        """Hook run at the END of the drain (after batcher flush and
+        job settlement) — e.g. the REST server's shutdown. Idempotent
+        by identity: re-registering the same callable (a module-level
+        hook across server restarts) does not accumulate entries."""
+        with self._lock:
+            if cb not in self._callbacks:
+                self._callbacks.append(cb)
+
+    def begin_drain(self, reason: str = "",
+                    timeout: float | None = None) -> threading.Thread:
+        """SERVING/STARTING → DRAINING; returns the (daemon) drain
+        thread. Idempotent: a second SIGTERM joins the drain already in
+        flight instead of starting another."""
+        if timeout is None:
+            timeout = _env_float("H2O_TPU_DRAIN_TIMEOUT", 30.0)
+        with self._lock:
+            if self._state in (DRAINING, TERMINATED):
+                return self._drain_thread
+            self._state = DRAINING
+            # deadline published HERE, atomically with the state flip:
+            # remaining_drain_budget() must never see DRAINING with no
+            # deadline (the drain-gate Retry-After and the retry
+            # layer's sleep clamp both consult it immediately)
+            self._drain_deadline = time.monotonic() + max(0.0, timeout)
+            t = threading.Thread(target=self._drain,
+                                 args=(reason, timeout, self._epoch,
+                                       self._terminated),
+                                 name="h2o-tpu-drain", daemon=True)
+            self._drain_thread = t
+        from ..diagnostics import log, timeline
+
+        timeline.record("drain_begin", reason)
+        log.warning("lifecycle: DRAINING (%s)", reason or "requested")
+        t.start()
+        return t
+
+    def _stale(self, epoch: int, reason: str) -> bool:
+        """True when reset() started a new epoch while this drain was
+        in flight — the drain must abandon, not touch the new state."""
+        with self._lock:
+            stale = self._epoch != epoch
+        if stale:
+            from ..diagnostics import log
+
+            log.warning("lifecycle: drain (%s) abandoned — reset() "
+                        "started a new epoch mid-drain", reason)
+        return stale
+
+    def _drain(self, reason: str, timeout: float,
+               epoch: int, term_event: threading.Event) -> None:
+        from ..diagnostics import log, timeline
+
+        with self._lock:
+            deadline = self._drain_deadline   # published by begin_drain
+        from . import faults
+
+        try:
+            faults.fire("lifecycle.drain")
+        except Exception as e:  # noqa: BLE001 — an injected drain fault
+            # must be observable, never leave the node undrained
+            log.error("lifecycle.drain fault during drain: %r", e)
+
+        # 1. flush the scoring micro-batcher: in-flight waiters get
+        # their terminal responses; new submits are already refused
+        try:
+            from .. import rest
+
+            rest.BATCHER.stop(
+                timeout=max(0.0, deadline - time.monotonic()))
+        except Exception as e:  # noqa: BLE001
+            log.error("drain: batcher flush failed: %r", e)
+
+        if self._stale(epoch, reason):
+            return
+        # 2. wait for RUNNING jobs, then fail the stragglers cleanly
+        try:
+            from ..automl import JOBS
+
+            while time.monotonic() < deadline:
+                if not any(j.status == "RUNNING" for j in JOBS.values()):
+                    break
+                time.sleep(0.05)
+            for j in list(JOBS.values()):
+                if j.status == "RUNNING":
+                    j.failed(
+                        "node draining: job still RUNNING at the drain "
+                        f"deadline (H2O_TPU_DRAIN_TIMEOUT={timeout:g}s)"
+                        + (f"; reason: {reason}" if reason else ""))
+        except Exception as e:  # noqa: BLE001
+            log.error("drain: job settlement failed: %r", e)
+
+        if self._stale(epoch, reason):
+            return
+        # 3. stop + join the heartbeat thread
+        try:
+            from . import health
+
+            health.stop_heartbeat(join=True, timeout=5.0)
+        except Exception as e:  # noqa: BLE001
+            log.error("drain: heartbeat stop failed: %r", e)
+
+        # 4. shutdown hooks (REST server stops accepting connections)
+        with self._lock:
+            if self._epoch != epoch:
+                cbs = None
+            else:
+                cbs = list(self._callbacks)
+        if cbs is None:
+            self._stale(epoch, reason)     # logs the abandonment
+            return
+        for cb in cbs:
+            try:
+                cb()
+            except Exception as e:  # noqa: BLE001
+                log.error("drain: shutdown hook %r failed: %r", cb, e)
+
+        with self._lock:
+            if self._epoch != epoch:
+                stale = True
+            else:
+                stale = False
+                self._state = TERMINATED
+                exit_on_drain = self._exit_on_drain
+                exit_code = self._exit_code
+        if stale:
+            self._stale(epoch, reason)
+            return
+        timeline.record("drain_complete", reason)
+        log.warning("lifecycle: TERMINATED (drain complete)")
+        # the event captured at begin_drain, NOT self._terminated: a
+        # reset() swapped in a fresh event for the new epoch, and a
+        # stale drain must never set that one
+        term_event.set()
+        if exit_on_drain:
+            # skip atexit/GC: lingering daemon threads (a wedged probe
+            # parked in a collective) must not outlive the grace period
+            os._exit(exit_code)
+
+    # -- signals --------------------------------------------------------------
+
+    def install_sigterm(self, exit_on_drain: bool = True,
+                        exit_code: int = 0) -> bool:
+        """Install the SIGTERM → drain handler (main thread only;
+        returns False when it cannot install). With ``exit_on_drain``
+        the process exits as soon as the drain completes — the
+        kubelet's SIGKILL at the grace-period boundary should never be
+        needed."""
+        if self._installed:
+            # reset() (in-process restart) clears _exit_on_drain but the
+            # handler stays installed — refresh the exit policy so a
+            # re-started server still exits when its drain completes
+            self._exit_on_drain = exit_on_drain
+            self._exit_code = exit_code
+            return True
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        self._exit_on_drain = exit_on_drain
+        self._exit_code = exit_code
+        prev = signal.getsignal(signal.SIGTERM)
+        self._prev_sigterm = prev
+        trigger = threading.Event()
+
+        def waiter():
+            # loops so a reset() (new epoch) + later SIGTERM still
+            # drains; begin_drain is idempotent within an epoch
+            while True:
+                trigger.wait()
+                trigger.clear()
+                self.begin_drain(reason="SIGTERM")
+
+        threading.Thread(target=waiter, name="h2o-tpu-sigterm-drain",
+                         daemon=True).start()
+
+        def handler(signum, frame):
+            # only set a flag here: begin_drain takes the (non-
+            # reentrant) lifecycle lock, and the handler runs on the
+            # main thread — which may BE the current lock holder
+            # (status()/state() mid-call), a guaranteed self-deadlock
+            trigger.set()
+            # chain an embedder's pre-existing handler (SIG_DFL/SIG_IGN
+            # are ints, not callable) — its cleanup must not be lost,
+            # but it also must not be able to kill the drain:
+            # BaseException because sys.exit() (SystemExit) in a chained
+            # handler would otherwise tear down the interpreter mid-drain
+            if callable(prev):
+                try:
+                    prev(signum, frame)
+                except BaseException:  # noqa: BLE001
+                    pass
+
+        signal.signal(signal.SIGTERM, handler)
+        self._installed = True
+        return True
+
+    def reset(self) -> None:
+        """Back to STARTING (tests / in-process cluster restart). Does
+        NOT uninstall a signal handler; clears shutdown hooks. Bumps
+        the epoch so a drain thread still in flight abandons rather
+        than terminating the restarted node."""
+        with self._lock:
+            self._epoch += 1
+            self._state = STARTING
+            self._drain_deadline = None
+            self._drain_thread = None
+            self._callbacks.clear()
+            self._exit_on_drain = False
+            self._terminated = threading.Event()
+        BREAKER.reset()
+
+
+LIFECYCLE = _Lifecycle()
+
+
+# module-level façade (the rest of the runtime imports functions, not
+# the singleton, mirroring health.py's shape)
+
+def state() -> str:
+    return LIFECYCLE.state()
+
+
+def accepting() -> bool:
+    return LIFECYCLE.accepting()
+
+
+def mark_serving() -> None:
+    LIFECYCLE.mark_serving()
+
+
+def begin_drain(reason: str = "",
+                timeout: float | None = None) -> threading.Thread:
+    return LIFECYCLE.begin_drain(reason=reason, timeout=timeout)
+
+
+def drain(reason: str = "", timeout: float | None = None) -> None:
+    """Synchronous drain (chaos drills, tests, explicit shutdown)."""
+    t = LIFECYCLE.begin_drain(reason=reason, timeout=timeout)
+    if t is not None:
+        t.join()
+
+
+def install_sigterm(exit_on_drain: bool = True, exit_code: int = 0) -> bool:
+    return LIFECYCLE.install_sigterm(exit_on_drain=exit_on_drain,
+                                     exit_code=exit_code)
+
+
+def remaining_drain_budget() -> float | None:
+    return LIFECYCLE.remaining_drain_budget()
+
+
+def register_shutdown(cb: Callable[[], None]) -> None:
+    LIFECYCLE.register_shutdown(cb)
+
+
+def terminated() -> bool:
+    return LIFECYCLE._terminated.is_set()
+
+
+def wait_terminated(timeout: float | None = None) -> bool:
+    return LIFECYCLE._terminated.wait(timeout)
+
+
+def reset() -> None:
+    LIFECYCLE.reset()
+
+
+def status() -> dict:
+    """One JSON-able snapshot for /healthz and operators."""
+    from . import health
+
+    return {"state": LIFECYCLE.state(),
+            "healthy": health.healthy(),
+            "breaker": BREAKER.status(),
+            "drain_budget_s": LIFECYCLE.remaining_drain_budget()}

@@ -70,6 +70,7 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
     """
     from . import faults
     from .health import device_dispatch, require_healthy
+    from .lifecycle import breaker_guard
 
     # fail fast on a broken cloud (SURVEY.md §5.3); doall fires its OWN
     # site, so it must not also consume train.step fault counts
@@ -84,7 +85,12 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
         key = (cache_key, map_fn, _freeze(reduce), donate)
         cached = _DOALL_CACHE.get(mesh, {}).get(key)
         if cached is not None:
-            with device_dispatch("doall dispatch"):
+            # breaker outside the device guard: a dispatch error
+            # (converted to ClusterHealthError by the guard) counts one
+            # consecutive failure; an open breaker rejects before any
+            # device work — MRTask traffic respects the cooldown too
+            with breaker_guard("doall dispatch"), \
+                    device_dispatch("doall dispatch"):
                 # block inside the guard: async dispatch would surface
                 # a mid-execution device error at the CALLER's first
                 # read, outside the guard. doall results are small
@@ -115,7 +121,8 @@ def doall(map_fn: Callable[..., Any], *cols: jax.Array,
                   if donate else ())
     if cache_key is not None:
         _DOALL_CACHE.setdefault(mesh, {})[key] = jfn
-    with device_dispatch("doall dispatch"):
+    with breaker_guard("doall dispatch"), \
+            device_dispatch("doall dispatch"):
         # block inside the guard (see the cached branch above)
         return jax.block_until_ready(jfn(*cols))
 

@@ -20,8 +20,17 @@ live process):
 - ``H2O_TPU_RETRY_BASE``       first backoff in seconds, default 0.2
 - ``H2O_TPU_RETRY_MAX_DELAY``  per-sleep cap in seconds, default 10
 - ``H2O_TPU_RETRY_DEADLINE``   total budget in seconds, default 120
+- ``H2O_TPU_RETRY_MAX_ELAPSED_S``  hard cap on total elapsed time
+  (attempts INCLUDED, unlike the deadline's sleep-lookahead), default
+  0 = off — gives a draining node a retry budget its jobs cannot blow
 - ``H2O_TPU_RETRY_DISABLE=1``  single attempt, no sleeps (chaos drills
   use this to prove a fault actually exercises the retry path)
+
+Drain integration (runtime/lifecycle.py): while the node is DRAINING,
+no retry sleep may outlive the drain deadline — a retried persist
+write inside a draining node gives up (raising the last
+TransientError) instead of holding the drain open past
+``H2O_TPU_DRAIN_TIMEOUT``.
 """
 
 from __future__ import annotations
@@ -55,7 +64,8 @@ class RetryPolicy:
     base: float = 0.2           # first backoff; doubles per attempt
     max_delay: float = 10.0     # per-sleep cap
     deadline: float = 120.0     # total wall-clock budget (0 = none)
-    jitter: bool = True
+    max_elapsed: float = 0.0    # hard elapsed-time cap incl. attempts
+    jitter: bool = True         # (0 = off)
 
     def backoff(self, attempt: int, rng=random.random) -> float:
         """Sleep before attempt `attempt+1` (attempt is 1-based)."""
@@ -91,6 +101,7 @@ def policy_from_env(**overrides) -> RetryPolicy:
         base=_env_float("H2O_TPU_RETRY_BASE", 0.2),
         max_delay=_env_float("H2O_TPU_RETRY_MAX_DELAY", 10.0),
         deadline=_env_float("H2O_TPU_RETRY_DEADLINE", 120.0),
+        max_elapsed=_env_float("H2O_TPU_RETRY_MAX_ELAPSED_S", 0.0),
     )
     kw.update(overrides)
     return RetryPolicy(**kw)
@@ -116,10 +127,24 @@ def call(fn: Callable[[], T], policy: RetryPolicy | None = None,
             last = e
             if attempt >= policy.attempts:
                 break
+            elapsed = time.monotonic() - start
+            if policy.max_elapsed and elapsed >= policy.max_elapsed:
+                break    # attempts themselves burned the budget
             delay = e.retry_after if e.retry_after is not None \
                 else policy.backoff(attempt)
-            if policy.deadline and \
-                    time.monotonic() - start + delay > policy.deadline:
+            if policy.deadline and elapsed + delay > policy.deadline:
+                break
+            if policy.max_elapsed and \
+                    elapsed + delay > policy.max_elapsed:
+                break
+            # a DRAINING node's retries must die inside the drain
+            # window: sleeping past the drain deadline would leave the
+            # job RUNNING at the timeout and fail it anyway — give up
+            # now with the real error instead
+            from .lifecycle import remaining_drain_budget
+
+            rem = remaining_drain_budget()
+            if rem is not None and delay >= rem:
                 break
             from ..diagnostics import log, timeline
 

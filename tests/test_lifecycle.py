@@ -1,0 +1,615 @@
+"""Lifecycle, overload control and circuit breaking (ISSUE 4).
+
+The Kubernetes-grade serving envelope: STARTING→SERVING→DRAINING→
+TERMINATED with a drain path that settles jobs and flushes the
+micro-batcher; `/healthz` vs `/readyz` probe semantics; the bounded
+admission queue's 429 load shedding; per-request deadlines rejected
+before any dispatch; and the dispatch circuit breaker's
+trip → open → half-open probe → closed round trip.
+"""
+
+import json
+import socket
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import rest
+from h2o_kubernetes_tpu.automl import JOBS, Job
+from h2o_kubernetes_tpu.runtime import faults, health, lifecycle, retry
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    faults.reset()
+    health.reset()
+    lifecycle.reset()
+    rest.BATCHER.reset()
+    yield
+    faults.reset()
+    health.reset()
+    lifecycle.reset()
+    rest.BATCHER.reset()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def server(mesh8):
+    port = _free_port()
+    srv = rest.start_server(port)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    rest.FRAMES.clear()
+    rest.MODELS.clear()
+
+
+@pytest.fixture
+def gbm_server(server, mesh8):
+    """Server + a small registered GBM for scoring-path tests."""
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=200).astype(np.float32)
+    y = np.where(x > 0, "p", "n")
+    fr = h2o.Frame.from_arrays({"x": x, "y": y})
+    from h2o_kubernetes_tpu.models import GBM
+
+    rest.MODELS["lc_gbm"] = GBM(ntrees=3, max_depth=2, seed=0).train(
+        y="y", training_frame=fr)
+    yield server
+    rest.MODELS.pop("lc_gbm", None)
+
+
+def _get(base, path):
+    """(status, body) without raising on 4xx/5xx."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _score(base, headers=None, n=2):
+    req = urllib.request.Request(
+        base + "/3/Predictions/models/lc_gbm",
+        data=json.dumps({"rows": [{"x": 0.3}] * n}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_trip_halfopen_reset_round_trip(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("H2O_TPU_BREAKER_COOLDOWN", "0.15")
+    b = lifecycle.CircuitBreaker("test")
+    assert b.state() == "closed"
+    b.record_failure("boom 1")
+    assert b.state() == "closed"        # one failure is not a pattern
+    b.record_failure("boom 2")
+    assert b.state() == "open" and b.stats["trips"] == 1
+    with pytest.raises(lifecycle.CircuitOpenError) as e:
+        b.allow()
+    assert e.value.retry_after > 0
+    assert b.stats["short_circuited"] == 1
+    # cooldown elapses -> half-open; ONE probe slot, the rest rejected
+    time.sleep(0.2)
+    assert b.state() == "half-open"
+    b.allow()                           # claims the probe
+    with pytest.raises(lifecycle.CircuitOpenError):
+        b.allow()
+    # failed probe -> back to open with a fresh cooldown
+    b.record_failure("probe failed")
+    assert b.state() == "open"
+    time.sleep(0.2)
+    b.allow()
+    b.record_success()                  # probe succeeds -> closed
+    assert b.state() == "closed"
+    assert b.stats["closes"] == 1
+    # a success resets the consecutive count entirely
+    b.record_failure("x")
+    b.record_success()
+    b.record_failure("y")
+    assert b.state() == "closed"
+
+
+def test_breaker_probe_slot_released_on_non_device_error(monkeypatch):
+    """A non-device exception during the half-open probe must RELEASE
+    the claimed probe slot (not count against the device): without the
+    release the breaker would stay wedged half-open forever, rejecting
+    every dispatch on a healthy device until a manual reset."""
+    monkeypatch.setenv("H2O_TPU_BREAKER_FAILURES", "1")
+    monkeypatch.setenv("H2O_TPU_BREAKER_COOLDOWN", "0.1")
+    b = lifecycle.BREAKER
+    with pytest.raises(health.ClusterHealthError):
+        with lifecycle.breaker_guard("t"):
+            raise health.ClusterHealthError("device gone")
+    assert b.state() == "open"
+    time.sleep(0.15)
+    assert b.state() == "half-open"
+    # the probe dispatch dies on a CALLER bug: slot freed, still open
+    with pytest.raises(TypeError):
+        with lifecycle.breaker_guard("t"):
+            raise TypeError("bad tracer")
+    assert b.state() == "half-open"     # cooldown already elapsed
+    # the NEXT dispatch becomes the probe and can close the breaker
+    with lifecycle.breaker_guard("t"):
+        pass
+    assert b.state() == "closed"
+
+
+def test_real_device_error_in_scoring_feeds_breaker_without_lock(
+        mesh8, monkeypatch):
+    """A REAL (non-injected) device runtime error in score_numpy is
+    breaker food, not a locked cloud: serving auto-recovers through the
+    half-open probe instead of demanding a manual health.reset()."""
+    monkeypatch.setenv("H2O_TPU_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("H2O_TPU_BREAKER_COOLDOWN", "0.15")
+    from jax.errors import JaxRuntimeError
+
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=160).astype(np.float32)
+    fr = h2o.Frame.from_arrays({"x": x, "y": np.where(x > 0, "p", "n")})
+    from h2o_kubernetes_tpu.models import GBM
+
+    m = GBM(ntrees=2, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    X = np.array([[0.5]], np.float32)
+
+    def boom(*a, **k):
+        raise JaxRuntimeError("INTERNAL: halted chip")
+
+    m._cached_score = boom              # instance attr shadows the method
+    for _ in range(2):
+        with pytest.raises(health.ClusterHealthError):
+            m.score_numpy(X)
+    assert health.healthy()             # NOT locked — no manual reset due
+    assert lifecycle.BREAKER.state() == "open"
+    del m.__dict__["_cached_score"]
+    time.sleep(0.2)
+    out = m.score_numpy(X)              # half-open probe closes it
+    assert out.shape[0] == 1
+    assert lifecycle.BREAKER.state() == "closed"
+
+
+def test_breaker_guard_counts_device_shaped_errors_only(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_BREAKER_FAILURES", "1")
+    b_before = lifecycle.BREAKER.status()["consecutive_failures"]
+    # a caller's bad input says nothing about the device
+    with pytest.raises(ValueError):
+        with lifecycle.breaker_guard("t"):
+            raise ValueError("bad payload")
+    assert lifecycle.BREAKER.state() == "closed"
+    assert lifecycle.BREAKER.status()["consecutive_failures"] == b_before
+    # a ClusterHealthError (what device_dispatch converts runtime
+    # errors into) trips at threshold 1
+    with pytest.raises(health.ClusterHealthError):
+        with lifecycle.breaker_guard("t"):
+            raise health.ClusterHealthError("device gone")
+    assert lifecycle.BREAKER.state() == "open"
+
+
+def test_breaker_trips_on_injected_dispatch_errors(mesh8, monkeypatch):
+    """score.dispatch:dispatch_error feeds the breaker WITHOUT locking
+    the cloud, and an open breaker rejects without any device call."""
+    monkeypatch.setenv("H2O_TPU_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("H2O_TPU_BREAKER_COOLDOWN", "0.2")
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=160).astype(np.float32)
+    fr = h2o.Frame.from_arrays(
+        {"x": x, "y": np.where(x > 0, "p", "n")})
+    from h2o_kubernetes_tpu.models import GBM
+
+    m = GBM(ntrees=2, max_depth=2, seed=0).train(
+        y="y", training_frame=fr)
+    X = np.array([[0.5]], np.float32)
+    with faults.inject("score.dispatch:dispatch_error*2"):
+        for _ in range(2):
+            with pytest.raises(health.ClusterHealthError):
+                m.score_numpy(X)
+    assert health.healthy()             # NOT locked — breaker food only
+    assert lifecycle.BREAKER.state() == "open"
+    # open: instant rejection, the armed fault is NOT consumed (finite
+    # count — inf - 1 == inf would make this assertion vacuous)
+    with faults.inject("score.dispatch:dispatch_error*5") as armed:
+        before = armed[0].count
+        with pytest.raises(lifecycle.CircuitOpenError):
+            m.score_numpy(X)
+        assert armed[0].count == before
+    # cooldown over + faults clear: the half-open probe closes it
+    time.sleep(0.25)
+    out = m.score_numpy(X)
+    assert out.shape[0] == 1
+    assert lifecycle.BREAKER.state() == "closed"
+
+
+# -- drain path --------------------------------------------------------------
+
+
+def test_lifecycle_states_and_admission():
+    assert lifecycle.state() == lifecycle.STARTING
+    assert lifecycle.accepting()
+    lifecycle.mark_serving()
+    assert lifecycle.state() == lifecycle.SERVING
+    lifecycle.begin_drain(reason="test", timeout=1.0)
+    assert not lifecycle.accepting()
+    assert lifecycle.wait_terminated(10.0)
+    assert lifecycle.state() == lifecycle.TERMINATED
+    # draining twice is idempotent, not a second drain
+    lifecycle.begin_drain(reason="again")
+    assert lifecycle.state() == lifecycle.TERMINATED
+
+
+def test_drain_waits_for_running_job(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "5")
+    job = Job(dest="drain_ok", description="finishes in time").start()
+
+    def worker():
+        time.sleep(0.3)
+        job.done()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    job._thread = t
+    try:
+        t0 = time.monotonic()
+        lifecycle.drain(reason="test")
+        assert job.status == "DONE"     # drain waited, did not kill it
+        assert time.monotonic() - t0 < 5.0
+        assert lifecycle.state() == lifecycle.TERMINATED
+    finally:
+        JOBS.pop("drain_ok", None)
+
+
+def test_drain_fails_job_exceeding_timeout(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "0.3")
+    job = Job(dest="drain_slow", description="outlives the drain").start()
+
+    def worker():
+        time.sleep(5.0)
+        job.done()                      # too late: FAILED is terminal
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    job._thread = t
+    try:
+        lifecycle.drain(reason="test")
+        assert job.status == "FAILED"
+        assert "drain" in job.msg.lower()
+        assert lifecycle.state() == lifecycle.TERMINATED
+    finally:
+        JOBS.pop("drain_slow", None)
+
+
+def test_stop_fails_waiters_in_wedged_inflight_batch():
+    """A batch the dispatcher already POPPED when the dispatch wedges
+    must be failed by stop() too — those waiters are invisible to the
+    pending-queue flush and would otherwise sit out their full timeout
+    while the drain os._exits around them."""
+    class _Wedge:
+        def score_numpy(self, X, offset=None):
+            time.sleep(3.0)
+            return np.zeros((len(X), 1), np.float32)
+
+    got = {}
+
+    def client():
+        try:
+            rest.BATCHER.submit(_Wedge(), np.zeros((1, 1), np.float32))
+            got["out"] = True
+        except Exception as e:  # noqa: BLE001
+            got["err"] = e
+
+    t = threading.Thread(target=client, daemon=True)
+    t.start()
+    time.sleep(0.3)             # batch popped, dispatch wedged in sleep
+    t0 = time.monotonic()
+    rest.BATCHER.stop(timeout=0.2)
+    t.join(2.0)
+    assert not t.is_alive()
+    assert isinstance(got.get("err"), rest.NodeDrainingError)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_sigterm_handler_safe_with_lifecycle_lock_held(monkeypatch):
+    """The SIGTERM handler must not take the lifecycle lock in signal
+    context: delivery while the main thread holds it (a status() call
+    mid-flight) would self-deadlock and the drain would never start."""
+    import os as _os
+    import signal as _signal
+
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "5")
+    assert lifecycle.install_sigterm(exit_on_drain=False)
+    with lifecycle.LIFECYCLE._lock:     # main thread IS the lock holder
+        _os.kill(_os.getpid(), _signal.SIGTERM)
+        time.sleep(0.1)                 # handler runs here; must return
+    assert lifecycle.wait_terminated(10.0)
+    assert lifecycle.state() == lifecycle.TERMINATED
+
+
+def test_reset_abandons_in_flight_drain(monkeypatch):
+    """reset() mid-drain (the in-process restart flow) bumps the epoch:
+    the stale drain thread must abandon, NOT force TERMINATED over the
+    restarted node's SERVING, set its terminated event, or run the new
+    epoch's shutdown hooks."""
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "10")
+    job = Job(dest="stale_drain", description="holds the drain").start()
+    t = threading.Thread(target=lambda: (time.sleep(0.8), job.done()),
+                         daemon=True)
+    t.start()
+    job._thread = t
+    try:
+        dt = lifecycle.begin_drain(reason="old epoch")
+        # deadline published atomically with the DRAINING flip
+        assert lifecycle.remaining_drain_budget() is not None
+        lifecycle.reset()               # restart while drain in flight
+        lifecycle.mark_serving()
+        dt.join(15.0)
+        assert not dt.is_alive()
+        assert lifecycle.state() == lifecycle.SERVING
+        assert not lifecycle.terminated()
+    finally:
+        JOBS.pop("stale_drain", None)
+
+
+def test_drain_stops_batcher_and_refuses_new_submits(mesh8):
+    model = types.SimpleNamespace(score_numpy=lambda X, offset=None:
+                                  np.zeros(X.shape[0], np.float32))
+    X = np.zeros((2, 1), np.float32)
+    assert rest.BATCHER.submit(model, X).shape == (2,)
+    lifecycle.drain(reason="test", timeout=2.0)
+    with pytest.raises(health.ClusterHealthError, match="drain"):
+        rest.BATCHER.submit(model, X)
+    # restart path: reset revives admission and the dispatcher thread
+    lifecycle.reset()
+    rest.BATCHER.reset()
+    assert rest.BATCHER.submit(model, X).shape == (2,)
+
+
+def test_shutdown_hooks_do_not_accumulate_across_server_restarts():
+    """start_server registers ONE module-level drain hook over the live
+    servers, idempotently — a process that restarts its REST server N
+    times must not replay N stale shutdowns (or leak N server objects
+    pinned by the callback list) at drain time."""
+    calls = []
+    lifecycle.register_shutdown(calls.append)
+    lifecycle.register_shutdown(calls.append)   # same identity: deduped
+    assert lifecycle.LIFECYCLE._callbacks.count(calls.append) <= 1
+    base = len(lifecycle.LIFECYCLE._callbacks)
+    s1 = rest.start_server(_free_port())
+    s1.shutdown()
+    s1.server_close()
+    s2 = rest.start_server(_free_port())
+    try:
+        assert len(lifecycle.LIFECYCLE._callbacks) == base + 1
+    finally:
+        s2.shutdown()
+        s2.server_close()
+
+
+def test_drain_joins_heartbeat_thread():
+    health.start_heartbeat(interval=0.05, timeout=5.0)
+    t = health._thread
+    assert t is not None and t.is_alive()
+    lifecycle.drain(reason="test", timeout=2.0)
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+
+
+def test_drain_fault_point_does_not_block_drain():
+    with faults.inject("lifecycle.drain:error"):
+        lifecycle.drain(reason="test", timeout=1.0)
+    assert lifecycle.state() == lifecycle.TERMINATED
+
+
+# -- probe endpoints ---------------------------------------------------------
+
+
+def test_probe_endpoints_healthy(server):
+    code, body = _get(server, "/healthz")
+    assert code == 200 and body["alive"] and body["state"] == "SERVING"
+    code, body = _get(server, "/readyz")
+    assert code == 200 and body["ready"]
+    assert body["breaker"]["state"] == "closed"
+
+
+def test_readyz_flips_before_healthz_during_drain(server, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "10")
+    # a RUNNING job holds DRAINING open long enough to probe it
+    job = Job(dest="drain_probe", description="holds the drain").start()
+
+    def worker():
+        time.sleep(1.0)
+        job.done()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    job._thread = t
+    try:
+        assert _get(server, "/readyz")[0] == 200
+        lifecycle.begin_drain(reason="test")
+        deadline = time.monotonic() + 5.0
+        while _get(server, "/readyz")[0] != 503 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        code, body = _get(server, "/readyz")
+        assert code == 503 and "state=DRAINING" in body["reasons"]
+        # liveness must NOT flip: the kubelet would kill the drain
+        code, body = _get(server, "/healthz")
+        assert code == 200 and body["alive"]
+        assert lifecycle.wait_terminated(10.0)
+        assert job.status == "DONE"
+    finally:
+        JOBS.pop("drain_probe", None)
+
+
+def test_readyz_unready_on_unhealthy_cloud(server):
+    health.mark_unhealthy("test outage")
+    code, body = _get(server, "/readyz")
+    assert code == 503 and "cloud unhealthy" in body["reasons"]
+    assert _get(server, "/healthz")[0] == 200   # alive, just not ready
+    health.reset()
+    assert _get(server, "/readyz")[0] == 200
+
+
+def test_post_rejected_while_draining(gbm_server, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "10")
+    job = Job(dest="drain_post", description="holds the drain").start()
+
+    def worker():
+        time.sleep(0.8)
+        job.done()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    job._thread = t
+    try:
+        lifecycle.begin_drain(reason="test")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _score(gbm_server)
+        assert e.value.code == 503
+        assert "draining" in json.loads(e.value.read())["msg"].lower()
+        assert lifecycle.wait_terminated(10.0)
+    finally:
+        JOBS.pop("drain_post", None)
+
+
+# -- overload control --------------------------------------------------------
+
+
+def test_admission_queue_full_sheds_with_429(gbm_server, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_SCORE_QUEUE_MAX", "1")
+    # fill the queue directly (no notify: the dispatcher stays parked,
+    # nothing consumes the fake entry while we probe the front door)
+    fake = rest._ScoreJob(None, np.zeros((1, 1), np.float32), None)
+    with rest.BATCHER._cond:
+        rest.BATCHER._pending.append(fake)
+    try:
+        shed0 = rest.BATCHER.stats["shed"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _score(gbm_server)
+        assert e.value.code == 429
+        assert int(e.value.headers["Retry-After"]) >= 1
+        assert rest.BATCHER.stats["shed"] == shed0 + 1
+    finally:
+        with rest.BATCHER._cond:
+            if fake in rest.BATCHER._pending:
+                rest.BATCHER._pending.remove(fake)
+    # queue freed: same request admits and scores
+    assert _score(gbm_server)["rows"] == 2
+
+
+def test_expired_deadline_rejected_without_dispatch(gbm_server):
+    r0 = rest.BATCHER.stats["requests"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _score(gbm_server, headers={"X-H2O-Deadline-Ms": "0"})
+    assert e.value.code == 504
+    assert rest.BATCHER.stats["requests"] == r0   # never reached the queue
+    # an unparseable deadline is the client's bug: 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _score(gbm_server, headers={"X-H2O-Deadline-Ms": "soon"})
+    assert e.value.code == 400
+    # a live deadline scores normally
+    out = _score(gbm_server, headers={"X-H2O-Deadline-Ms": "60000"})
+    assert out["rows"] == 2
+    assert rest.BATCHER.stats["requests"] == r0 + 1
+
+
+def test_deadline_expiring_in_queue_is_504_shaped():
+    """A budget that runs out WHILE QUEUED answers like the
+    pre-admission rejection (504 via _DeadlineExpired), not a
+    retryable-looking 503 — either side of admission, a spent budget
+    means the same thing."""
+    class _Slow:
+        def score_numpy(self, X, offset=None):
+            time.sleep(0.6)                  # holds the dispatcher busy
+            return np.zeros((len(X), 1), np.float32)
+
+    with pytest.raises(rest._DeadlineExpired):
+        rest.BATCHER.submit(_Slow(), np.zeros((1, 1), np.float32),
+                            deadline=time.monotonic() + 0.15)
+
+
+def test_breaker_open_rejects_over_rest(gbm_server, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("H2O_TPU_BREAKER_COOLDOWN", "0.2")
+    with faults.inject("score.dispatch:dispatch_error*2"):
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _score(gbm_server)
+            assert e.value.code == 503
+    assert _get(gbm_server, "/readyz")[0] == 503
+    t0 = time.monotonic()
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _score(gbm_server)
+    assert e.value.code == 503
+    assert time.monotonic() - t0 < 2.0
+    assert e.value.headers["Retry-After"] is not None
+    time.sleep(0.25)
+    assert _score(gbm_server)["rows"] == 2        # half-open probe
+    assert _get(gbm_server, "/readyz")[0] == 200
+
+
+# -- retry caps --------------------------------------------------------------
+
+
+def test_retry_max_elapsed_cap(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_RETRY_MAX_ELAPSED_S", "0.2")
+    calls = []
+
+    def fn():
+        calls.append(1)
+        time.sleep(0.08)
+        raise retry.TransientError("always down")
+
+    t0 = time.monotonic()
+    with pytest.raises(retry.TransientError):
+        retry.call(fn, retry.policy_from_env(attempts=50, base=0.05))
+    assert time.monotonic() - t0 < 1.5
+    assert 1 < len(calls) < 10          # retried some, capped well short
+
+
+def test_retry_gives_up_inside_drain_window(monkeypatch):
+    """A retried persist write on a DRAINING node must not outlive the
+    drain: a backoff sleep past the drain deadline is skipped and the
+    last transient error surfaces instead."""
+    monkeypatch.setenv("H2O_TPU_DRAIN_TIMEOUT", "10")
+    job = Job(dest="drain_retry", description="holds the drain").start()
+
+    def worker():
+        time.sleep(1.0)
+        job.done()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    job._thread = t
+    try:
+        lifecycle.begin_drain(reason="test")
+        assert lifecycle.remaining_drain_budget() is not None
+
+        def fn():
+            raise retry.TransientError("still down")
+
+        t0 = time.monotonic()
+        with pytest.raises(retry.TransientError):
+            # base=30: the first backoff alone would exceed the 10s
+            # drain budget, so the loop must give up immediately
+            retry.call(fn, retry.RetryPolicy(attempts=5, base=30.0,
+                                             max_delay=30.0))
+        assert time.monotonic() - t0 < 2.0
+        assert lifecycle.wait_terminated(15.0)
+    finally:
+        JOBS.pop("drain_retry", None)

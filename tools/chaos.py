@@ -30,6 +30,15 @@ Scenarios:
 - ``ingest-truncated-csv``  a CSV stream aborts mid-file: the parse
   must fail cleanly on BOTH the streamed arrow reader and the
   pure-Python parser — never ship a short frame.
+- ``breaker-trip``  repeated injected ``score.dispatch`` device errors
+  trip the serving circuit breaker: instant 503s with NO device calls
+  while open, ``/readyz`` unready, and the half-open probe restores
+  SERVING once the faults clear.
+- ``drain-under-load``  SIGTERM hits a pod serving concurrent REST
+  scoring traffic with a build RUNNING: ``/readyz`` flips unready
+  while ``/healthz`` stays live, every in-flight request gets a
+  terminal response (result or 503/429 — zero hung clients), and the
+  process exits cleanly inside ``H2O_TPU_DRAIN_TIMEOUT`` + 5s.
 """
 
 from __future__ import annotations
@@ -381,6 +390,288 @@ def scenario_ingest_truncated_csv() -> None:
                     os.environ[k] = v
 
 
+def scenario_breaker_trip() -> None:
+    """Serving circuit breaker: trip open on consecutive dispatch
+    errors, short-circuit with zero device work while open, recover
+    SERVING through the half-open probe once faults clear."""
+    import json as _json
+    import socket
+    import urllib.error
+    import urllib.request
+
+    import h2o_kubernetes_tpu as h2o  # noqa: F401 — package init
+    from h2o_kubernetes_tpu import rest
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.runtime import faults, health, lifecycle
+
+    saved = {k: os.environ.get(k) for k in
+             ("H2O_TPU_BREAKER_FAILURES", "H2O_TPU_BREAKER_COOLDOWN")}
+    os.environ["H2O_TPU_BREAKER_FAILURES"] = "3"
+    # LONG cooldown for the open-phase assertions: the knob is read at
+    # use time, so a loaded box can't race the breaker into half-open
+    # between the trip and the checks below; the recovery phase lowers
+    # it just before waiting for the half-open probe
+    os.environ["H2O_TPU_BREAKER_COOLDOWN"] = "30"
+    health.reset()
+    lifecycle.BREAKER.reset()
+    fr = _frame()
+    m = GBM(ntrees=3, max_depth=2, seed=0).train(y="y", training_frame=fr)
+    rest.MODELS["breaker_gbm"] = m
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = rest.start_server(port)
+    base = f"http://127.0.0.1:{port}"
+
+    def score():
+        req = urllib.request.Request(
+            base + "/3/Predictions/models/breaker_gbm",
+            data=_json.dumps({"rows": [{"x": 0.3}]}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read())
+
+    def probe(path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        _check(len(score()["predict"]) == 1, "healthy scoring broken")
+        _check(probe("/readyz") == 200, "/readyz not ready while healthy")
+        # 3 consecutive injected dispatch errors -> breaker OPEN; the
+        # cloud must NOT lock (dispatch_error is per-dispatch, not a
+        # dead mesh)
+        with faults.inject("score.dispatch:dispatch_error*3"):
+            for i in range(3):
+                try:
+                    score()
+                except urllib.error.HTTPError as e:
+                    _check(e.code == 503,
+                           f"faulted dispatch returned {e.code}")
+                else:
+                    raise ChaosFailure(
+                        f"dispatch {i} survived an injected error")
+        _check(health.healthy(),
+               "dispatch_error locked the cloud (it must only feed "
+               "the breaker)")
+        _check(lifecycle.BREAKER.state() == "open",
+               f"breaker not open: {lifecycle.BREAKER.status()}")
+        _check(probe("/readyz") == 503, "/readyz ready with breaker open")
+        # while open: instant 503, and NO device call — an armed fault
+        # at the dispatch site must not be consumed
+        # finite count: inf - 1 == inf would make the consumed-check
+        # below vacuous; 5 is plenty for the single probe attempt
+        with faults.inject("score.dispatch:dispatch_error*5") as armed:
+            before = armed[0].count
+            t0 = time.monotonic()
+            try:
+                score()
+            except urllib.error.HTTPError as e:
+                dt = time.monotonic() - t0
+                _check(e.code == 503, f"open breaker returned {e.code}")
+                _check(dt < 1.0, f"open-breaker 503 took {dt:.2f}s — "
+                       "not an instant short-circuit")
+                _check(int(e.headers.get("Retry-After") or 0) >= 1,
+                       "open-breaker 503 lacks Retry-After")
+            else:
+                raise ChaosFailure("open breaker admitted a dispatch")
+            _check(armed[0].count == before,
+                   "device dispatch happened while the breaker was "
+                   "open (armed fault consumed)")
+        _check(lifecycle.BREAKER.stats["short_circuited"] >= 1,
+               "no short-circuit recorded")
+        # faults cleared: after the cooldown, the next request is the
+        # half-open probe; success closes the breaker and restores
+        # readiness (read-at-use-time knob: shortening it now makes
+        # the already-elapsed open time count)
+        os.environ["H2O_TPU_BREAKER_COOLDOWN"] = "0.2"
+        time.sleep(0.3)
+        _check(len(score()["predict"]) == 1,
+               "half-open probe did not score")
+        _check(lifecycle.BREAKER.state() == "closed",
+               f"probe success did not close: {lifecycle.BREAKER.status()}")
+        _check(probe("/readyz") == 200,
+               "/readyz not restored after the breaker closed")
+    finally:
+        srv.shutdown()
+        rest.MODELS.pop("breaker_gbm", None)
+        lifecycle.BREAKER.reset()
+        health.reset()
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+
+
+# child process for the drain drill: a real pod-shaped server that
+# installs the SIGTERM handler and exits when the drain completes
+_DRAIN_CHILD = r"""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[2])
+import numpy as np
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import rest
+from h2o_kubernetes_tpu.models import GBM
+from h2o_kubernetes_tpu.runtime import (lifecycle, make_mesh,
+                                        set_global_mesh)
+
+set_global_mesh(make_mesh())
+rng = np.random.default_rng(7)
+x = rng.normal(size=400).astype(np.float32)
+y = np.where(x + rng.normal(scale=0.4, size=400) > 0, "p", "n")
+fr = h2o.Frame.from_arrays({"x": x, "y": y})
+rest.FRAMES["drain_train"] = fr
+rest.MODELS["drain_gbm"] = GBM(ntrees=3, max_depth=2, seed=0).train(
+    y="y", training_frame=fr)
+srv = rest.start_server(int(sys.argv[1]), install_signals=True)
+print("READY", flush=True)
+while not lifecycle.terminated():   # sleep is signal-interruptible;
+    time.sleep(0.2)                 # the drain thread os._exit(0)s
+sys.exit(0)
+"""
+
+
+def scenario_drain_under_load() -> None:
+    """SIGTERM during concurrent REST scoring + a RUNNING build:
+    readiness flips while liveness holds, every client gets a terminal
+    response, the job settles, the process exits inside the budget."""
+    import json as _json
+    import signal
+    import socket
+    import subprocess
+    import urllib.error
+    import urllib.request
+
+    drain_timeout = 15.0
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, H2O_TPU_DRAIN_TIMEOUT=str(drain_timeout))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _DRAIN_CHILD, str(port), repo],
+        env=env, stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        _check(line.strip() == "READY",
+               f"child never came up (got {line!r})")
+
+        def probe(path):
+            try:
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+            except urllib.error.URLError:
+                return 0             # server gone (post-drain shutdown)
+
+        _check(probe("/readyz") == 200, "pod not ready before SIGTERM")
+
+        # closed-loop scoring load; every request must end terminally
+        hung: list[str] = []
+        stop = threading.Event()
+        sigterm_at = [None]
+
+        def worker(wid):
+            body = _json.dumps(
+                {"rows": [{"x": 0.1 * wid}] * 8}).encode()
+            while not stop.is_set():
+                req = urllib.request.Request(
+                    base + "/3/Predictions/models/drain_gbm", data=body,
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        r.read()                 # 200: scored
+                except urllib.error.HTTPError as e:
+                    e.read()                     # 503/429: shed — terminal
+                except Exception as e:  # noqa: BLE001
+                    import socket as _socket
+
+                    # urlopen wraps a connect/read timeout in URLError
+                    # (reason=socket.timeout) — unwrap it, or the main
+                    # hang shape this drill exists to catch passes as a
+                    # terminal outcome
+                    cause = getattr(e, "reason", e)
+                    if (isinstance(cause, (TimeoutError, _socket.timeout))
+                            and not isinstance(e, ConnectionError)
+                            and not isinstance(cause, ConnectionError)):
+                        # a request that never returned = hung client,
+                        # the one outcome the drain contract forbids
+                        hung.append(f"w{wid}: hung — {e!r}")
+                        return
+                    # refused/reset/disconnected: an immediate error is
+                    # terminal — but only legitimate once SIGTERM has
+                    # allowed the server to be going away
+                    if sigterm_at[0] is None:
+                        hung.append(f"w{wid}: {e!r} before SIGTERM")
+                    return
+
+        workers = [threading.Thread(target=worker, args=(w,))
+                   for w in range(4)]
+        for t in workers:
+            t.start()
+        time.sleep(0.5)              # load in flight
+        # a build RUNNING at SIGTERM time: the drain must wait for (or
+        # terminally fail) it — and it holds DRAINING open long enough
+        # to observe the probe flip
+        req = urllib.request.Request(
+            base + "/3/ModelBuilders/gbm",
+            data=_json.dumps({
+                "training_frame": "drain_train", "response_column": "y",
+                "ntrees": 40, "max_depth": 3, "model_id": "drain_job",
+                "_sync_timeout": 0}).encode(),
+            method="POST", headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+        sigterm_at[0] = time.monotonic()
+        proc.send_signal(signal.SIGTERM)
+
+        # readiness must flip within 5s of SIGTERM, while the process
+        # (and its liveness) are still up
+        flipped = False
+        while time.monotonic() - sigterm_at[0] < 5.0:
+            if proc.poll() is not None:
+                break                # drained *very* fast: acceptable
+            code = probe("/readyz")
+            if code == 503:
+                flipped = True
+                break
+            time.sleep(0.02)
+        _check(flipped or proc.poll() is not None,
+               "/readyz never went unready after SIGTERM")
+        if flipped and proc.poll() is None:
+            _check(probe("/healthz") == 200,
+                   "liveness dropped during drain — the kubelet would "
+                   "kill a draining pod")
+
+        # the process must exit cleanly inside the drain budget
+        try:
+            rc = proc.wait(timeout=drain_timeout + 5.0)
+        except subprocess.TimeoutExpired:
+            raise ChaosFailure(
+                f"process still alive {drain_timeout + 5:.0f}s after "
+                "SIGTERM — drain wedged")
+        _check(rc == 0, f"drained process exited rc={rc}")
+        stop.set()
+        deadline = time.monotonic() + 15
+        for t in workers:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        _check(not any(t.is_alive() for t in workers),
+               "load workers still blocked after process exit — "
+               "hung clients")
+        _check(not hung, f"non-terminal client outcomes: {hung}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -388,6 +679,8 @@ SCENARIOS = {
     "resume": scenario_resume,
     "score-under-fault": scenario_score_under_fault,
     "ingest-truncated-csv": scenario_ingest_truncated_csv,
+    "breaker-trip": scenario_breaker_trip,
+    "drain-under-load": scenario_drain_under_load,
 }
 
 

@@ -11,6 +11,9 @@ Usage:
     python tools/run_tests.py           # fast tier (-m "not slow")
     python tools/run_tests.py --slow    # slow tier only
     python tools/run_tests.py --all     # both tiers
+    python tools/run_tests.py --chaos   # chaos drill suite only
+                                        # (tools/chaos.py all); combine
+                                        # with --all/--slow to append it
     python tools/run_tests.py --timeout 1200   # per-module cap
 
 Prints one status line per module and a final JSON summary; exit 0
@@ -36,6 +39,9 @@ def main() -> int:
                     help="run only the slow-marked tier")
     ap.add_argument("--all", action="store_true",
                     help="run both tiers (fast then slow)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the chaos drill suite (tools/chaos.py "
+                    "all); alone it replaces the pytest tiers")
     ap.add_argument("--timeout", type=float, default=1500.0,
                     help="per-module wall cap (a starved rendezvous "
                     "hangs forever; this converts it into a named "
@@ -45,6 +51,8 @@ def main() -> int:
     modules = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
     tiers = (["not slow", "slow"] if args.all
              else ["slow"] if args.slow else ["not slow"])
+    if args.chaos and not (args.all or args.slow):
+        tiers = []                   # drills only
     results = []
     t0 = time.monotonic()
     # per-test timing lines ([time] …, tests/conftest.py hook): on a
@@ -109,6 +117,42 @@ def main() -> int:
                             "tail": tail[-120:]})
             print(f"[{status:>7}] {name:<32} ({tier}) {dt:6.1f}s "
                   f"{tail[-80:]}", flush=True)
+
+    if args.chaos:
+        # the drill suite is one subprocess, same timeout discipline as
+        # a test module (a wedged drain must become a named failure)
+        cmd = [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+               "all"]
+        start = time.monotonic()
+        # own process group, like the module loop above: on timeout the
+        # drill's grandchildren (drain-under-load's pod subprocess — a
+        # full JAX interpreter with a REST server) must die with it
+        proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE,
+                                start_new_session=True)
+        try:
+            out_b, err_b = proc.communicate(timeout=args.timeout)
+            out = (out_b + err_b).decode(errors="replace")
+            status = "ok" if proc.returncode == 0 else "FAIL"
+        except subprocess.TimeoutExpired as e:
+            import signal
+
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            out_b, err_b = proc.communicate()
+            out = ((e.stdout or out_b or b"")
+                   + (e.stderr or err_b or b"")).decode(errors="replace")
+            status = "TIMEOUT"
+        dt = time.monotonic() - start
+        tail = out.strip().splitlines()[-1] if out.strip() else ""
+        results.append({"module": "chaos.py all", "tier": "chaos",
+                        "status": status, "seconds": round(dt, 1),
+                        "tail": tail[-120:]})
+        print(f"[{status:>7}] {'chaos.py all':<32} (chaos) {dt:6.1f}s "
+              f"{tail[-80:]}", flush=True)
 
     failed = [r for r in results if r["status"] in ("FAIL", "TIMEOUT")]
     print(json.dumps({
