@@ -20,6 +20,8 @@ This build mirrors that plan:
 
 from __future__ import annotations
 
+import collections
+import functools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -48,6 +50,12 @@ def jobs() -> list[dict[str, Any]]:
 # done/failed/reap), contention is nil, and a shared lock keeps the
 # dataclass pickle-friendly (no per-instance lock field)
 _JOB_STATE_LOCK = threading.Lock()
+
+# resume-manifest read-modify-write lock: the pipelined host stream is
+# the only writer during a run, but the lock makes _save_step safe
+# against any concurrent manifest reader (REST pollers, a second run
+# sharing the checkpoint_dir in-process) — same discipline Jobs got
+_MANIFEST_LOCK = threading.Lock()
 
 
 @dataclass
@@ -107,6 +115,13 @@ class Job:
             self.end_time = time.time()
 
 
+# one lock for all Leaderboard mutation/reads: the pipelined executor's
+# host stream inserts rows while the driver thread (or a REST poller)
+# reads the ranking — same shared-lock rationale as _JOB_STATE_LOCK
+# (contention is nil, instances stay pickle-friendly)
+_LB_LOCK = threading.Lock()
+
+
 class Leaderboard:
     """Ranked table of (model_id, metrics) — Leaderboard.java analog."""
 
@@ -117,34 +132,39 @@ class Leaderboard:
         self.models: dict[str, Any] = {}
 
     def add(self, model_id: str, model, metrics: dict[str, float]):
-        self.models[model_id] = model
-        self.rows.append({"model_id": model_id, **metrics})
-        self.rows.sort(key=lambda r: r.get(self.sort_metric, np.inf)
-                       if self.ascending
-                       else -r.get(self.sort_metric, -np.inf))
+        with _LB_LOCK:
+            self.models[model_id] = model
+            self.rows.append({"model_id": model_id, **metrics})
+            self.rows.sort(key=lambda r: r.get(self.sort_metric, np.inf)
+                           if self.ascending
+                           else -r.get(self.sort_metric, -np.inf))
 
     @property
     def leader(self):
-        return self.models[self.rows[0]["model_id"]] if self.rows else None
+        with _LB_LOCK:
+            return self.models[self.rows[0]["model_id"]] \
+                if self.rows else None
 
     def as_list(self) -> list[dict[str, Any]]:
-        return [dict(r) for r in self.rows]
+        with _LB_LOCK:
+            return [dict(r) for r in self.rows]
 
     def to_pandas(self):
         import pandas as pd
 
-        return pd.DataFrame(self.rows)
+        return pd.DataFrame(self.as_list())
 
     def __repr__(self):
-        if not self.rows:
+        rows = self.as_list()   # locked snapshot: list.sort in add()
+        if not rows:            # transiently empties the live list
             return "Leaderboard(empty)"
         cols: list[str] = []
-        for r in self.rows:           # union of metric keys, stable order
+        for r in rows:            # union of metric keys, stable order
             cols += [c for c in r if c != "model_id" and c not in cols]
-        w = max(len(r["model_id"]) for r in self.rows)
+        w = max(len(r["model_id"]) for r in rows)
         lines = ["  ".join([f"{'model_id':<{w}}"] +
                            [f"{c:>12}" for c in cols])]
-        for r in self.rows:
+        for r in rows:
             lines.append("  ".join(
                 [f"{r['model_id']:<{w}}"] +
                 [f"{r[c]:>12.5f}" if c in r else " " * 12 for c in cols]))
@@ -255,6 +275,9 @@ class AutoML:
         self.verbosity = verbosity
         self.leaderboard: Leaderboard | None = None
         self.job: Job | None = None
+        # overlap accounting of the last train() when the pipelined
+        # executor ran (runtime/scheduler.py stats dict), else None
+        self.scheduler_stats: dict | None = None
         self._models_by_family: dict[str, list] = {}
         # reference parity: H2O AutoML keeps an event_log frame
         # (ai/h2o/automl/EventLog [U3]); here a list of
@@ -286,6 +309,20 @@ class AutoML:
     def train(self, y: str, training_frame: Frame,
               x: Sequence[str] | None = None,
               leaderboard_frame: Frame | None = None) -> "AutoML":
+        """Run the model search.
+
+        By default the plan executes on the PIPELINED executor
+        (runtime/scheduler.py): the driver thread holds the device
+        token and trains plan entries strictly in order, a compile-
+        ahead worker pre-traces/lowers the next entries' boost
+        executables (a persistent-cache fill cold, a no-op warm), and
+        a host worker applies completions — leaderboard insertion,
+        `_save_step` manifest writes, event-log lines — in PLAN order
+        whatever order they become runnable.  The ordering contract is
+        strict: leaderboard, model metrics, and resume manifest are
+        identical to the sequential run's (same seeds, insertion order
+        by plan index).  ``H2O_TPU_AUTOML_PIPELINE=0`` restores the
+        serial path bit-for-bit."""
         t0 = time.monotonic()
         deadline = t0 + self.max_runtime_secs if self.max_runtime_secs \
             else None
@@ -304,14 +341,39 @@ class AutoML:
         budget = self.max_models if self.max_models else None
 
         def out_of_budget():
+            # n_done counts at TRAIN completion, so the budget holds
+            # even while completions are still pending on the host
+            # stream (out-of-order completion cannot over-train)
             if budget is not None and n_done >= budget:
                 return True
             return deadline is not None and time.monotonic() > deadline
 
         completed = self._load_manifest()
 
-        def run_one(fam: str, name: str, params: dict) -> bool:
-            """Train one model; returns False when the step is skipped."""
+        from .runtime import scheduler as _sched
+
+        execu = _sched.PipelinedExecutor() if _sched.pipeline_enabled() \
+            else None
+        self.scheduler_stats = None
+
+        def complete_step(model_id, fam, model, metrics, resumed):
+            """Everything after a step's device work — runs inline
+            (serial) or on the host stream in plan order (pipelined)."""
+            self.leaderboard.add(model_id, model, metrics)
+            self._models_by_family.setdefault(fam, []).append(
+                (model_id, model))
+            if resumed:
+                self._log(f"{model_id}: resumed from checkpoint")
+                return
+            self._save_step(model_id, fam, model, metrics)
+            self._log(f"{model_id}: {metric}="
+                      f"{metrics.get(metric, float('nan')):.5f}")
+
+        def run_one(seq: int, fam: str, name: str, params: dict) -> bool:
+            """Train (or resume) one model. Always returns True today;
+            the bool return + the caller's skip branch are the seam a
+            future step-skip predicate plugs into (a skipped step must
+            not consume budget NOR a host-stream sequence slot)."""
             if fam == "glm":
                 params = {**params,
                           "family": "binomial" if nclasses == 2
@@ -321,10 +383,15 @@ class AutoML:
             if model_id in completed:       # resume: step already done
                 model, metrics = self._load_step(model_id,
                                                  completed[model_id])
-                self.leaderboard.add(model_id, model, metrics)
-                self._models_by_family.setdefault(fam, []).append(
-                    (model_id, model))
-                self._log(f"{model_id}: resumed from checkpoint")
+                done = functools.partial(complete_step, model_id, fam,
+                                         model, metrics, True)
+                if execu is not None:
+                    # resumed completions ride the ordered host stream
+                    # too — a resumed step k must not insert before a
+                    # still-pending step k-1
+                    execu.host.submit(seq, done, label=model_id)
+                else:
+                    done()
                 return True
             from .runtime import faults
 
@@ -337,21 +404,31 @@ class AutoML:
                 nfolds=self.nfolds, fold_assignment="modulo",
                 keep_cross_validation_predictions=True)
             t = time.monotonic()
-            model = est.train(y=y, training_frame=training_frame, x=x)
-            if leaderboard_frame is not None:
-                metrics = model.model_performance(leaderboard_frame, y)
-            elif model.cv is not None:
-                metrics = model.cv.metrics
-            else:   # nfolds < 2: rank on training metrics (H2O fallback)
-                metrics = model.model_performance(training_frame, y)
+
+            def train_and_score():
+                model = est.train(y=y, training_frame=training_frame,
+                                  x=x)
+                if leaderboard_frame is not None:
+                    ms = model.model_performance(leaderboard_frame, y)
+                elif model.cv is not None:
+                    ms = model.cv.metrics
+                else:   # nfolds < 2: training metrics (H2O fallback)
+                    ms = model.model_performance(training_frame, y)
+                return model, ms
+
+            if execu is not None:
+                with execu.device(model_id):
+                    model, metrics = train_and_score()
+            else:
+                model, metrics = train_and_score()
             metrics = {**metrics,
                        "training_time_s": time.monotonic() - t}
-            self.leaderboard.add(model_id, model, metrics)
-            self._models_by_family.setdefault(fam, []).append(
-                (model_id, model))
-            self._save_step(model_id, fam, model, metrics)
-            self._log(f"{model_id}: {metric}="
-                      f"{metrics.get(metric, float('nan')):.5f}")
+            done = functools.partial(complete_step, model_id, fam,
+                                     model, metrics, False)
+            if execu is not None:
+                execu.host.submit(seq, done, label=model_id)
+            else:
+                done()
             return True
 
         from .runtime.health import (ClusterHealthError, healthy,
@@ -377,55 +454,205 @@ class AutoML:
                 self.job.failed(repr(err))
                 raise err from e
 
-        for fam, name, params in plan:
-            if out_of_budget():
-                break
+        def poll_host_errors():
+            """Surface host-stream completion failures (a failed
+            `_save_step`, a leaderboard error) with the SAME semantics
+            the serial loop gives them: logged via step_failed, fatal
+            only if the cluster died with them."""
+            if execu is None:
+                return
+            for _s, label, err in execu.host.pop_errors():
+                step_failed(label or f"step {_s}",
+                            err if isinstance(err, Exception)
+                            else RuntimeError(repr(err)))
+
+        ca_seen: set = set()
+
+        def submit_compile_ahead(fam: str, name: str, params: dict):
+            """Queue the entry's boost executables on the compile
+            stream. Entry names dedupe up front (the sliding lookahead
+            window sees each entry `depth` times — without this the
+            unsupported count would multiply and estimators would be
+            rebuilt per pass); family+params dedupe again inside the
+            stream, so identical grid draws stay free too."""
+            if execu is None or execu.compiles is None:
+                return
+            if name in ca_seen:
+                return
+            ca_seen.add(name)
+            if f"{name}_AutoML_{self.project_name}" in completed:
+                return      # resumed step: _load_step never dispatches
+            if not hasattr(_EST[fam], "compile_ahead_lowerings"):
+                # GLM/DL today: their iterative programs are
+                # shape-shared across configs, so pre-lowering buys
+                # little (an estimator adding support just defines the
+                # method)
+                execu.compiles.mark_unsupported()
+                return
             try:
-                # a skipped step doesn't consume budget; a failed attempt
-                # does (so persistent failures can't loop forever)
-                if not run_one(fam, name, params):
-                    continue
-            except ClusterHealthError as e:
-                # dead cloud: every later step would fail too — fail the
-                # job cleanly instead of grinding through the plan
-                # (reference fail-fast semantics, SURVEY.md §5.3)
-                self.job.failed(repr(e))
-                raise
-            except Exception as e:
-                step_failed(name, e)
-            n_done += 1
-            self.job.update(min(0.8, n_done / max(budget or 20, 1)))
+                est = _EST[fam](
+                    **params, seed=self.seed,
+                    nfolds=self.nfolds, fold_assignment="modulo",
+                    keep_cross_validation_predictions=True)
+            except Exception:       # bad params fail at run_one, loudly
+                return
+            key = (fam, tuple(sorted(
+                (k, repr(v)) for k, v in params.items())))
+            execu.compile_ahead_submit(
+                key,
+                functools.partial(est.compile_ahead_lowerings, y,
+                                  training_frame, x),
+                label=name)
 
         grid_families = [f for f in ("gbm", "xgboost", "deeplearning")
                          if f in self.algos]
         if budget is None and deadline is None:
             grid_families = []          # nothing bounds the grid search
-        grid_idx = 0
-        while grid_families and not out_of_budget():
-            fam, params = _random_grid(rng)
-            if fam not in grid_families:
-                continue
-            grid_idx += 1
+        grid_state = {"idx": 0}
+
+        def draw_grid_entry():
+            """One ACCEPTED grid draw — consumes rng exactly like the
+            serial loop (rejected draws consume a draw and nothing
+            else), so the accepted-entry sequence is identical."""
+            while True:
+                fam, params = _random_grid(rng)
+                if fam in grid_families:
+                    grid_state["idx"] += 1
+                    return (fam, f"{fam.upper()}_grid_"
+                            f"{grid_state['idx']}", params)
+
+        drawn: collections.deque = collections.deque()
+        seq = 0
+        try:
+            for idx, (fam, name, params) in enumerate(plan):
+                if out_of_budget():
+                    break
+                poll_host_errors()
+                if execu is not None:
+                    # pre-lower the NEXT entries' executables while this
+                    # one holds the device token (entry idx itself would
+                    # just race its own on-demand compile). Bounded by
+                    # the REMAINING model budget too: pre-compiling an
+                    # entry the budget will never train is pure waste
+                    # (it even slows a single-core host)
+                    ahead = execu.compile_ahead
+                    if budget is not None:
+                        ahead = min(ahead, budget - n_done - 1)
+                    for nfam, nname, nparams in \
+                            plan[idx + 1: idx + 1 + max(ahead, 0)]:
+                        submit_compile_ahead(nfam, nname, nparams)
+                s, seq = seq, seq + 1
+                try:
+                    # a skipped step doesn't consume budget; a failed
+                    # attempt does (persistent failures can't loop)
+                    if not run_one(s, fam, name, params):
+                        if execu is not None:
+                            execu.host.skip(s)
+                        continue
+                except ClusterHealthError as e:
+                    # dead cloud: every later step would fail too — fail
+                    # the job cleanly instead of grinding through the
+                    # plan (reference fail-fast semantics, SURVEY §5.3)
+                    if execu is not None:
+                        execu.host.skip(s)
+                    self.job.failed(repr(e))
+                    raise
+                except Exception as e:
+                    if execu is not None:
+                        execu.host.skip(s)
+                    step_failed(name, e)
+                n_done += 1
+                self.job.update(min(0.8, n_done / max(budget or 20, 1)))
+
+            while grid_families and not out_of_budget():
+                poll_host_errors()
+                if execu is not None:
+                    # draw-ahead keeps the compile stream fed; drawing
+                    # past the budget only advances rng state nothing
+                    # downstream observes (the accepted-entry order the
+                    # leaderboard contract depends on is unchanged).
+                    # Lookahead is budget-bounded like the plan loop's.
+                    ahead = execu.compile_ahead
+                    if budget is not None:
+                        ahead = min(ahead, budget - n_done - 1)
+                    while len(drawn) < 1 + max(ahead, 0):
+                        drawn.append(draw_grid_entry())
+                    for entry in list(drawn)[1:1 + max(ahead, 0)]:
+                        submit_compile_ahead(*entry)
+                    fam, name, params = drawn.popleft()
+                else:
+                    fam, params = _random_grid(rng)
+                    if fam not in grid_families:
+                        continue
+                    grid_state["idx"] += 1
+                    name = f"{fam.upper()}_grid_{grid_state['idx']}"
+                s, seq = seq, seq + 1
+                try:
+                    run_one(s, fam, name, params)
+                except ClusterHealthError as e:
+                    if execu is not None:
+                        execu.host.skip(s)
+                    self.job.failed(repr(e))
+                    raise
+                except Exception as e:
+                    if execu is not None:
+                        execu.host.skip(s)
+                    step_failed(f"grid {fam}", e)
+                n_done += 1
+                self.job.update(min(0.9, n_done / max(budget or 20, 1)))
+
+            if execu is not None:
+                # barrier before the ensembles: every base model's
+                # completion must be applied (the ensembles read the
+                # leaderboard + family map), and pending completion
+                # failures get their serial-semantics escalation now
+                try:
+                    execu.host.drain(timeout=600.0)
+                except TimeoutError as te:
+                    # a wedged host stream is a scheduler defect — fail
+                    # the job loudly, never hang the run
+                    self.job.failed(repr(te))
+                    raise
+                poll_host_errors()
+
             try:
-                run_one(fam, f"{fam.upper()}_grid_{grid_idx}", params)
-            except ClusterHealthError as e:
+                if "stackedensemble" in self.algos and \
+                        leaderboard_frame is None and \
+                        len(self.leaderboard.models) >= 2 and \
+                        self.nfolds >= 2:
+                    self._build_ensembles(y, training_frame, metric, asc)
+            except Exception as e:      # surface fatal errors on the Job
                 self.job.failed(repr(e))
                 raise
-            except Exception as e:
-                step_failed(f"grid {fam}", e)
-            n_done += 1
-            self.job.update(min(0.9, n_done / max(budget or 20, 1)))
 
-        try:
-            if "stackedensemble" in self.algos and \
-                    leaderboard_frame is None and \
-                    len(self.leaderboard.models) >= 2 and self.nfolds >= 2:
-                self._build_ensembles(y, training_frame, metric, asc)
-        except Exception as e:           # surface fatal errors on the Job
-            self.job.failed(repr(e))
-            raise
+            self.job.done()
+        finally:
+            # EVERY exit path (success, dead cloud, injected fault)
+            # settles the streams: pending completions are applied so
+            # finished steps' manifest writes land before the error
+            # propagates (the resume round-trip depends on it), and no
+            # scheduler thread outlives the run
+            if execu is not None:
+                try:
+                    execu.host.drain(timeout=120.0)
+                except TimeoutError as te:
+                    self._log(f"scheduler drain wedged: {te}")
+                for _s, label, err in execu.host.pop_errors():
+                    self._log(f"{label or _s} completion failed: "
+                              f"{err!r}")
+                execu.shutdown(timeout=30.0)
+                self.scheduler_stats = execu.stats()
+                st = self.scheduler_stats
+                ca = st.get("compile_ahead") or {}
+                self._log(
+                    "pipeline: "
+                    f"device_busy={st['device_busy_s']:.1f}s "
+                    f"compile_wait={st['device_compile_wait_s']:.1f}s "
+                    f"host_busy={st['host_busy_s']:.1f}s "
+                    f"compile_ahead={ca.get('busy_s', 0.0):.1f}s "
+                    f"(fills={ca.get('fills', 0)} "
+                    f"warm={ca.get('warm', 0)})")
 
-        self.job.done()
         self._log(f"done in {time.monotonic() - t0:.1f}s — leader: "
                   f"{self.leaderboard.rows[0]['model_id']}"
                   if self.leaderboard.rows else "done (no models)")
@@ -470,18 +697,19 @@ class AutoML:
 
         path = join_path(self.checkpoint_dir, f"{model_id}.model")
         save_model(model, path)
-        manifest = self._load_manifest()
-        manifest[model_id] = {"file": path, "fam": fam,
-                              "metrics": metrics}
-        if is_remote(self.checkpoint_dir):
-            # object stores overwrite atomically per PUT
-            write_bytes(self._manifest_path(),
-                        json.dumps(manifest).encode())
-        else:
-            tmp = self._manifest_path() + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(manifest, f)
-            os.replace(tmp, self._manifest_path())   # crash-atomic
+        with _MANIFEST_LOCK:       # read-modify-write must be atomic
+            manifest = self._load_manifest()
+            manifest[model_id] = {"file": path, "fam": fam,
+                                  "metrics": metrics}
+            if is_remote(self.checkpoint_dir):
+                # object stores overwrite atomically per PUT
+                write_bytes(self._manifest_path(),
+                            json.dumps(manifest).encode())
+            else:
+                tmp = self._manifest_path() + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, self._manifest_path())   # crash-atomic
 
     def _load_step(self, model_id, entry):
         from .persist import load_model

@@ -39,6 +39,10 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_BREAKER_FAILURES | 5 | consecutive device-dispatch errors that trip the serving circuit breaker open (runtime/lifecycle.py) |
 | H2O_TPU_BREAKER_COOLDOWN | 30 | seconds the breaker stays open before admitting the half-open probe (runtime/lifecycle.py) |
 | H2O_TPU_RETRY_MAX_ELAPSED_S | 0 (off) | hard cap on a retry loop's total elapsed time, attempts included (runtime/retry.py) |
+| H2O_TPU_AUTOML_PIPELINE | 1 | 0 kills the pipelined AutoML executor AND the CV fold pipeline — restores the serial path bit-for-bit (runtime/scheduler.py, docs/SCALING.md) |
+| H2O_TPU_AUTOML_COMPILE_AHEAD | 1 | plan entries whose boost executables are pre-lowered ahead of the training cursor; 0 disables the compile stream (needs the persistent XLA cache to pay — auto-disabled without it) |
+| H2O_TPU_AUTOML_QUEUE_DEPTH | 4 | bound on the scheduler's host/compile queues: completed-but-unapplied models and stale compile requests cannot accumulate (runtime/scheduler.py) |
+| H2O_TPU_FUSED_BINNING | 1 | 0 restores the two-dispatch fit_bins→Frame.binned train prologue instead of the fused single-dispatch fit+apply (models/tree/binning.py) |
 | JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection

@@ -434,8 +434,13 @@ class Frame:
         ns = {v.nrows for v in self._vecs.values()}
         if len(ns) > 1:
             raise ValueError(f"ragged columns: nrows {ns}")
-        # binned-matrix cache (Frame.binned): {key: uint8 device array}
+        # binned-matrix cache (Frame.binned / binning.fused_fit_bins):
+        # {key: uint8 device array | (BinSpec, uint8 device array)}
         self._binned_cache: dict = {}
+        # content version for the fused-binning fit keys: edges are a
+        # pure function of (columns, names, n_bins), so a cache entry is
+        # valid exactly while the version holds (binning.fused_fit_bins)
+        self._version: int = 0
 
     # -- construction -------------------------------------------------------
 
@@ -505,8 +510,10 @@ class Frame:
             raise ValueError("nrows mismatch")
         self._vecs[name] = vec
         # column set changed: binned stale (setdefault: frames from old
-        # pickles predate the cache attribute)
+        # pickles predate the cache attribute); the version bump also
+        # invalidates any fused-binning fit key a live BinSpec carries
         self.__dict__.setdefault("_binned_cache", {}).clear()
+        self.__dict__["_version"] = self.__dict__.get("_version", 0) + 1
 
     def __contains__(self, name: str) -> bool:
         return name in self._vecs

@@ -435,14 +435,15 @@ class Model:
                 cols.append(v.as_float())
         return jnp.stack(cols, axis=1)
 
-    def predict_raw(self, frame: Frame) -> np.ndarray:
-        """[n, K] class probabilities, or [n] regression predictions.
+    def _predict_raw_device(self, frame: Frame) -> jax.Array:
+        """Device half of predict_raw: the [padded(, K)] scoring array
+        BEFORE the host transfer, dispatched under the device guard.
 
-        Scoring fails fast on a locked cloud (same gate as training)
-        and runs its dispatch under the device guard: a runtime error
-        escaping the mesh mid-predict (halted chip, dead ICI link)
-        surfaces as ClusterHealthError with the locked-cloud recovery
-        message, not a raw XLA traceback."""
+        The CV fold pipeline (models/cv.py) consumes the transfer on
+        its host stream so fold f+1's train can dispatch while fold
+        f's holdout predictions come back — JAX dispatch is async, so
+        returning the un-transferred array is exactly the overlap
+        point."""
         from ..runtime.health import device_dispatch, require_healthy
 
         # scoring is not a training chunk boundary: it must never
@@ -456,10 +457,24 @@ class Model:
             X = self._design_matrix(frame)
             off = self._frame_offset(frame)
             if off is not None:
-                out = np.asarray(self._score(X, off))
-                return out[: frame.nrows]
-            out = np.asarray(self._score(X))[: frame.nrows]
-            return out
+                return self._score(X, off)
+            return self._score(X)
+
+    def predict_raw(self, frame: Frame) -> np.ndarray:
+        """[n, K] class probabilities, or [n] regression predictions.
+
+        Scoring fails fast on a locked cloud (same gate as training)
+        and runs its dispatch under the device guard: a runtime error
+        escaping the mesh mid-predict (halted chip, dead ICI link)
+        surfaces as ClusterHealthError with the locked-cloud recovery
+        message, not a raw XLA traceback."""
+        from ..runtime.health import device_dispatch
+
+        out_dev = self._predict_raw_device(frame)
+        # the transfer stays under the guard too: an async-dispatched
+        # device error surfaces HERE, at the first read
+        with device_dispatch("model scoring"):
+            return np.asarray(out_dev)[: frame.nrows]
 
     def _frame_offset(self, frame: Frame) -> jax.Array | None:
         """Validated per-row offset column for an offset-trained model

@@ -204,34 +204,57 @@ def cross_validate(est, y: str, frame: Frame, cv: CVArgs,
             tkw_share["ignored_columns"] = list(
                 tkw.get("ignored_columns") or []) + [wcol]
 
-    models, fold_metrics = [], []
-    preds: np.ndarray | None = None
     y_codes_all = yv.to_numpy() if yv.is_enum() else \
         np.asarray(yv.as_float())[:n]
-    for k in range(nfolds):
-        hold = folds == k
-        clone = copy.deepcopy(est)
-        clone.cv_args = CVArgs()            # fold models never recurse
-        if share:
-            wk = np.where(hold, 0.0, base_w).astype(np.float32)
-            vecs = {nm: frame.vec(nm) for nm in frame.names}
-            vecs[mask_col] = Vec.from_numpy(wk, mask_col)
-            m = clone.train(y=y, training_frame=Frame(vecs), **tkw_share)
-            pk_full = m.predict_raw(frame)   # full shape: shared program
-            pk = pk_full[hold]
-        else:
-            m = clone.train(y=y,
-                            training_frame=frame.select_rows(~hold),
-                            **tkw)
-            pk = m.predict_raw(frame.select_rows(hold))
-        if preds is None:
-            preds = np.zeros((n,) + pk.shape[1:], dtype=pk.dtype)
-        preds[hold] = pk
-        # fold metrics straight from pk — a model_performance() call
-        # would rebuild the design matrix and re-score the holdout
-        fold_metrics.append(_combined_metrics(
-            m, y_codes_all[hold], yv.is_enum(), pk, m.distribution))
-        models.append(m)
+
+    # -- fold pipelining (runtime/scheduler.py kill switch) -----------
+    # JAX dispatch is async, so fold f's holdout-prediction TRANSFER +
+    # metric extraction (host work) can ride a one-worker host stream
+    # while fold f+1's train dispatches on the main thread; in sliced
+    # mode the same worker also prefetches fold f+1's frame slices when
+    # they take select_rows' HOST-gather path (the device-gather path
+    # stays on the main thread: only the device-token holder may
+    # dispatch device programs — tests/conftest.py rendezvous rule).
+    # Results are deterministic either way: tasks run on ONE worker in
+    # submission order and every fold's metrics are a pure function of
+    # its predictions. H2O_TPU_AUTOML_PIPELINE=0 restores the serial
+    # loop bit-for-bit.
+    from ..runtime import scheduler as _sched
+
+    pipe = nfolds >= 2 and _sched.pipeline_enabled()
+    if not pipe:
+        models, fold_metrics = [], []
+        preds = None
+        for k in range(nfolds):
+            hold = folds == k
+            clone = copy.deepcopy(est)
+            clone.cv_args = CVArgs()        # fold models never recurse
+            if share:
+                wk = np.where(hold, 0.0, base_w).astype(np.float32)
+                vecs = {nm: frame.vec(nm) for nm in frame.names}
+                vecs[mask_col] = Vec.from_numpy(wk, mask_col)
+                m = clone.train(y=y, training_frame=Frame(vecs),
+                                **tkw_share)
+                pk_full = m.predict_raw(frame)  # full shape: shared
+                pk = pk_full[hold]              # program
+            else:
+                m = clone.train(y=y,
+                                training_frame=frame.select_rows(~hold),
+                                **tkw)
+                pk = m.predict_raw(frame.select_rows(hold))
+            if preds is None:
+                preds = np.zeros((n,) + pk.shape[1:], dtype=pk.dtype)
+            preds[hold] = pk
+            # fold metrics straight from pk — a model_performance()
+            # call would rebuild the design matrix and re-score
+            fold_metrics.append(_combined_metrics(
+                m, y_codes_all[hold], yv.is_enum(), pk, m.distribution))
+            models.append(m)
+    else:
+        models, fold_metrics, preds = _cross_validate_pipelined(
+            est, y, frame, folds, nfolds, share,
+            tkw_share if share else tkw,
+            base_w if share else None, mask_col, y_codes_all, yv, n)
 
     keys = fold_metrics[0].keys()
     summary = {key: {"mean": float(np.mean([fm[key] for fm in fold_metrics])),
@@ -246,6 +269,95 @@ def cross_validate(est, y: str, frame: Frame, cv: CVArgs,
                              cv.keep_cross_validation_predictions else None),
         metrics=combined, metrics_summary=summary,
         fold_metrics=fold_metrics)
+
+
+def _cross_validate_pipelined(est, y, frame: Frame, folds, nfolds: int,
+                              share: bool, tkw: dict, base_w,
+                              mask_col: str, y_codes_all, yv, n: int):
+    """The pipelined fold loop — numerics identical to the serial one
+    (same train calls in the same order on the main thread, same
+    per-fold metric computation), with the holdout transfer + metric
+    extraction (and eligible slice prefetches) on a one-worker host
+    stream. Returns (models, fold_metrics, preds)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..frame import Vec
+    from ..frame.frame import _device_gather_min
+    from ..runtime.health import device_dispatch
+    from ..runtime.mesh import ROWS, global_mesh
+
+    models: list = [None] * nfolds
+    fold_metrics: list = [None] * nfolds
+    box: dict = {}                      # {"preds": ndarray} once known
+
+    def extract(k, m, hold, out_dev, hold_n):
+        # the transfer stays under the device guard, like predict_raw:
+        # an async-dispatched device error surfaces at this first read
+        with device_dispatch("model scoring"):
+            arr = np.asarray(out_dev)
+        if share:
+            pk = arr[:n][hold]
+        else:
+            pk = arr[:hold_n]
+        if "preds" not in box:
+            box["preds"] = np.zeros((n,) + pk.shape[1:], dtype=pk.dtype)
+        box["preds"][hold] = pk
+        fold_metrics[k] = _combined_metrics(
+            m, y_codes_all[hold], yv.is_enum(), pk, m.distribution)
+
+    # slice prefetch rides the worker ONLY on select_rows' host-gather
+    # path; past the device-gather threshold the gather is a device
+    # program and belongs to the main (device-token) thread
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(global_mesh(), P(ROWS))
+    prefetch_ok = (not share) and (
+        n < _device_gather_min() or not sharding.is_fully_addressable)
+
+    def make_slices(hold):
+        return frame.select_rows(~hold), frame.select_rows(hold)
+
+    pool = ThreadPoolExecutor(max_workers=1,
+                              thread_name_prefix="h2o-cv-host")
+    slice_futs: list = [None] * nfolds
+    metric_futs: list = [None] * nfolds
+    try:
+        for k in range(nfolds):
+            # fail fast like the serial loop: a COMPLETED earlier
+            # fold's extraction error surfaces before the next train
+            # dispatches (done() keeps the check non-blocking, so the
+            # pipeline overlap is untouched)
+            for fut in metric_futs[:k]:
+                if fut is not None and fut.done():
+                    fut.result()
+            hold = folds == k
+            clone = copy.deepcopy(est)
+            clone.cv_args = CVArgs()        # fold models never recurse
+            if share:
+                wk = np.where(hold, 0.0, base_w).astype(np.float32)
+                vecs = {nm: frame.vec(nm) for nm in frame.names}
+                vecs[mask_col] = Vec.from_numpy(wk, mask_col)
+                tr_frame, hold_frame = Frame(vecs), frame
+            elif slice_futs[k] is not None:
+                tr_frame, hold_frame = slice_futs[k].result()
+            else:
+                tr_frame, hold_frame = make_slices(hold)
+            if prefetch_ok and k + 1 < nfolds:
+                # submitted BEFORE the train so it overlaps fold k's
+                # device work (FIFO worker: it runs after fold k-1's
+                # metric extraction)
+                slice_futs[k + 1] = pool.submit(make_slices,
+                                                folds == (k + 1))
+            m = clone.train(y=y, training_frame=tr_frame, **tkw)
+            models[k] = m
+            out_dev = m._predict_raw_device(hold_frame)
+            metric_futs[k] = pool.submit(extract, k, m, hold, out_dev,
+                                         hold_frame.nrows)
+        for fut in metric_futs:
+            fut.result()            # re-raise fold task errors in order
+    finally:
+        pool.shutdown(wait=True)
+    return models, fold_metrics, box["preds"]
 
 
 def finalize_train(est, model, y: str, training_frame: Frame,

@@ -43,17 +43,22 @@ class DRF(GBM):
         self.params.learn_rate = 1.0
         self._mtries_arg = mtries
 
-    def train(self, y: str, training_frame: Frame,
-              x: Sequence[str] | None = None, **kw) -> DRFModel:
-        # resolve mtries default: sqrt(F) for classification, F/3 for
-        # regression (reference DRF defaults) — from column names only,
-        # without materializing the design matrix twice
-        ignored = set(kw.get("ignored_columns") or [])
+    def _resolve_mtries(self, y: str, training_frame: Frame,
+                        x: Sequence[str] | None,
+                        ignored_columns=None, weights_column=None
+                        ) -> None:
+        """Resolve the mtries default into self.params — sqrt(F) for
+        classification, F/3 for regression (reference DRF defaults) —
+        from column names only, without materializing the design
+        matrix twice.  Shared by train() and compile-ahead so the
+        pre-lowered TreeParams carry the same mtries the dispatch
+        will."""
+        ignored = set(ignored_columns or [])
         ignored.add(y)
         if self.cv_args.fold_column:
             ignored.add(self.cv_args.fold_column)
-        if kw.get("weights_column"):
-            ignored.add(kw["weights_column"])
+        if weights_column:
+            ignored.add(weights_column)
         names = list(x) if x else [
             n for n in training_frame.names
             if n not in ignored and
@@ -72,4 +77,18 @@ class DRF(GBM):
         else:
             raise ValueError(f"mtries must be -1, -2 or > 0, "
                              f"got {self._mtries_arg}")
+
+    def train(self, y: str, training_frame: Frame,
+              x: Sequence[str] | None = None, **kw) -> DRFModel:
+        self._resolve_mtries(y, training_frame, x,
+                             kw.get("ignored_columns"),
+                             kw.get("weights_column"))
         return super().train(y=y, training_frame=training_frame, x=x, **kw)
+
+    def compile_ahead_lowerings(self, y: str, training_frame: Frame,
+                                x: Sequence[str] | None = None) -> list:
+        try:
+            self._resolve_mtries(y, training_frame, x)
+        except (ValueError, KeyError):
+            return []                 # train() will raise it properly
+        return super().compile_ahead_lowerings(y, training_frame, x)

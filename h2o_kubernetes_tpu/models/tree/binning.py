@@ -111,6 +111,46 @@ def _sampled_feature_matrix(num_cols: list) -> jax.Array:
     return jnp.stack(num_cols, axis=1)
 
 
+def _classify_features(frame, feature_names: list[str], n_bins: int
+                       ) -> tuple[list[bool], list[int], list, np.ndarray]:
+    """(is_enum, num_idx, num_cols, base_M) — the ONE feature-kind
+    classification shared by fit_bins and the fused path.
+
+    ``base_M`` is the host [F, B-2] +inf edge matrix with the
+    high-cardinality range-grouping edges already filled: past B-1
+    levels, contiguous CODE RANGES share bins — the same range grouping
+    the reference's DHistogram applies to categoricals past nbins_cats
+    ([U3] hex/tree/DHistogram). Expressed through the numeric
+    searchsorted path (is_enum=False + synthetic edges between ranges);
+    NA codes arrive as NaN from as_float and land in the NA bin as
+    usual.  Enum rows never consult edges (apply_bins clips the code),
+    so their rows stay at the +inf padding."""
+    is_enum: list[bool] = []
+    num_idx: list[int] = []
+    num_cols = []
+    base = np.full((len(feature_names), n_bins - 2), np.inf,
+                   dtype=np.float32)
+    for name in feature_names:
+        v = frame.vec(name)
+        if v.is_enum():
+            card = v.cardinality()
+            if card > n_bins - 1:
+                # n_bins-3 edges split the code space [0, card) into
+                # n_bins-2 near-equal ranges; the -0.5 puts each edge
+                # BETWEEN codes (airlines Origin/Dest is ~300 levels)
+                e = (np.arange(1, n_bins - 2, dtype=np.float32)
+                     * card / (n_bins - 2)) - 0.5
+                base[len(is_enum), : n_bins - 3] = e
+                is_enum.append(False)
+                continue
+            is_enum.append(True)
+            continue
+        num_idx.append(len(is_enum))
+        num_cols.append(v.as_float())
+        is_enum.append(False)
+    return is_enum, num_idx, num_cols, base
+
+
 def fit_bins(frame, feature_names: list[str],
              n_bins: int = 256) -> BinSpec:
     """Compute quantile edges per numeric feature, fully device-side.
@@ -124,49 +164,15 @@ def fit_bins(frame, feature_names: list[str],
     if not 4 <= n_bins <= 256:
         raise ValueError(f"n_bins must be in [4, 256] (uint8 bin codes), "
                          f"got {n_bins}")
-    is_enum: list[bool] = []
-    num_idx: list[int] = []
-    num_cols = []
-    ovf_idx: list[int] = []
-    ovf_card: list[int] = []
-    for name in feature_names:
-        v = frame.vec(name)
-        if v.is_enum():
-            card = v.cardinality()
-            if card > n_bins - 1:
-                # high-cardinality categorical (airlines Origin/Dest is
-                # ~300): group contiguous CODE RANGES into the B-2
-                # finite bins — the same range grouping the reference's
-                # DHistogram applies to categoricals past nbins_cats
-                # ([U3] hex/tree/DHistogram). Expressed through the
-                # numeric searchsorted path (is_enum=False + synthetic
-                # edges between ranges); NA codes arrive as NaN from
-                # as_float and land in the NA bin as usual.
-                ovf_idx.append(len(is_enum))
-                ovf_card.append(card)
-                is_enum.append(False)
-                continue
-            is_enum.append(True)
-            continue
-        num_idx.append(len(is_enum))
-        num_cols.append(v.as_float())
-        is_enum.append(False)
-    F = len(feature_names)
-    # enum rows never consult edges (apply_bins clips the code), so the
-    # whole base can stay at the +inf padding
-    M = jnp.full((F, n_bins - 2), jnp.inf, dtype=jnp.float32)
+    is_enum, num_idx, num_cols, base = _classify_features(
+        frame, feature_names, n_bins)
+    M = jnp.asarray(base)
     if num_cols:
         Q = _device_quantiles(_sampled_feature_matrix(num_cols),
                               n_bins - 3)
         Q = jnp.where(jnp.isnan(Q), jnp.inf, Q.astype(jnp.float32))
         M = M.at[jnp.asarray(num_idx, dtype=jnp.int32),
                  : n_bins - 3].set(Q)
-    for fi, card in zip(ovf_idx, ovf_card):
-        # n_bins-3 edges split the code space [0, card) into n_bins-2
-        # near-equal ranges; the -0.5 puts each edge BETWEEN codes
-        e = (np.arange(1, n_bins - 2, dtype=np.float32)
-             * card / (n_bins - 2)) - 0.5
-        M = M.at[fi, : n_bins - 3].set(jnp.asarray(e))
     return BinSpec(names=list(feature_names), edges=None,
                    is_enum=is_enum, n_bins=n_bins, edges_dev=M)
 
@@ -251,6 +257,97 @@ def bin_frame(frame, bin_spec: BinSpec) -> jax.Array:
         out.append(_bin_block_jit(cols, edges[lo:hi], bin_spec.na_bin,
                                   enum_mask[lo:hi]))
     return out[0] if len(out) == 1 else _concat_blocks_jit(*out)
+
+
+# ---------------------------------------------------------------------------
+# Fused first-dispatch binning (fit + apply in ONE program)
+# ---------------------------------------------------------------------------
+#
+# The two-dispatch train prologue (fit_bins → Frame.binned) hides a
+# blocking host round trip: Frame.binned fingerprints the EDGE BYTES
+# for its cache key, so `np.asarray(edges)` must wait out the quantile
+# computation and transfer it to the host before the bin apply can even
+# dispatch — ~100 ms per train() on the tunneled chip (PROFILE.md
+# "What's next" #2), paid once per AutoML candidate and per CV fold.
+# `fused_fit_bins` folds both halves into the frame's first training
+# dispatch: one jitted program computes the quantile edges AND the
+# first column block's codes, nothing touches the host, and the binned
+# cache is keyed by (names, n_bins, frame content version) — valid
+# because the edges are a pure function of the frame's content (the
+# version counter bumps on Frame.__setitem__).  Bit-parity with the
+# two-dispatch path (same sample gather, same quantile program, same
+# apply_bins) is asserted by tests/test_scheduler.py.
+
+
+def fused_binning_enabled() -> bool:
+    """H2O_TPU_FUSED_BINNING != "0" (the two-dispatch escape hatch)."""
+    return _os.environ.get("H2O_TPU_FUSED_BINNING", "1") != "0"
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def _fused_fit_bin_jit(base_M, num_idx, sample, cols: tuple,
+                       enum_block, na_bin: int):
+    """ONE dispatch: quantile edges from the sampled matrix + the bin
+    codes of the first column block.  ``sample=None`` (no numeric
+    features) skips the quantile half at trace time."""
+    M = base_M
+    if sample is not None:
+        n_q = M.shape[1] - 1                      # n_bins - 3
+        qs = jnp.linspace(0.0, 1.0, n_q + 2)[1:-1]
+        Q = jax.vmap(lambda c: jnp.nanquantile(c, qs))(sample.T)
+        Q = jnp.where(jnp.isnan(Q), jnp.inf, Q.astype(jnp.float32))
+        M = M.at[num_idx, : n_q].set(Q)
+    binned = apply_bins(jnp.stack(cols, axis=1), M[: len(cols)],
+                        enum_block, na_bin)
+    return M, binned
+
+
+def fused_fit_bins(frame, feature_names: list[str],
+                   n_bins: int = 256) -> tuple[BinSpec, jax.Array]:
+    """(BinSpec, [padded, F] uint8 codes) in one fused first dispatch.
+
+    Cache: hits the owning frame's ``_binned_cache`` under a
+    content-version fit key WITHOUT any device sync, so a second model
+    on the same frame/nbins (every AutoML plan entry after the first)
+    pays neither the quantile fit nor the bin apply.  The classic
+    fingerprint path (Frame.binned) remains for specs that did not come
+    from fitting THIS frame (checkpoint continuation)."""
+    if not 4 <= n_bins <= 256:
+        raise ValueError(f"n_bins must be in [4, 256] (uint8 bin codes), "
+                         f"got {n_bins}")
+    cache = frame.__dict__.setdefault("_binned_cache", {})
+    key = ("fitbin", tuple(feature_names), n_bins,
+           frame.__dict__.get("_version", 0))
+    hit = cache.pop(key, None)
+    if hit is not None:
+        cache[key] = hit              # true LRU: a hit refreshes recency
+        return hit
+    is_enum, num_idx, num_cols, base = _classify_features(
+        frame, feature_names, n_bins)
+    F = len(feature_names)
+    padded = frame.vec(feature_names[0]).padded_len
+    sample = _sampled_feature_matrix(num_cols) if num_cols else None
+    block = _bin_block_cols(padded, F)
+    enum_arr = np.array(is_enum)
+    cols0 = tuple(frame.vec(nm).as_float()
+                  for nm in feature_names[:block])
+    M, first = _fused_fit_bin_jit(
+        jnp.asarray(base), jnp.asarray(num_idx, dtype=jnp.int32),
+        sample, cols0, jnp.asarray(enum_arr[:block]), n_bins - 1)
+    outs = [first]
+    for lo in range(block, F, block):
+        hi = min(lo + block, F)
+        cols = tuple(frame.vec(nm).as_float()
+                     for nm in feature_names[lo:hi])
+        outs.append(_bin_block_jit(cols, M[lo:hi], n_bins - 1,
+                                   jnp.asarray(enum_arr[lo:hi])))
+    binned = outs[0] if len(outs) == 1 else _concat_blocks_jit(*outs)
+    spec = BinSpec(names=list(feature_names), edges=None,
+                   is_enum=is_enum, n_bins=n_bins, edges_dev=M)
+    while len(cache) >= 2:                  # tiny LRU: drop oldest
+        cache.pop(next(iter(cache)))
+    cache[key] = (spec, binned)
+    return spec, binned
 
 
 def bin_frame_host_chunks(frame, bin_spec: BinSpec,

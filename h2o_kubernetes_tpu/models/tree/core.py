@@ -520,19 +520,17 @@ def _boost_drf_jit(binned, y, w, margin, keys, p: TreeParams,
     return fn(binned, y, w, margin, keys)
 
 
-def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
-                    p: TreeParams, bp: BoostParams, mesh=None):
-    """Grouped DRF forest growth: n_trees independent trees in ONE
-    dispatch, vmapped in groups sized to the histogram memory budget.
-    Returns (margin unchanged, trees [n_trees, N])."""
-    assert bp.drf_mode
-    F = binned.shape[1]
-    # same live-histogram accounting as the multinomial path: vmap
-    # multiplies per-level histogram memory by G. Grouping only pays on
-    # the MXU (fuller M, fewer kernel launches); under the segment impl
-    # (CPU mesh) it just multiplies live memory on a shared host — and
-    # the virtual-device mesh multiplies it again by the shard count —
-    # so grow sequentially there.
+def drf_group_size(n_trees: int, p: TreeParams, F: int) -> tuple[int, int]:
+    """(G, rounds) for the grouped DRF grow — the ONE sizing used by
+    boost_trees_drf and by compile-ahead (models/gbm.py), so the
+    pre-lowered executable's key shape cannot drift from the dispatch.
+
+    Same live-histogram accounting as the multinomial path: vmap
+    multiplies per-level histogram memory by G. Grouping only pays on
+    the MXU (fuller M, fewer kernel launches); under the segment impl
+    (CPU mesh) it just multiplies live memory on a shared host — and
+    the virtual-device mesh multiplies it again by the shard count —
+    so grow sequentially there."""
     hist_bytes = level_hist_bytes(p, F)
     if _resolve_impl(p.hist_impl) != "pallas":
         G = 1
@@ -551,7 +549,17 @@ def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
     # and throw 12 away; G = ceil(n_trees / rounds) keeps the same
     # round count (and stays under the old G, hence under budget) with
     # minimal padded work
-    G = -(-n_trees // rounds)
+    return -(-n_trees // rounds), rounds
+
+
+def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
+                    p: TreeParams, bp: BoostParams, mesh=None):
+    """Grouped DRF forest growth: n_trees independent trees in ONE
+    dispatch, vmapped in groups sized to the histogram memory budget
+    (drf_group_size). Returns (margin unchanged, trees [n_trees, N])."""
+    assert bp.drf_mode
+    F = binned.shape[1]
+    G, rounds = drf_group_size(n_trees, p, F)
     keys = jax.random.split(key, rounds * G).reshape(rounds, G)
     margin, trees = _boost_drf_jit(binned, y, w, margin, keys, p, bp,
                                    G, mesh or global_mesh())

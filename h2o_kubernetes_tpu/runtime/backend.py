@@ -15,6 +15,7 @@ import os
 import re
 import subprocess
 import sys
+import threading
 import time
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
@@ -153,6 +154,109 @@ def enable_persistent_compile_cache() -> None:
                     "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
     except Exception:   # noqa: BLE001 — acceleration only, never fatal
         pass
+
+
+# ---------------------------------------------------------------------------
+# XLA compile accounting (jax.monitoring listeners)
+# ---------------------------------------------------------------------------
+#
+# The pipelined AutoML scheduler (runtime/scheduler.py) needs to know
+# how much XLA compilation ran on WHICH thread: compiles on the device
+# stream are critical-path compile-wait, compiles on the compile-ahead
+# stream are overlapped cache fills.  jax.monitoring emits exactly the
+# events needed ('/jax/core/compile/backend_compile_duration' per
+# compile request, '/jax/compilation_cache/cache_hits|misses' for the
+# persistent cache) without the stderr spam of jax_log_compiles, so the
+# watch is a pair of listeners feeding per-thread counters.  Listeners
+# are registered once per process and are pure accounting — they can
+# never raise into jax.
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_PCACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_PCACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_watch_lock = threading.Lock()
+_watch_installed = False
+# global counters + per-thread breakdown
+# {ident: [compiles, seconds, pcache_hits, pcache_misses]}
+_watch = {"compiles": 0, "compile_s": 0.0,
+          "pcache_hits": 0, "pcache_misses": 0}
+_watch_threads: dict[int, list] = {}
+
+
+def _per_thread() -> list:
+    return _watch_threads.setdefault(threading.get_ident(),
+                                     [0, 0.0, 0, 0])
+
+
+def _on_compile_duration(event: str, duration: float, **kw) -> None:
+    if event != _BACKEND_COMPILE_EVENT:
+        return
+    with _watch_lock:
+        _watch["compiles"] += 1
+        _watch["compile_s"] += duration
+        per = _per_thread()
+        per[0] += 1
+        per[1] += duration
+
+
+def _on_compile_event(event: str, **kw) -> None:
+    # the listener runs on the compiling thread, so per-thread cache
+    # attribution is exact even with a concurrent compile-ahead stream
+    if event == _PCACHE_HIT_EVENT:
+        with _watch_lock:
+            _watch["pcache_hits"] += 1
+            _per_thread()[2] += 1
+    elif event == _PCACHE_MISS_EVENT:
+        with _watch_lock:
+            _watch["pcache_misses"] += 1
+            _per_thread()[3] += 1
+
+
+def start_compile_watch() -> None:
+    """Install the jax.monitoring listeners (idempotent, never raises).
+
+    Counting starts at install; callers diff snapshots, so a late
+    install only shortens history, never corrupts it."""
+    global _watch_installed
+    with _watch_lock:
+        if _watch_installed:
+            return
+        _watch_installed = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_duration_secs_listener(
+            _on_compile_duration)
+        monitoring.register_event_listener(_on_compile_event)
+    except Exception:   # noqa: BLE001 — accounting only, never fatal
+        pass
+
+
+def compile_watch_snapshot(thread_ident: int | None = None) -> dict:
+    """Cumulative compile counters; with ``thread_ident``, that
+    thread's share under ``thread_compiles``/``thread_compile_s`` —
+    diff two snapshots to attribute a code region's compile cost."""
+    with _watch_lock:
+        if len(_watch_threads) > 64:
+            # prune dead threads' entries: every AutoML run spawns
+            # fresh scheduler workers, and a long-lived REST server
+            # would otherwise grow this dict (and risk ident-reuse
+            # mixing a dead stream's counters into a new thread's)
+            # without bound. Callers diff snapshots over short windows,
+            # so dropping finished threads' history is safe.
+            live = {t.ident for t in threading.enumerate()}
+            live.add(thread_ident)
+            for ident in [i for i in _watch_threads if i not in live]:
+                del _watch_threads[ident]
+        out = dict(_watch)
+        if thread_ident is not None:
+            per = _watch_threads.get(thread_ident, [0, 0.0, 0, 0])
+            out["thread_compiles"] = per[0]
+            out["thread_compile_s"] = per[1]
+            out["thread_pcache_hits"] = per[2]
+            out["thread_pcache_misses"] = per[3]
+    return out
 
 
 def ensure_live_backend(timeout: float = 90.0,

@@ -51,7 +51,7 @@ def make_table(rows: int, seed: int = 0):
 
 def run_shape(rows: int, max_models: int, nfolds: int,
               max_runtime_secs: float | None = None,
-              exclude_algos=None) -> dict:
+              exclude_algos=None, include_algos=None) -> dict:
     import traceback
 
     import jax
@@ -73,6 +73,7 @@ def run_shape(rows: int, max_models: int, nfolds: int,
         aml = AutoML(max_models=max_models, nfolds=nfolds, seed=1,
                      max_runtime_secs=max_runtime_secs,
                      exclude_algos=exclude_algos,
+                     include_algos=include_algos,
                      project_name=f"scale_{rows}")
         aml.train(y="IsDepDelayed", training_frame=fr)
         wall = time.perf_counter() - t0
@@ -95,6 +96,13 @@ def run_shape(rows: int, max_models: int, nfolds: int,
         "leader": lb[0]["model_id"] if lb else None,
         "leader_auc": round(lb[0].get("auc", float("nan")), 5)
         if lb else None,
+        # full-precision rows: the bench's pipelined-vs-serial identity
+        # check compares every printed digit (minus wall-clock fields)
+        "leaderboard": lb,
+        # overlap accounting when the pipelined executor ran
+        # (runtime/scheduler.py; None on H2O_TPU_AUTOML_PIPELINE=0)
+        "scheduler_stats": aml.scheduler_stats if aml is not None
+        else None,
         "platform": jax.default_backend(),
         # the event log carries every swallowed per-model failure —
         # a 1-model leaderboard is explainable from the artifact alone
@@ -123,6 +131,14 @@ def main() -> int:
                     help="AutoML families to skip (the 1M-row CPU "
                     "curve drops drf/deeplearning: 100 depth-12 CPU "
                     "trees per point measure the box, not the design)")
+    ap.add_argument("--include-algos", nargs="+", default=None,
+                    help="restrict the plan to these families "
+                    "(mutually exclusive with --exclude-algos)")
+    ap.add_argument("--no-recompile-check", action="store_true",
+                    help="skip the warm-repeat recompile check (the "
+                    "automl_wall bench runs serial/pipelined legs in "
+                    "separate processes and checks warm compiles on "
+                    "one leg only)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -135,7 +151,8 @@ def main() -> int:
     rows_list = args.rows or ([10_000_000] if on_tpu
                               else [100_000, 300_000, 1_000_000])
     results = [run_shape(r, args.max_models, args.nfolds,
-                         args.max_runtime_secs, args.exclude_algos)
+                         args.max_runtime_secs, args.exclude_algos,
+                         args.include_algos)
                for r in rows_list]
     # per-model recompile check: a WARM repeat of the smallest shape
     # (same families, same row count, same plan) must compile ~nothing
@@ -146,10 +163,11 @@ def main() -> int:
     # CPU-mesh only: on chip it would double the wall inside a scarce
     # availability window for a diagnostic the CPU curve already gives.
     recompile_check = None
-    if not on_tpu and len(results) >= 1 \
+    if not on_tpu and not args.no_recompile_check and len(results) >= 1 \
             and not results[0].get("error"):
         warm = run_shape(rows_list[0], args.max_models, args.nfolds,
-                         args.max_runtime_secs, args.exclude_algos)
+                         args.max_runtime_secs, args.exclude_algos,
+                         args.include_algos)
         recompile_check = {
             "cold_models": results[0]["models_trained"],
             "cold_compiles": results[0]["xla_compiles"],

@@ -19,6 +19,13 @@ beyond the headline GBM number (bench.py):
   warm ``score_numpy`` rows/s on a 100k-row batch, recorded next to
   the per-call ``predict()`` Frame path it replaces, with a
   recompile check (warm repeat must add 0 scorer-cache misses);
+- config #7  ``automl_wall_100k`` — pipelined vs serial AutoML
+  wall-clock on the airlines shape (docs/SCALING.md "Pipelined
+  AutoML"): two cold subprocess legs with isolated persistent caches,
+  leaderboard-identity check, warm-repeat compile count, and the
+  scheduler's overlap accounting (device-busy / compile-wait /
+  host-busy / compile-ahead fills). ``AUTOML_BENCH_ROWS`` /
+  ``AUTOML_BENCH_MODELS`` size it;
 - config #6  the 10M-row chunked-data-path proofs (docs/SCALING.md):
   ``ingest_airlines_csv_10m`` — streamed pyarrow record-batch CSV
   ingest of a ~1.5 GB airlines-shaped file; ``gbm_higgs_10m`` — GBM
@@ -295,6 +302,91 @@ def main() -> int:
                out.pop("compile_seconds"),
                rows_score=out.pop("rows"), ntrees=20, max_depth=5,
                **out)
+
+    if _want("automl_wall_100k"):
+        # config #7: pipelined AutoML wall-clock (ISSUE 5 tentpole) on
+        # the AUTOML_SCALE airlines shape. Two COLD legs in separate
+        # subprocesses — serial (H2O_TPU_AUTOML_PIPELINE=0) then
+        # pipelined — each with its own fresh persistent-cache dir so
+        # neither inherits the other's compiles; the pipelined leg
+        # also runs automl_scale's warm repeat (warm-repeat compile
+        # count must stay 0). Recorded: the wall ratio, per-leg walls
+        # and compile counts, the scheduler overlap accounting
+        # (device-busy / compile-wait / host-busy / compile-ahead
+        # fills), and the leaderboard identity check (model ids,
+        # ranking, metrics to every printed digit — wall-clock fields
+        # excluded). NOTE: on a single-core host the streams time-slice
+        # one CPU, so the ratio is bounded near 1.0 by construction —
+        # the overlap stats still show what LEFT the critical path
+        # (the wall win materializes where the compile/host streams
+        # have their own core, and on the tunneled chip where every
+        # compile is a remote round trip).
+        import subprocess
+        import tempfile
+
+        aml_rows = int(os.environ.get("AUTOML_BENCH_ROWS", 100_000))
+        aml_models = int(os.environ.get("AUTOML_BENCH_MODELS", 2))
+
+        def _aml_leg(pipeline: str, cache_dir: str, out_path: str,
+                     recompile_check: bool) -> dict:
+            env = dict(os.environ,
+                       JAX_PLATFORMS="cpu" if not on_tpu
+                       else os.environ.get("JAX_PLATFORMS", ""),
+                       H2O_TPU_AUTOML_PIPELINE=pipeline,
+                       JAX_COMPILATION_CACHE_DIR=cache_dir)
+            cmd = [sys.executable,
+                   os.path.join(REPO, "tools", "automl_scale.py"),
+                   "--rows", str(aml_rows),
+                   "--max-models", str(aml_models),
+                   "--nfolds", "3",
+                   "--include-algos", "glm", "gbm",
+                   "--out", out_path]
+            if not recompile_check:
+                cmd.append("--no-recompile-check")
+            r = subprocess.run(cmd, cwd=REPO, env=env,
+                               capture_output=True)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"automl_wall leg pipeline={pipeline} rc="
+                    f"{r.returncode}: "
+                    f"{r.stderr.decode(errors='replace')[-400:]}")
+            with open(out_path) as f:
+                out = json.load(f)
+            # run_shape swallows AutoML crashes into 'error' (and
+            # automl_scale still exits 0) — a crashed leg must fail
+            # the config, not record a 0-second "identical" row
+            err = out["curve"][0].get("error")
+            if err:
+                raise RuntimeError(
+                    f"automl_wall leg pipeline={pipeline} AutoML "
+                    f"crashed: {err[-400:]}")
+            return out
+
+        def _strip_rows(rows):
+            return [{k: v for k, v in r.items()
+                     if k != "training_time_s"} for r in rows]
+
+        with tempfile.TemporaryDirectory() as td:
+            serial = _aml_leg("0", os.path.join(td, "cache_serial"),
+                              os.path.join(td, "serial.json"), False)
+            pipe = _aml_leg("1", os.path.join(td, "cache_pipe"),
+                            os.path.join(td, "pipe.json"), True)
+        s0, p0 = serial["curve"][0], pipe["curve"][0]
+        lb_identical = _strip_rows(s0["leaderboard"]) == \
+            _strip_rows(p0["leaderboard"])
+        ratio = s0["wall_seconds"] / max(p0["wall_seconds"], 1e-9)
+        rc = pipe.get("recompile_check") or {}
+        record("automl_wall_100k", ratio, "x_speedup_vs_serial",
+               p0["wall_seconds"], 1, 0.0,
+               rows_automl=aml_rows, max_models=aml_models, nfolds=3,
+               serial_wall_s=s0["wall_seconds"],
+               pipelined_wall_s=p0["wall_seconds"],
+               serial_compiles=s0["xla_compiles"],
+               pipelined_compiles=p0["xla_compiles"],
+               warm_repeat_compiles=rc.get("warm_compiles"),
+               leaderboard_identical=lb_identical,
+               leader=p0["leader"], leader_auc=p0["leader_auc"],
+               scheduler_stats=p0.get("scheduler_stats"))
 
     # -- config #6: the 10M-row chunked-path proofs --------------------
     rows_10m = int(os.environ.get("BENCH_ROWS_10M", 10_000_000))

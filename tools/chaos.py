@@ -39,6 +39,13 @@ Scenarios:
   while ``/healthz`` stays live, every in-flight request gets a
   terminal response (result or 503/429 — zero hung clients), and the
   process exits cleanly inside ``H2O_TPU_DRAIN_TIMEOUT`` + 5s.
+- ``automl-pipelined-fault``  an injected ``automl.step`` device error
+  lands mid-overlap in the PIPELINED AutoML executor
+  (runtime/scheduler.py): the job must fail terminally with the
+  completed steps' manifest entries already written (the resume
+  contract), no scheduler thread may outlive the run, and the
+  ``H2O_TPU_AUTOML_PIPELINE=0`` kill switch must drain the same
+  scenario clean on the serial path with an identical manifest.
 """
 
 from __future__ import annotations
@@ -672,6 +679,83 @@ def scenario_drain_under_load() -> None:
         proc.stdout.close()
 
 
+def scenario_automl_pipelined_fault() -> None:
+    """Mid-overlap step failure in the pipelined AutoML executor: the
+    job fails terminally, finished steps' manifest writes have landed
+    (the resume contract), no scheduler thread is left wedged — and
+    the kill switch reproduces the exact same manifest serially."""
+    import json as _json
+    import threading as _threading
+
+    import h2o_kubernetes_tpu as h2o  # noqa: F401 — package init
+    from h2o_kubernetes_tpu.automl import AutoML
+    from h2o_kubernetes_tpu.runtime import faults, health
+
+    def sched_threads():
+        return [t.name for t in _threading.enumerate()
+                if t.is_alive() and (t.name.startswith("h2o-automl-")
+                                     or t.name.startswith("h2o-cv-"))]
+
+    def run_faulted(pipeline: str, ckpt: str) -> dict:
+        saved = os.environ.get("H2O_TPU_AUTOML_PIPELINE")
+        os.environ["H2O_TPU_AUTOML_PIPELINE"] = pipeline
+        try:
+            health.reset()
+            aml = AutoML(max_models=2, nfolds=2, seed=11,
+                         verbosity=None,
+                         include_algos=["glm", "deeplearning"],
+                         # same project name both legs: the model ids
+                         # (manifest keys) embed it, and the identity
+                         # check compares keys
+                         project_name="chaos_pipe",
+                         checkpoint_dir=ckpt)
+            with faults.inject("automl.step:device_error@1"):
+                try:
+                    aml.train(y="y", training_frame=_frame(seed=12))
+                except health.ClusterHealthError:
+                    pass
+                else:
+                    raise ChaosFailure(
+                        f"pipeline={pipeline}: AutoML survived a "
+                        "mid-run device error")
+            _check(aml.job.status == "FAILED",
+                   f"pipeline={pipeline}: job not FAILED terminally "
+                   f"({aml.job.status})")
+            # the scheduler threads must settle — a wedged host/compile
+            # worker would hold model references and block interpreter
+            # shutdown hygiene
+            deadline = time.monotonic() + 10
+            while sched_threads() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            _check(not sched_threads(),
+                   f"pipeline={pipeline}: scheduler threads wedged: "
+                   f"{sched_threads()}")
+            man = _json.load(
+                open(os.path.join(ckpt, "automl_manifest.json")))
+            _check(len(man) == 1,
+                   f"pipeline={pipeline}: manifest should hold the 1 "
+                   f"finished step, has {sorted(man)}")
+            return man
+        finally:
+            os.environ.pop("H2O_TPU_AUTOML_PIPELINE", None)
+            if saved is not None:
+                os.environ["H2O_TPU_AUTOML_PIPELINE"] = saved
+            health.reset()
+
+    def norm(man: dict) -> dict:
+        return {k: {mk: mv for mk, mv in v["metrics"].items()
+                    if mk != "training_time_s"}
+                for k, v in man.items()}
+
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as d2:
+        m_pipe = run_faulted("1", d1)
+        m_serial = run_faulted("0", d2)
+        _check(norm(m_pipe) == norm(m_serial),
+               "pipelined manifest diverged from the serial kill-"
+               f"switch run: {norm(m_pipe)} vs {norm(m_serial)}")
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -681,6 +765,7 @@ SCENARIOS = {
     "ingest-truncated-csv": scenario_ingest_truncated_csv,
     "breaker-trip": scenario_breaker_trip,
     "drain-under-load": scenario_drain_under_load,
+    "automl-pipelined-fault": scenario_automl_pipelined_fault,
 }
 
 

@@ -16,6 +16,12 @@ Usage:
                                         # with --all/--slow to append it
     python tools/run_tests.py --timeout 1200   # per-module cap
 
+A preflight scan warns (or, with ``--strict-preflight`` /
+``H2O_TPU_PREFLIGHT_STRICT=1``, fails) when orphaned bench/AutoML
+processes are still running on the box — a leftover
+``automl_scale_10m.py`` once starved tier-1 into rendezvous stalls,
+and nothing timed on a contended core is trustworthy.
+
 Prints one status line per module and a final JSON summary; exit 0
 only if every module passed.
 """
@@ -32,6 +38,78 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# cmdline fragments that mark a bench/AutoML workload: one such process
+# left over from an earlier round starves the shared core and turns
+# tier-1's collective rendezvous into timeouts (the stale
+# automl_scale_10m.py found at 72% CPU during the PR-4 round did
+# exactly that — CHANGES.md PR 4 ops note)
+_ORPHAN_PATTERNS = ("automl_scale", "bench_suite", "bench.py",
+                    "boost_profile", "tpu_watch", "score_load",
+                    "automl_wall")
+
+
+def find_orphan_processes() -> list[tuple[int, str]]:
+    """(pid, cmdline) of processes that look like leftover bench/AutoML
+    workloads — excluding this process and its ancestors (running the
+    suite FROM a bench wrapper must not flag itself)."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(32):                     # walk up to init
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                ppid = int(f.read().split(")")[-1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+        ancestors.add(pid)
+        if ppid <= 1:
+            break
+        pid = ppid
+    out = []
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return out                          # no procfs (macOS): skip
+    for pid in pids:
+        if pid == me or pid in ancestors:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+                cmd = b" ".join(argv).decode(errors="replace").strip()
+        except OSError:
+            continue
+        # only interpreter processes count: 'vim tools/bench.py' or a
+        # grep mentioning the name is not a workload
+        if not argv or b"python" not in argv[0].lower():
+            continue
+        if cmd and any(pat in cmd for pat in _ORPHAN_PATTERNS):
+            out.append((pid, cmd[:160]))
+    return out
+
+
+def preflight(strict: bool) -> bool:
+    """Scan for orphaned bench/AutoML processes BEFORE timing anything;
+    returns False (and prints the PIDs) when the box is not clean.
+    Warns by default; fails the run under --strict-preflight or
+    H2O_TPU_PREFLIGHT_STRICT=1."""
+    orphans = find_orphan_processes()
+    if not orphans:
+        return True
+    print(f"[preflight] {len(orphans)} orphaned bench/automl "
+          "process(es) are competing for this box — timings below "
+          "are not trustworthy:", flush=True)
+    for pid, cmd in orphans:
+        print(f"[preflight]   pid {pid}: {cmd}", flush=True)
+    if strict:
+        print("[preflight] strict mode: refusing to run "
+              "(kill the processes above or drop --strict-preflight)",
+              flush=True)
+        return False
+    print("[preflight] continuing anyway (pass --strict-preflight to "
+          "fail instead)", flush=True)
+    return True
+
 
 def main() -> int:
     ap = argparse.ArgumentParser()
@@ -46,7 +124,15 @@ def main() -> int:
                     help="per-module wall cap (a starved rendezvous "
                     "hangs forever; this converts it into a named "
                     "module failure)")
+    ap.add_argument("--strict-preflight", action="store_true",
+                    help="fail (rc 2) when orphaned bench/automl "
+                    "processes are found instead of warning")
     args = ap.parse_args()
+
+    strict = args.strict_preflight or \
+        os.environ.get("H2O_TPU_PREFLIGHT_STRICT") == "1"
+    if not preflight(strict):
+        return 2
 
     modules = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
     tiers = (["not slow", "slow"] if args.all
