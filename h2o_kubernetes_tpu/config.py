@@ -43,6 +43,12 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_AUTOML_COMPILE_AHEAD | 1 | plan entries whose boost executables are pre-lowered ahead of the training cursor; 0 disables the compile stream (needs the persistent XLA cache to pay — auto-disabled without it) |
 | H2O_TPU_AUTOML_QUEUE_DEPTH | 4 | bound on the scheduler's host/compile queues: completed-but-unapplied models and stale compile requests cannot accumulate (runtime/scheduler.py) |
 | H2O_TPU_FUSED_BINNING | 1 | 0 restores the two-dispatch fit_bins→Frame.binned train prologue instead of the fused single-dispatch fit+apply (models/tree/binning.py) |
+| H2O_TPU_POOL_REPLICA | — | 1 marks this rest.py process an operator-provisioned scorer replica: /readyz additionally requires a pushed+warmed registry artifact (rest.py, docs/OPERATOR.md) |
+| H2O_TPU_POOL_WARM_BUCKETS | 128,1024 | default warm-up ladder: Model.warm_up pre-traces every pow2 batch bucket up to the largest listed, before a replica's readyz flips (models/base.py) |
+| H2O_TPU_POOL_RECONCILE_INTERVAL | 0.5 | seconds between scorer-pool reconcile passes (operator/reconcile.py) |
+| H2O_TPU_POOL_STARTUP_DEADLINE | 180 | seconds a provisioned replica may take to reach READY before the reconciler replaces it |
+| H2O_TPU_POOL_DEREGISTER_GRACE | 0.75 | cordon→SIGTERM gap of a rolling update, so routers drop the endpoint before the drain begins (zero-5xx contract) |
+| H2O_TPU_POOL_QUEUE_HIGH | 8 | mean admission-queue depth per replica that scales the pool up (operator/autoscale.py) |
 | JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection

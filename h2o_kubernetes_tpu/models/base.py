@@ -341,6 +341,65 @@ class Model:
             return self._score_matrix(X, offset=offset)
         return self._score_matrix(X)
 
+    def warm_up(self, buckets=None) -> list[int]:
+        """Pre-trace the jitted serving scorer at the given batch
+        buckets (padded to the pow2 buckets score_numpy actually
+        dispatches), so the FIRST real request after a replica goes
+        ready pays zero compiles — the operator warm-up contract
+        (docs/OPERATOR.md): a scorer-pool replica runs this before its
+        ``/readyz`` flips, and warm traffic at any batch size <= the
+        largest warmed bucket then adds only cache `hits`.
+
+        The whole pow2 ladder up to the LARGEST requested bucket is
+        traced (128, 256, ... top): score_numpy pads any batch to its
+        own bucket, so a skipped rung would be a first-request compile
+        for batches in that range. ``buckets=None`` reads
+        ``H2O_TPU_POOL_WARM_BUCKETS`` (default ``128,1024``). Compiles
+        land in the persistent XLA cache (runtime/backend.py), so
+        sibling replicas on the same host warm from disk instead of
+        recompiling. Returns the bucket sizes warmed, ascending."""
+        if not self._serving_jit:
+            raise ValueError(
+                f"model '{self.algo}' has no jitted serving scorer to "
+                "warm (score it through predict() instead)")
+        if buckets is None:
+            raw = os.environ.get("H2O_TPU_POOL_WARM_BUCKETS", "128,1024")
+            buckets = [b for b in raw.replace(" ", "").split(",") if b]
+        elif isinstance(buckets, (str, bytes)):
+            # a JSON string like "512" would otherwise iterate as the
+            # DIGITS ('5','1','2' — top bucket 128) and silently warm
+            # the wrong ladder, breaking the zero-miss contract the
+            # route then advertises
+            raise ValueError(
+                f"warm-up buckets must be a list of ints, got the "
+                f"string {buckets!r}")
+        try:
+            top = max(_batch_bucket(int(b)) for b in buckets)
+            if min(int(b) for b in buckets) < 1:
+                raise ValueError
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"bad warm-up bucket list {buckets!r} (want positive "
+                "ints, e.g. 128,1024)") from None
+        # the FULL pow2 ladder up to the largest requested bucket:
+        # score_numpy pads any n to its own bucket, so skipping a rung
+        # would leave batches in that range paying a first-request
+        # compile — exactly what the contract forbids
+        padded, b = [], _SCORE_MIN_BATCH
+        while b <= top:
+            padded.append(b)
+            b *= 2
+        F = len(self.feature_names)
+        need_off = bool(getattr(self, "offset_column", None))
+        for b in padded:
+            # zeros are valid everywhere: enum code 0 is a real level,
+            # numerics are finite — the VALUES don't matter, only the
+            # (schema, padded-batch, offset?) trace key
+            X = np.zeros((b, F), dtype=np.float32)
+            off = np.zeros(b, dtype=np.float32) if need_off else None
+            self.score_numpy(X, offset=off)
+        return padded
+
     def score_numpy(self, X, offset=None) -> np.ndarray:
         """Serving entry: raw [n, F] ndarray (training value space,
         enum codes / NaN NAs) -> [n, K] probabilities or [n]

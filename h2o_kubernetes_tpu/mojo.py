@@ -26,7 +26,8 @@ import zipfile
 
 import numpy as np
 
-__all__ = ["export_mojo", "import_mojo", "MojoModel"]
+__all__ = ["export_mojo", "import_mojo", "MojoModel", "MOJO_FORMAT",
+           "read_mojo_parts"]
 
 # format 2: tree ensembles carry the flattened serving arrays
 # (flat_*) instead of heap tree_* + bin edges — bumped so an OLD
@@ -34,6 +35,11 @@ __all__ = ["export_mojo", "import_mojo", "MojoModel"]
 # in its scorer; THIS reader accepts both (legacy branch kept)
 _FORMAT = "h2o_kubernetes_tpu/mojo/2"
 _READABLE_FORMATS = ("h2o_kubernetes_tpu/mojo/1", _FORMAT)
+
+# public name for consumers that must pin the CURRENT format (the
+# operator model registry only ships v2 artifacts: replicas serve the
+# flat_* arrays directly, so a v1 artifact has nothing to serve)
+MOJO_FORMAT = _FORMAT
 
 
 def _np(a):
@@ -189,23 +195,41 @@ def import_mojo(path: str) -> "MojoModel":
     return MojoModel(path)
 
 
+def read_mojo_parts(path, want_nested: bool = False
+                    ) -> tuple[dict, dict, dict]:
+    """(meta, arrays, nested) of a mojo artifact without building a
+    scorer — the shared reader for MojoModel and the operator model
+    registry (operator/registry.py validates the format/algo and wraps
+    the arrays in a jitted serving scorer instead of numpy descent).
+
+    ``nested`` holds the inner ``*.mojo`` blobs of a stackedensemble
+    artifact when ``want_nested``; empty otherwise."""
+    with zipfile.ZipFile(path) as z:
+        meta = json.loads(z.read("model.json"))
+        if meta.get("format") not in _READABLE_FORMATS:
+            raise ValueError(f"not a {_FORMAT} artifact "
+                             f"(format={meta.get('format')!r})")
+        with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
+            arrays = {k: npz[k] for k in npz.files}
+        nested = {}
+        if want_nested:
+            nested = {n: z.read(n) for n in z.namelist()
+                      if n.endswith(".mojo")}
+    return meta, arrays, nested
+
+
 class MojoModel:
     """Loads and scores a mojo artifact with numpy only."""
 
     def __init__(self, path):
-        with zipfile.ZipFile(path) as z:
-            self.meta = json.loads(z.read("model.json"))
-            if self.meta.get("format") not in _READABLE_FORMATS:
-                raise ValueError(f"{path}: not a {_FORMAT} artifact "
-                                 f"(format={self.meta.get('format')!r})")
-            with np.load(io.BytesIO(z.read("arrays.npz"))) as npz:
-                self.arrays = {k: npz[k] for k in npz.files}
-            if self.meta["algo"] == "stackedensemble":
-                self._base = [
-                    MojoModel(io.BytesIO(z.read(f"base_{i}.mojo")))
-                    for i in range(self.meta["base_count"])]
-                self._metalearner = MojoModel(
-                    io.BytesIO(z.read("metalearner.mojo")))
+        self.meta, self.arrays, nested = read_mojo_parts(
+            path, want_nested=True)
+        if self.meta["algo"] == "stackedensemble":
+            self._base = [
+                MojoModel(io.BytesIO(nested[f"base_{i}.mojo"]))
+                for i in range(self.meta["base_count"])]
+            self._metalearner = MojoModel(
+                io.BytesIO(nested["metalearner.mojo"]))
         self.algo = self.meta["algo"]
         self.feature_names = self.meta["feature_names"]
         self.nclasses = self.meta["nclasses"]

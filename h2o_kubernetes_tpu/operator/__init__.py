@@ -1,0 +1,43 @@
+"""Operator-provisioned scorer fleets — the layer above the pod.
+
+The source project IS a Kubernetes operator that provisions H2O
+clusters (PAPER.md §1a); PRs 2 and 4 built the single-pod serving
+primitives (flattened MOJO-v2 scorer + jitted cache, lifecycle/
+breaker/drain), and this package is the controller that turns those
+pods into a FLEET:
+
+- ``spec``      — the ``H2OScorerPool`` spec model + a dict-backed
+  in-process "API server" (``PoolStore``): spec generations, status,
+  and a bounded event log — the CRD/etcd analog, swappable for a real
+  kubeconfig-backed store later without touching the reconciler.
+- ``registry``  — the model registry: versioned MOJO-v2 artifacts
+  persisted through persist.py backends, pushed to replicas over
+  ``POST /3/ModelRegistry/load``, and a jitted ``FlatTreeScorer``
+  built from the flat arrays so a replica serves WITHOUT the training
+  stack.
+- ``reconcile`` — the level-triggered reconcile loop: observes real
+  subprocess pods (the rest.py serving entry with its own lifecycle
+  state machine), converges observed state to spec on replica death,
+  spec resize, and artifact change, and rolls artifact updates
+  surge-one with warm-up-gated readiness (zero 5xx under load).
+- ``autoscale`` — the horizontal scale signal derived from each
+  replica's admission-queue depth / shed / deadline counters scraped
+  off ``GET /3/Stats``.
+- ``pod``       — the replica entry point
+  (``python -m h2o_kubernetes_tpu.operator.pod --port N``): mesh +
+  persistent XLA cache + the model-registry readiness gate + the
+  SIGTERM drain path.
+
+docs/OPERATOR.md documents the spec schema, reconcile semantics, the
+rolling-update contract, and the autoscale signal; tools/chaos.py's
+``rolling-update`` and ``replica-kill`` drills rehearse the whole
+stack end to end.
+"""
+
+from .registry import FlatTreeScorer, ModelRegistry, load_artifact
+from .reconcile import Reconciler, ScorerReplica
+from .spec import PoolStore, ScorerPoolSpec
+
+__all__ = ["ScorerPoolSpec", "PoolStore", "ModelRegistry",
+           "FlatTreeScorer", "load_artifact", "Reconciler",
+           "ScorerReplica"]

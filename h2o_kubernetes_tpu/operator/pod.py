@@ -1,0 +1,59 @@
+"""Scorer-pool replica entry: ``python -m h2o_kubernetes_tpu.operator.pod``.
+
+The pod the reconciler provisions: the existing rest.py serving stack
+(micro-batcher, admission queue, breaker, SIGTERM drain — PR 2/4)
+plus the two replica-specific pieces:
+
+- the **model-registry readiness gate**: ``/readyz`` stays 503 until
+  an artifact has been pushed over ``POST /3/ModelRegistry/load`` AND
+  its pow2 batch buckets pre-traced (``Model.warm_up``) — a Service
+  can never route traffic to a replica that would compile on its
+  first request;
+- the **persistent XLA compile cache** is enabled up front, so the
+  warm-up traces of replica N+1 are disk hits from replica N's
+  compiles instead of fresh multi-second compiles.
+
+``/healthz`` is live from server start (the reconciler uses it as the
+"process is up, push the artifact now" signal); SIGTERM runs the PR-4
+drain (flush in-flight scoring, settle jobs, exit 0) inside
+``H2O_TPU_DRAIN_TIMEOUT``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+
+    # replica identity BEFORE any jax/package import reads env
+    os.environ.setdefault("H2O_TPU_POOL_REPLICA", "1")
+    from ..runtime.backend import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    from ..runtime import lifecycle, make_mesh, set_global_mesh
+
+    set_global_mesh(make_mesh())
+    from .. import rest
+
+    rest.install_pool_replica_gate()
+    rest.start_server(args.port, host=args.host, background=True,
+                      install_signals=True)
+    print(f"POD_UP port={args.port} pid={os.getpid()}", flush=True)
+    # sleep is signal-interruptible; the SIGTERM drain thread
+    # os._exit(0)s when the drain completes, so this loop only ends
+    # via terminated() on an in-process drain
+    while not lifecycle.terminated():
+        time.sleep(0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

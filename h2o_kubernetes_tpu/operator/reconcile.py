@@ -1,0 +1,551 @@
+"""Level-triggered reconcile loop over real subprocess scorer pods.
+
+The controller pattern of the reference operator (deployment/
+controller.rs watches the H2O CRD and converges StatefulSets), applied
+to the serving fleet: every pass re-derives actions from OBSERVED
+state (live processes, /healthz, /readyz) against the current spec —
+no edge memory, so a missed event can never wedge the pool. The loop
+converges on:
+
+- **replica death** — a pod whose process exited (OOM-kill, SIGKILL,
+  crash) is recorded (``replica_died``) and replaced next pass;
+- **spec resize** — ``replicas`` up spawns, down cordons + drains the
+  excess (never a hard kill of a serving replica);
+- **artifact change** — ``version`` bump rolls surge-one: spawn ONE
+  fresh replica on the new artifact, push + warm it (readyz flips only
+  after the pow2 buckets are pre-traced), and only once it is READY
+  cordon one old-version replica, wait the deregister grace (routers
+  drop the endpoint; stragglers still get served — that is how the
+  drill holds zero 5xx), then SIGTERM it into the PR-4 drain path.
+
+Pods are REAL subprocesses running the rest.py serving entry via
+``python -m h2o_kubernetes_tpu.operator.pod``: own lifecycle state
+machine, SIGTERM drain, breaker, admission queue — exactly what a
+kubelet would run; swapping the Popen for a pod template against a
+kube API server changes ``ScorerReplica`` only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+from ..runtime.retry import _env_float
+from .registry import ModelRegistry
+from .spec import PoolStore, ScorerPoolSpec
+
+__all__ = ["Reconciler", "ScorerReplica", "PENDING", "STARTING",
+           "LOADING", "READY", "CORDONED", "DRAINING", "DEAD"]
+
+PENDING = "PENDING"        # created, not yet spawned
+STARTING = "STARTING"      # process up, waiting for /healthz
+LOADING = "LOADING"        # artifact push + warm-up in flight
+READY = "READY"            # /readyz green (artifact warmed)
+CORDONED = "CORDONED"      # readiness off, serving stragglers (grace)
+DRAINING = "DRAINING"      # SIGTERM sent, PR-4 drain in progress
+DEAD = "DEAD"              # process gone (observed or forced)
+
+# states that count toward (future) serving capacity — cordoned and
+# draining replicas are on their way OUT and never count
+CAPACITY_STATES = (STARTING, LOADING, READY)
+
+
+def _interval() -> float:
+    return max(0.05, _env_float("H2O_TPU_POOL_RECONCILE_INTERVAL", 0.5))
+
+
+def _startup_deadline() -> float:
+    return max(1.0, _env_float("H2O_TPU_POOL_STARTUP_DEADLINE", 180.0))
+
+
+def _deregister_grace() -> float:
+    return max(0.0, _env_float("H2O_TPU_POOL_DEREGISTER_GRACE", 0.75))
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ScorerReplica:
+    """One subprocess scorer pod + this controller's view of it.
+
+    All process/HTTP interaction lives here so the Reconciler is pure
+    orchestration — tests drive it with fake replicas implementing
+    this surface."""
+
+    def __init__(self, rid: str, version: int, spec: ScorerPoolSpec,
+                 log_dir: str | None = None):
+        self.rid = rid
+        self.version = int(version)
+        self.model_key = spec.model_key
+        self.artifact = spec.artifact
+        # None = the replica resolves H2O_TPU_POOL_WARM_BUCKETS itself
+        self.warm_buckets = None if spec.warm_buckets is None \
+            else tuple(spec.warm_buckets)
+        self.env_overrides = dict(spec.env)
+        self.log_dir = log_dir
+        self.port = _free_port()
+        self.proc: subprocess.Popen | None = None
+        self.state = PENDING
+        self.created_at = time.monotonic()
+        self.cordoned_at = 0.0
+        self.drain_at = 0.0
+        self._log_f = None
+        self._load_thread: threading.Thread | None = None
+        self._load_err: str | None = None
+        self._load_done = False
+
+    # -- process --------------------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def spawn(self) -> None:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.update(self.env_overrides)
+        env["H2O_TPU_POOL_REPLICA"] = "1"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.DEVNULL
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._log_f = open(os.path.join(
+                self.log_dir, f"{self.rid}.log"), "ab")
+            out = self._log_f
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "h2o_kubernetes_tpu.operator.pod",
+             "--port", str(self.port)],
+            env=env, cwd=repo, stdout=out, stderr=out,
+            start_new_session=True)
+        self.state = STARTING
+        self.created_at = time.monotonic()
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def pid(self) -> int | None:
+        return self.proc.pid if self.proc is not None else None
+
+    def mark_dead(self) -> None:
+        self.state = DEAD
+        if self._log_f is not None:
+            try:
+                self._log_f.close()
+            except OSError:
+                pass
+            self._log_f = None
+
+    # -- HTTP -----------------------------------------------------------------
+
+    def _get_json(self, path: str, timeout: float = 2.0):
+        try:
+            with urllib.request.urlopen(self.url + path,
+                                        timeout=timeout) as r:
+                return json.loads(r.read())
+        except Exception:  # noqa: BLE001 — down/unready both read None
+            return None
+
+    def healthz_ok(self) -> bool:
+        out = self._get_json("/healthz")
+        return bool(out and out.get("alive"))
+
+    def readyz_ok(self) -> bool:
+        out = self._get_json("/readyz")
+        return bool(out and out.get("ready"))
+
+    def stats(self) -> dict | None:
+        return self._get_json("/3/Stats")
+
+    def loaded_version(self) -> int | None:
+        out = self._get_json("/3/ModelRegistry")
+        if not out:
+            return None
+        info = (out.get("models") or {}).get(self.model_key)
+        return info.get("version") if info else None
+
+    # -- artifact push (background: warm-up compiles take seconds) -----------
+
+    def start_load(self, registry: ModelRegistry) -> None:
+        self.state = LOADING
+
+        def push():
+            try:
+                registry.push(self.url, self.artifact, self.version,
+                              self.model_key, self.warm_buckets,
+                              timeout=_startup_deadline())
+            except Exception as e:  # noqa: BLE001 — reconciler decides
+                self._load_err = repr(e)[:300]
+            finally:
+                self._load_done = True
+
+        self._load_thread = threading.Thread(
+            target=push, name=f"h2o-pool-push-{self.rid}", daemon=True)
+        self._load_thread.start()
+
+    def load_finished(self) -> bool:
+        return self._load_done
+
+    def load_error(self) -> str | None:
+        return self._load_err
+
+    # -- retirement -----------------------------------------------------------
+
+    def cordon(self) -> None:
+        """Endpoint removal: readiness off, admission stays open."""
+        try:
+            req = urllib.request.Request(
+                self.url + "/3/Cordon",
+                data=json.dumps({"reason": "rollout"}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except Exception:  # noqa: BLE001 — a dead pod cordons itself
+            pass
+        self.state = CORDONED
+        self.cordoned_at = time.monotonic()
+
+    def terminate(self) -> None:
+        """SIGTERM → the pod's PR-4 drain path (flush batcher, settle
+        jobs, exit 0 inside H2O_TPU_DRAIN_TIMEOUT)."""
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.terminate()
+            except ProcessLookupError:
+                pass
+        self.state = DRAINING
+        self.drain_at = time.monotonic()
+
+    def kill(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            try:
+                self.proc.kill()
+            except ProcessLookupError:
+                pass
+
+
+class Reconciler:
+    """Converge a pool of ScorerReplicas to its ScorerPoolSpec."""
+
+    def __init__(self, store: PoolStore, registry: ModelRegistry,
+                 pool: str, log_dir: str | None = None,
+                 replica_factory=None):
+        self.store = store
+        self.registry = registry
+        self.pool = pool
+        self.log_dir = log_dir
+        # injectable for tests: factory(rid, version, spec) -> replica
+        self.replica_factory = replica_factory or (
+            lambda rid, version, spec: ScorerReplica(
+                rid, version, spec, log_dir=self.log_dir))
+        self.replicas: list = []
+        self._seq = 0
+        self._last_totals: dict | None = None   # autoscale deltas
+        self._lock = threading.Lock()           # replicas list mutation
+        self._stopped = False                   # shutdown() flips it
+
+    # -- events / status ------------------------------------------------------
+
+    def _event(self, kind: str, msg: str = "") -> None:
+        self.store.record_event(self.pool, kind, msg)
+        from ..diagnostics import log
+
+        log.warning("operator[%s]: %s %s", self.pool, kind, msg)
+
+    def endpoints(self) -> list[str]:
+        """Routable endpoint URLs — the Service-endpoints analog.
+        Cordoned/draining replicas are OUT the instant they cordon;
+        not-yet-ready ones are included (the load generator's
+        readiness poller filters on /readyz, like kube-proxy on
+        endpoint readiness)."""
+        with self._lock:
+            return [r.url for r in self.replicas
+                    if r.state in CAPACITY_STATES]
+
+    def status(self) -> dict:
+        with self._lock:
+            reps = list(self.replicas)
+        return {
+            "replicas": [{"id": r.rid, "state": r.state,
+                          "version": r.version, "port": r.port,
+                          "pid": r.pid()} for r in reps],
+            "ready": sum(1 for r in reps if r.state == READY),
+        }
+
+    def converged(self, spec: ScorerPoolSpec | None = None) -> bool:
+        if spec is None:
+            spec, _ = self.store.get(self.pool)
+        with self._lock:
+            reps = list(self.replicas)
+        # alive() is checked HERE, not just at reconcile time: a
+        # replica SIGKILLed an instant ago is still READY in controller
+        # state until the next pass observes it, and a wait_converged
+        # racing that pass must not declare victory over a dead pod
+        current_ready = [r for r in reps if r.state == READY
+                         and r.version == spec.version and r.alive()]
+        leftovers = [r for r in reps if r.state != DEAD
+                     and not (r.state == READY
+                              and r.version == spec.version
+                              and r.alive())]
+        return len(current_ready) == spec.replicas and not leftovers
+
+    # -- the loop -------------------------------------------------------------
+
+    def _spawn(self, version: int, spec: ScorerPoolSpec):
+        with self._lock:
+            if self._stopped:
+                return None
+            self._seq += 1
+            rid = f"{self.pool}-{self._seq}"
+        r = self.replica_factory(rid, version, spec)
+        r.spawn()
+        with self._lock:
+            if self._stopped:
+                # shutdown() completed between the check above and the
+                # Popen: the torn-down pool must not gain a live pod
+                # nothing will ever terminate — kill it right here
+                r.kill()
+                r.mark_dead()
+                return None
+            self.replicas.append(r)
+        self._event("replica_start",
+                    f"{rid} v{version} port={getattr(r, 'port', '?')}")
+        return r
+
+    def reconcile_once(self) -> None:
+        if self._stopped:
+            # shutdown() won the race with a still-running run() loop:
+            # reconciling now would re-provision the pool it just tore
+            # down and leak pods past the caller's teardown
+            return
+        spec, gen = self.store.get(self.pool)
+        now = time.monotonic()
+        deadline = _startup_deadline()
+        grace = _deregister_grace()
+
+        # 1. observe process deaths (replica-kill converges from here)
+        for r in list(self.replicas):
+            if r.state in (DEAD, PENDING):
+                continue
+            if not r.alive():
+                if r.state == DRAINING:
+                    self._event("replica_exit",
+                                f"{r.rid} drained and exited")
+                elif r.state == CORDONED:
+                    self._event("replica_exit",
+                                f"{r.rid} exited while cordoned")
+                else:
+                    self._event("replica_died",
+                                f"{r.rid} v{r.version} "
+                                f"(port {r.port}) exited unexpectedly")
+                r.mark_dead()
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.state != DEAD]
+
+        # 2. advance startups: healthz → push+warm → readyz
+        for r in self.replicas:
+            if r.state == STARTING:
+                if r.healthz_ok():
+                    r.start_load(self.registry)
+                    buckets = "env default" if r.warm_buckets is None \
+                        else str(list(r.warm_buckets))
+                    self._event("replica_load",
+                                f"{r.rid} pushing {r.artifact} "
+                                f"v{r.version} + warming {buckets}")
+                elif now - r.created_at > deadline:
+                    self._event("replica_startup_timeout",
+                                f"{r.rid} no /healthz after "
+                                f"{deadline:.0f}s — replacing")
+                    r.kill()
+                    r.mark_dead()
+            elif r.state == LOADING:
+                err = r.load_error()
+                if err is not None:
+                    self._event("replica_load_failed",
+                                f"{r.rid}: {err}")
+                    r.kill()
+                    r.mark_dead()
+                elif r.load_finished() and r.readyz_ok():
+                    r.state = READY
+                    self._event("replica_ready",
+                                f"{r.rid} v{r.version} warmed — "
+                                "readyz green")
+                elif now - r.created_at > deadline:
+                    self._event("replica_startup_timeout",
+                                f"{r.rid} not READY after "
+                                f"{deadline:.0f}s — replacing")
+                    r.kill()
+                    r.mark_dead()
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.state != DEAD]
+
+        # 3. cordoned replicas past the deregister grace drain now;
+        # wedged drains get SIGKILL well past the pod's own budget
+        drain_budget = _env_float("H2O_TPU_DRAIN_TIMEOUT", 30.0)
+        for r in self.replicas:
+            if r.state == CORDONED and now - r.cordoned_at >= grace:
+                r.terminate()
+                self._event("replica_drain",
+                            f"{r.rid} SIGTERM after {grace:.2f}s "
+                            "deregister grace")
+            elif r.state == DRAINING and \
+                    now - r.drain_at > drain_budget + 15.0:
+                self._event("replica_drain_wedged",
+                            f"{r.rid} still alive "
+                            f"{drain_budget + 15:.0f}s after SIGTERM "
+                            "— SIGKILL")
+                r.kill()
+
+        # 4. converge version + count (surge-one rolling update)
+        want = spec.version
+        # stale replicas that never went READY are superseded work —
+        # kill outright, nothing routes to them
+        for r in list(self.replicas):
+            if r.version != want and r.state in (STARTING, LOADING):
+                self._event("replica_superseded",
+                            f"{r.rid} v{r.version} superseded by "
+                            f"v{want} before READY")
+                r.kill()
+                r.mark_dead()
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.state != DEAD]
+        capacity = [r for r in self.replicas
+                    if r.state in CAPACITY_STATES]
+        current = [r for r in capacity if r.version == want]
+        stale_ready = [r for r in capacity
+                       if r.version != want and r.state == READY]
+        ready = [r for r in capacity if r.state == READY]
+
+        if len(current) < spec.replicas and \
+                len(capacity) < spec.replicas + 1:
+            # scale up / replace dead / surge the rollout — one spawn
+            # per pass keeps the surge at one
+            self._spawn(want, spec)
+        elif stale_ready and len(ready) > spec.replicas:
+            # a new-version replica is READY beyond the desired count:
+            # retire ONE old-version replica — cordon first (routers
+            # drop the endpoint), drain after the grace (step 3)
+            victim = stale_ready[0]
+            victim.cordon()
+            self._event("replica_cordon",
+                        f"{victim.rid} v{victim.version} cordoned "
+                        f"(rollout to v{want})")
+        elif not stale_ready and len(current) > spec.replicas:
+            # spec resize down: prefer retiring a not-yet-ready spare
+            spares = [r for r in current if r.state != READY]
+            if spares:
+                victim = spares[-1]
+                self._event("replica_scaled_down",
+                            f"{victim.rid} (not yet ready) stopped — "
+                            f"replicas={spec.replicas}")
+                victim.kill()
+                victim.mark_dead()
+            else:
+                victim = current[-1]
+                victim.cordon()
+                self._event("replica_cordon",
+                            f"{victim.rid} cordoned (scale down to "
+                            f"{spec.replicas})")
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.state != DEAD]
+
+        # 5. publish observed status
+        st = self.status()
+        by_version: dict[str, int] = {}
+        for r in st["replicas"]:
+            if r["state"] == READY:
+                by_version[str(r["version"])] = \
+                    by_version.get(str(r["version"]), 0) + 1
+        self.store.set_status(self.pool, {
+            "generation_observed": gen,
+            "desired_replicas": spec.replicas,
+            "desired_version": spec.version,
+            "ready_by_version": by_version,
+            "converged": self.converged(spec),
+            **st,
+        })
+
+    def run(self, stop: threading.Event,
+            interval: float | None = None) -> None:
+        """Blocking loop (callers thread it); autoscale piggybacks on
+        the same cadence when the spec opts in."""
+        while not stop.is_set():
+            try:
+                self.reconcile_once()
+                self.autoscale_once()
+            except Exception as e:  # noqa: BLE001 — the loop survives
+                self._event("reconcile_error", repr(e)[:300])
+            stop.wait(interval if interval is not None else _interval())
+
+    def wait_converged(self, timeout: float = 120.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.converged():
+                return True
+            time.sleep(0.1)
+        return self.converged()
+
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Drain every replica (tests/drills teardown): stop
+        reconciling first (a racing run() pass must not re-provision
+        what this tears down), SIGTERM all, SIGKILL stragglers at the
+        deadline."""
+        with self._lock:
+            # one atomic step: after this, _spawn either sees _stopped
+            # (and kills its own pod) or its replica is in this
+            # snapshot — no pod can fall between the two
+            self._stopped = True
+            reps = list(self.replicas)
+        for r in reps:
+            if r.state not in (DEAD,):
+                r.terminate()
+        deadline = time.monotonic() + timeout
+        for r in reps:
+            while r.alive() and time.monotonic() < deadline:
+                time.sleep(0.1)
+            if r.alive():
+                r.kill()
+            r.mark_dead()
+        with self._lock:
+            self.replicas = []
+
+    # -- autoscale ------------------------------------------------------------
+
+    def autoscale_once(self) -> int | None:
+        """Scrape /3/Stats off READY replicas and apply the autoscale
+        signal to the spec (when ``spec.autoscale``); returns the new
+        desired count or None when disabled/unchanged."""
+        spec, _ = self.store.get(self.pool)
+        if not spec.autoscale:
+            return None
+        with self._lock:
+            ready = [r for r in self.replicas if r.state == READY]
+        samples = [s for s in (r.stats() for r in ready) if s]
+        from .autoscale import desired_replicas
+
+        desired, why, totals = desired_replicas(
+            spec, samples, self._last_totals)
+        self._last_totals = totals
+        if desired != spec.replicas:
+            self.store.apply_update(self.pool, replicas=desired)
+            self._event("autoscale",
+                        f"replicas {spec.replicas} -> {desired} "
+                        f"({why})")
+            return desired
+        return None

@@ -1,0 +1,140 @@
+"""H2OScorerPool spec model + the dict-backed in-process API server.
+
+The reference operator watches an ``H2O`` CRD in the kube API server
+and reconciles StatefulSets against it (SURVEY.md §5.6); here the
+"API server" is an in-process, thread-safe store with the same
+observable semantics — specs carry a monotonically increasing
+``generation``, status is written by the controller, and events are a
+bounded log — so the reconciler is written against an interface a
+kubeconfig-backed store can implement later without changing it.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field, replace
+
+__all__ = ["ScorerPoolSpec", "PoolStore"]
+
+
+@dataclass(frozen=True)
+class ScorerPoolSpec:
+    """Declarative spec of one scorer pool (the CRD analog).
+
+    ``artifact``/``version`` name a model-registry artifact
+    (registry.publish's name + version); ``model_key`` is the stable
+    REST key replicas serve it under — it stays the same across
+    versions so client URLs survive rolling updates.
+    """
+
+    name: str                      # pool name (store key)
+    artifact: str                  # registry artifact name
+    version: int                   # pinned artifact version (rolls on change)
+    model_key: str = "model"       # MODELS key on every replica
+    replicas: int = 1              # desired serving replicas
+    min_replicas: int = 1          # autoscale floor
+    max_replicas: int = 8          # autoscale ceiling
+    autoscale: bool = False        # reconciler adjusts `replicas` itself
+    # pow2 batches pre-traced before readyz; None = let each REPLICA
+    # resolve H2O_TPU_POOL_WARM_BUCKETS (default 128,1024) — pinning a
+    # tuple here overrides the env knob for this pool
+    warm_buckets: tuple | None = None
+    env: dict = field(default_factory=dict)   # extra pod env overrides
+
+    def validate(self) -> "ScorerPoolSpec":
+        if not self.name or not self.artifact or not self.model_key:
+            raise ValueError("pool spec needs name, artifact and "
+                             "model_key")
+        if self.version < 1:
+            raise ValueError(f"version must be >= 1, got {self.version}")
+        if self.replicas < 0:
+            raise ValueError(f"replicas must be >= 0, got "
+                             f"{self.replicas}")
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError(
+                f"need 1 <= min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+        if self.warm_buckets is not None and not self.warm_buckets:
+            raise ValueError("warm_buckets must name at least one "
+                             "batch bucket, or be None to defer to "
+                             "the replica's H2O_TPU_POOL_WARM_BUCKETS")
+        return self
+
+
+_EVENT_CAP = 256        # bounded: a flapping pool must not grow memory
+
+
+class PoolStore:
+    """Thread-safe dict-backed spec/status/event store (etcd analog)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: dict[str, ScorerPoolSpec] = {}
+        self._gens: dict[str, int] = {}
+        self._status: dict[str, dict] = {}
+        self._events: dict[str, collections.deque] = {}
+
+    # -- spec (the declarative side) ------------------------------------------
+
+    def apply(self, spec: ScorerPoolSpec, **updates) -> int:
+        """Create or update a pool spec; field updates may be passed as
+        kwargs against the stored spec (``store.apply(spec)`` or
+        ``store.apply_update(name, replicas=3)`` style). Returns the
+        new generation. No-op updates still bump the generation — the
+        reconciler is level-triggered, so that is harmless."""
+        spec = replace(spec, **updates).validate() if updates \
+            else spec.validate()
+        with self._lock:
+            self._specs[spec.name] = spec
+            self._gens[spec.name] = self._gens.get(spec.name, 0) + 1
+            return self._gens[spec.name]
+
+    def apply_update(self, name: str, **updates) -> int:
+        with self._lock:
+            cur = self._specs.get(name)
+        if cur is None:
+            raise KeyError(f"no pool '{name}'")
+        return self.apply(replace(cur, **updates))
+
+    def get(self, name: str) -> tuple[ScorerPoolSpec, int]:
+        with self._lock:
+            if name not in self._specs:
+                raise KeyError(f"no pool '{name}'")
+            return self._specs[name], self._gens[name]
+
+    def pools(self) -> list[str]:
+        with self._lock:
+            return sorted(self._specs)
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            self._specs.pop(name, None)
+            self._gens.pop(name, None)
+            self._status.pop(name, None)
+            self._events.pop(name, None)
+
+    # -- status + events (the observed side) ----------------------------------
+
+    def set_status(self, name: str, status: dict) -> None:
+        with self._lock:
+            self._status[name] = dict(status)
+
+    def get_status(self, name: str) -> dict:
+        with self._lock:
+            return dict(self._status.get(name, {}))
+
+    def record_event(self, name: str, kind: str, msg: str = "") -> None:
+        """Append one operator event (bounded ring; the drill
+        acceptance reads the replica_died → replica_start →
+        replica_ready sequence out of this)."""
+        ev = {"t": time.time(), "kind": kind, "msg": msg}
+        with self._lock:
+            dq = self._events.setdefault(
+                name, collections.deque(maxlen=_EVENT_CAP))
+            dq.append(ev)
+
+    def events(self, name: str) -> list[dict]:
+        with self._lock:
+            return list(self._events.get(name, ()))

@@ -52,6 +52,81 @@ GRIDS: dict[str, object] = {}      # grid_id -> GridSearch
 _ID_LOCK = threading.Lock()
 _MODEL_SEQ = 0
 
+# -- scorer-pool replica surface (operator/, docs/OPERATOR.md) --------------
+#
+# READINESS_GATES: extra predicates AND-ed into /readyz beyond the
+# lifecycle conjunction (SERVING ∧ breaker ∧ healthy). A gate returns
+# (ok, reason); the model-registry gate below holds a pool replica
+# unready until an artifact has been pushed AND its pow2 batch buckets
+# pre-traced — the warm-up contract: no router sends traffic to a
+# replica that would pay a compile on its first request.
+READINESS_GATES: dict[str, object] = {}
+
+# model_id -> {name, version, algo, warmed_buckets,
+#              warm_baseline_misses, loaded_at} for artifacts loaded
+# over POST /3/ModelRegistry/load. `warm_baseline_misses` snapshots
+# the global scorer-cache miss counter right after warm-up, so
+# /3/Stats can report warm_cache_misses (misses since the replica
+# went warm — 0 is the contract; meaningful on single-model pods,
+# which is what the operator provisions).
+REGISTRY_MODELS: dict[str, dict] = {}
+
+# REST-level counters scraped by the operator's autoscale signal
+# (GET /3/Stats): 504s from expired X-H2O-Deadline-Ms budgets, and
+# scoring requests admitted while the node could not serve readiness
+# (cordon excluded — a cordoned replica serving routed stragglers is
+# the rolling-update contract, not a violation). Incremented under
+# _STATS_LOCK: handler threads race, and a lost increment would
+# suppress an autoscale scale-up for a scrape window.
+STATS = {"deadline_504": 0, "scored_while_unready": 0}
+_STATS_LOCK = threading.Lock()
+
+
+def _bump_stat(key: str) -> None:
+    with _STATS_LOCK:
+        STATS[key] += 1
+
+
+def _registry_gate():
+    if REGISTRY_MODELS:
+        return True, ""
+    return False, "no model artifact loaded+warmed yet"
+
+
+def install_pool_replica_gate() -> None:
+    """Make /readyz require a warmed registry artifact (scorer-pool
+    replicas; also installed by start_server when
+    H2O_TPU_POOL_REPLICA=1 so the plain rest.py entry can be a pool
+    pod)."""
+    READINESS_GATES["model-registry"] = _registry_gate
+
+
+def _ready_state(ignore_cordon: bool = False) -> tuple[bool, list, dict]:
+    """(ready, reasons, lifecycle status) — THE readiness computation,
+    shared by /readyz, /3/Stats and the scored_while_unready counter.
+    ``ignore_cordon`` gives capability-readiness: a cordoned node is
+    routing-unready (routers must drop it) but still serving-capable
+    (admission stays open for stragglers during the deregister
+    grace)."""
+    st = lifecycle.status()
+    reasons = []
+    if st["state"] != lifecycle.SERVING:
+        reasons.append(f"state={st['state']}")
+    if st["breaker"]["state"] == "open":
+        reasons.append("breaker=open")
+    if not st["healthy"]:
+        reasons.append("cloud unhealthy")
+    for name, gate in list(READINESS_GATES.items()):
+        try:
+            ok, why = gate()
+        except Exception as e:  # noqa: BLE001 — a buggy gate must fail
+            ok, why = False, f"error: {e!r}"    # unready, not crash /readyz
+        if not ok:
+            reasons.append(f"gate:{name}: {why}")
+    if not ignore_cordon and st.get("cordoned"):
+        reasons.append(f"cordoned: {st['cordoned']}")
+    return (not reasons), reasons, st
+
 
 # ---------------------------------------------------------------------------
 # Scoring micro-batcher
@@ -191,6 +266,26 @@ class ScoreBatcher:
             self._pending.append(job)
             self.stats["requests"] += 1
             self._cond.notify_all()
+        # admitted: account serving-while-not-capable. The full
+        # _ready_state() would add several lock acquisitions per
+        # request on the serving hot path; at this point the admission
+        # checks above already ruled out draining/unhealthy/open-
+        # breaker, so the only remaining capability gaps are state !=
+        # SERVING and an unsatisfied readiness gate (the warm-up gate)
+        # — test exactly those, cheaply. Cordon deliberately excluded
+        # (see STATS).
+        unready = lifecycle.state() != lifecycle.SERVING
+        if not unready:
+            for _name, gate in list(READINESS_GATES.items()):
+                try:
+                    ok, _why = gate()
+                except Exception:  # noqa: BLE001 — buggy gate reads
+                    ok = False     # unready, same as _ready_state
+                if not ok:
+                    unready = True
+                    break
+        if unready:
+            _bump_stat("scored_while_unready")
         if not job.event.wait(wait_s):
             if deadline is not None and time.monotonic() >= deadline:
                 # the CLIENT's budget ran out while queued: 504, same
@@ -236,6 +331,12 @@ class ScoreBatcher:
         dispatcher thread respawns lazily on the next submit."""
         with self._cond:
             self._stopped = False
+
+    def queue_depth(self) -> int:
+        """Requests currently queued behind the dispatcher — the
+        instantaneous half of the autoscale signal (/3/Stats)."""
+        with self._cond:
+            return len(self._pending)
 
     def _loop(self) -> None:
         while True:
@@ -642,24 +743,48 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json({"alive": True, **st})
             if path == "/readyz":
                 # READINESS = SERVING ∧ breaker-not-open ∧ cloud
-                # healthy: flips the instant a drain begins (or the
-                # breaker trips), while /healthz stays green — the
-                # Service stops routing long before the kubelet kills
-                st = lifecycle.status()
-                ready = (st["state"] == lifecycle.SERVING
-                         and st["breaker"]["state"] != "open"
-                         and st["healthy"])
+                # healthy ∧ every READINESS_GATE ∧ not cordoned: flips
+                # the instant a drain begins (or the breaker trips, or
+                # the operator cordons this replica), while /healthz
+                # stays green — the Service stops routing long before
+                # the kubelet kills
+                ready, reasons, st = _ready_state()
                 if ready:
                     return self._json({"ready": True, **st})
-                reasons = []
-                if st["state"] != lifecycle.SERVING:
-                    reasons.append(f"state={st['state']}")
-                if st["breaker"]["state"] == "open":
-                    reasons.append("breaker=open")
-                if not st["healthy"]:
-                    reasons.append("cloud unhealthy")
                 return self._json({"ready": False,
                                    "reasons": reasons, **st}, 503)
+            if path == "/3/Stats":
+                # ONE scrape for operators + the autoscale signal:
+                # process-local serving counters that were previously
+                # invisible over REST (scorer cache, admission queue
+                # depth/shed, breaker, deadline 504s, registry warm
+                # state). Device-free: safe to poll on a wedged node.
+                from .models.base import scorer_cache_stats
+
+                ready, reasons, st = _ready_state()
+                sc = scorer_cache_stats()
+                reg = {}
+                for mid, info in list(REGISTRY_MODELS.items()):
+                    reg[mid] = {
+                        "name": info.get("name"),
+                        "version": info.get("version"),
+                        "algo": info.get("algo"),
+                        "warmed_buckets": info.get("warmed_buckets"),
+                        "warm_cache_misses": sc["misses"]
+                        - info.get("warm_baseline_misses", sc["misses"]),
+                    }
+                return self._json({
+                    "ready": ready, "reasons": reasons, **st,
+                    "scorer_cache": sc,
+                    "batcher": {**BATCHER.stats,
+                                "queue_depth": BATCHER.queue_depth()},
+                    "counters": dict(STATS),
+                    "registry": reg})
+            if path == "/3/ModelRegistry":
+                return self._json({"models": {
+                    mid: {k: v for k, v in info.items()
+                          if k != "warm_baseline_misses"}
+                    for mid, info in REGISTRY_MODELS.items()}})
             if path in ("", "/flow", "/flow/index.html"):
                 # the h2o-web Flow analog (SURVEY §2b C19): one
                 # self-contained page, same REST verbs as any client
@@ -753,13 +878,21 @@ class _Handler(BaseHTTPRequestHandler):
 
                     from .mojo import export_mojo
 
-                    # fixed artifact name inside the tempdir: model keys
-                    # come verbatim from POST bodies, so using them as a
-                    # path component would allow ../ traversal out of td
-                    with tempfile.TemporaryDirectory() as td:
-                        p = export_mojo(m, os.path.join(td, "model.mojo"))
-                        with open(p, "rb") as f:
-                            blob = f.read()
+                    if hasattr(m, "export_artifact"):
+                        # a registry FlatTreeScorer has no heap trees
+                        # for export_mojo to walk — it serves its kept
+                        # artifact parts directly
+                        blob = m.export_artifact()
+                    else:
+                        # fixed artifact name inside the tempdir: model
+                        # keys come verbatim from POST bodies, so using
+                        # them as a path component would allow ../
+                        # traversal out of td
+                        with tempfile.TemporaryDirectory() as td:
+                            p = export_mojo(
+                                m, os.path.join(td, "model.mojo"))
+                            with open(p, "rb") as f:
+                                blob = f.read()
                     # header filename: strip path separators, quotes and
                     # control chars (CRLF here = response splitting)
                     safe = "".join(
@@ -826,11 +959,27 @@ class _Handler(BaseHTTPRequestHandler):
                 # unparseable X-H2O-Deadline-Ms — a ValueError from a
                 # route handler below is a server bug and must 500
                 return self._error(400, str(e))
+            if path in ("/3/Cordon", "/3/Uncordon"):
+                # ops verbs, device-free and allowed on an UNHEALTHY
+                # node (the operator must be able to pull a sick
+                # replica out of rotation): flip routing-readiness
+                # without touching admission — the rolling-update
+                # endpoint-removal step (docs/OPERATOR.md)
+                if path == "/3/Cordon":
+                    lifecycle.cordon(str(params.get("reason")
+                                         or "operator"))
+                else:
+                    lifecycle.uncordon()
+                ready, reasons, st = _ready_state()
+                return self._json({"ready": ready,
+                                   "reasons": reasons, **st})
             # every POST verb does device work (parse shards onto the
             # mesh, builds/predictions dispatch collectives): on a dead
             # cloud degrade to 503 up front — reads (GET) stay served
             if self._unhealthy_503():
                 return None
+            if path == "/3/ModelRegistry/load":
+                return self._registry_load(params)
             if path == "/3/ImportFiles" or path == "/3/Parse":
                 from .frame import import_file
 
@@ -883,6 +1032,7 @@ class _Handler(BaseHTTPRequestHandler):
         except _DeadlineExpired as e:
             # the client's budget ran out before we dispatched: 504,
             # zero device work wasted on an answer nobody is awaiting
+            _bump_stat("deadline_504")
             return self._error(504, str(e))
         except QueueFullError as e:
             # load shedding: the admission queue is full — fast 429 +
@@ -942,6 +1092,72 @@ class _Handler(BaseHTTPRequestHandler):
                     pass
             kw[k] = v
         return kw
+
+    def _registry_load(self, params: dict):
+        """POST /3/ModelRegistry/load — the operator push route: load a
+        MOJO-v2 artifact (by persist path or inline base64 bytes),
+        pre-trace its pow2 batch buckets, and ONLY THEN publish it
+        under ``model_id`` — so the model-registry readiness gate (and
+        a rolling update's traffic shift) can never observe a model
+        that would compile on its first request."""
+        import base64
+        import hashlib
+
+        from . import persist
+        from .models.base import scorer_cache_stats
+        from .operator.registry import load_artifact
+
+        model_id = params.get("model_id")
+        if not model_id or not isinstance(model_id, str):
+            return self._error(400, "missing 'model_id'")
+        b64 = params.get("artifact_b64")
+        path = params.get("path")
+        if b64:
+            try:
+                blob = base64.b64decode(b64, validate=True)
+            except Exception:  # noqa: BLE001 — binascii detail useless
+                return self._error(400, "bad 'artifact_b64' (not valid "
+                                   "base64)")
+        elif path:
+            try:
+                blob = persist.read_bytes(str(path))
+            except FileNotFoundError:
+                return self._error(404, f"artifact not found at "
+                                   f"{path!r}")
+        else:
+            return self._error(400, "need 'path' (persist-readable "
+                               "artifact) or 'artifact_b64'")
+        want_sha = params.get("sha256")
+        if want_sha:
+            got = hashlib.sha256(blob).hexdigest()
+            if got != str(want_sha):
+                return self._error(
+                    409, f"artifact digest mismatch (got {got[:12]}, "
+                    f"registry says {str(want_sha)[:12]}) — refusing "
+                    "to serve a corrupted model")
+        try:
+            model = load_artifact(blob)
+        except ValueError as e:
+            return self._error(400, f"unservable artifact: {e}")
+        buckets = params.get("warm_buckets")
+        try:
+            warmed = model.warm_up(buckets)
+        except ValueError as e:
+            return self._error(400, str(e))
+        MODELS[model_id] = model
+        REGISTRY_MODELS[model_id] = {
+            "name": params.get("name"),
+            "version": params.get("version"),
+            "algo": model.algo,
+            "warmed_buckets": warmed,
+            "warm_baseline_misses": scorer_cache_stats()["misses"],
+            "loaded_at": time.time(),
+        }
+        return self._json({"model_id": {"name": model_id},
+                           "name": params.get("name"),
+                           "version": params.get("version"),
+                           "algo": model.algo,
+                           "warmed_buckets": warmed})
 
     def _score_rows(self, model, mkey: str, params: dict,
                     deadline: float | None = None):
@@ -1167,6 +1383,11 @@ def start_server(port: int = 54321, host: str = "127.0.0.1",
     completes — inside ``terminationGracePeriodSeconds``, ahead of the
     kubelet's SIGKILL."""
     srv = ThreadingHTTPServer((host, port), _Handler)
+    if os.environ.get("H2O_TPU_POOL_REPLICA") == "1":
+        # operator-provisioned scorer replica: readiness additionally
+        # requires a pushed+warmed registry artifact, so the Service
+        # never routes to a pod that would compile on request one
+        install_pool_replica_gate()
     lifecycle.mark_serving()
     # one module-level hook over the set of live servers (not one hook
     # per start_server call): register_shutdown is idempotent by

@@ -66,6 +66,7 @@ __all__ = [
     "breaker_guard", "state", "accepting", "mark_serving", "begin_drain",
     "drain", "install_sigterm", "remaining_drain_budget", "status",
     "register_shutdown", "terminated", "wait_terminated", "reset",
+    "cordon", "uncordon", "cordoned",
 ]
 
 STARTING = "STARTING"
@@ -297,6 +298,12 @@ class _Lifecycle:
         # clobbering the restarted node (forcing TERMINATED over
         # SERVING, shutting down the new server, os._exit-ing)
         self._epoch = 0
+        # cordon = endpoint removal WITHOUT draining: /readyz goes 503
+        # so routers stop sending, while admission stays open so
+        # requests already routed here still get served (the k8s
+        # endpoints-removed-before-SIGTERM window the operator's
+        # rolling updates rely on for zero 5xx)
+        self._cordoned: str | None = None
 
     # -- queries --------------------------------------------------------------
 
@@ -327,6 +334,27 @@ class _Lifecycle:
         with self._lock:
             if self._state == STARTING:
                 self._state = SERVING
+
+    def cordon(self, reason: str = "operator") -> None:
+        """Flip readiness off WITHOUT refusing work: the pool
+        reconciler cordons a replica, waits the deregister grace (so
+        routers drop the endpoint), and only then begins the drain —
+        in-flight and straggler requests still score."""
+        with self._lock:
+            self._cordoned = reason or "cordoned"
+        from ..diagnostics import log, timeline
+
+        timeline.record("cordon", reason)
+        log.warning("lifecycle: cordoned (%s) — readiness off, "
+                    "admission still open", reason)
+
+    def uncordon(self) -> None:
+        with self._lock:
+            self._cordoned = None
+
+    def cordoned(self) -> str | None:
+        with self._lock:
+            return self._cordoned
 
     def register_shutdown(self, cb: Callable[[], None]) -> None:
         """Hook run at the END of the drain (after batcher flush and
@@ -535,6 +563,7 @@ class _Lifecycle:
             self._drain_thread = None
             self._callbacks.clear()
             self._exit_on_drain = False
+            self._cordoned = None
             self._terminated = threading.Event()
         BREAKER.reset()
 
@@ -594,6 +623,18 @@ def reset() -> None:
     LIFECYCLE.reset()
 
 
+def cordon(reason: str = "operator") -> None:
+    LIFECYCLE.cordon(reason)
+
+
+def uncordon() -> None:
+    LIFECYCLE.uncordon()
+
+
+def cordoned() -> str | None:
+    return LIFECYCLE.cordoned()
+
+
 def status() -> dict:
     """One JSON-able snapshot for /healthz and operators."""
     from . import health
@@ -601,4 +642,5 @@ def status() -> dict:
     return {"state": LIFECYCLE.state(),
             "healthy": health.healthy(),
             "breaker": BREAKER.status(),
+            "cordoned": LIFECYCLE.cordoned(),
             "drain_budget_s": LIFECYCLE.remaining_drain_budget()}

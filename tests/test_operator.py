@@ -1,0 +1,615 @@
+"""Operator scorer fleets (ISSUE 6 tentpole): the model registry's
+MOJO-v2 round trip must be bitwise (the replica scorer descends the
+SAME flat arrays with the SAME flat_margin executable), format-v1
+artifacts must reject, the warm-up contract (pow2 ladder pre-traced →
+zero misses on first traffic) must pin, and the reconcile loop must
+converge on replica death, spec resize, and artifact change — driven
+here with fake replicas (pure orchestration; the real-subprocess legs
+live in tools/chaos.py's rolling-update and replica-kill drills)."""
+
+import io
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+import zipfile
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import rest
+from h2o_kubernetes_tpu.models import GBM, GLM
+from h2o_kubernetes_tpu.models.base import Model, scorer_cache_stats
+from h2o_kubernetes_tpu.mojo import read_mojo_parts
+from h2o_kubernetes_tpu.operator import (FlatTreeScorer, ModelRegistry,
+                                         PoolStore, Reconciler,
+                                         ScorerPoolSpec, load_artifact)
+from h2o_kubernetes_tpu.operator.autoscale import desired_replicas
+from h2o_kubernetes_tpu.operator.reconcile import (CORDONED, DEAD,
+                                                   DRAINING, LOADING,
+                                                   READY, STARTING)
+
+pytestmark = pytest.mark.chaos
+
+from test_flat_scorer import _rich_frame  # noqa: E402 — the shared
+# parity fixture (NAs, high-card enums, weights, offset); bare module
+# import because tests/ is pytest-inserted, not a package
+
+
+def _gbm(fr, seed=1, **kw):
+    kw.setdefault("ntrees", 5)
+    kw.setdefault("max_depth", 3)
+    kw.setdefault("nbins", 64)
+    return GBM(seed=seed, **kw).train(y="y", training_frame=fr)
+
+
+# ---------------------------------------------------------------------------
+# Registry: artifact round trip
+# ---------------------------------------------------------------------------
+
+
+def test_registry_roundtrip_bitwise(mesh8):
+    """MOJO-v2 bytes written by the registry load bitwise-identically
+    on a scorer replica: flat arrays equal to the source model's
+    flattening, and score_numpy output bitwise-equal — NAs, high-card
+    grouped enums and all (the test_flat_scorer parity frame)."""
+    fr = _rich_frame(n=600, seed=13)
+    m = _gbm(fr)
+    reg = ModelRegistry("mem://test_roundtrip")
+    v = reg.publish(m, "scorer")
+    blob = reg.fetch("scorer", v)
+    meta, arrays, _ = read_mojo_parts(io.BytesIO(blob))
+    flat = m._flat()
+    for f in ("split_feat", "thresh", "left", "na_left", "value"):
+        assert np.array_equal(arrays[f"flat_{f}"],
+                              np.asarray(getattr(flat, f))), f
+    sc = load_artifact(blob)
+    assert isinstance(sc, FlatTreeScorer) and sc._serving_jit
+    X = np.asarray(m._design_matrix(fr))[: fr.nrows]
+    assert np.array_equal(sc.score_numpy(X), m.score_numpy(X))
+    # schema travels: feature names/domains drive the REST row parser
+    assert sc.feature_names == m.feature_names
+    assert sc.feature_domains == m.feature_domains
+    assert sc.response_domain == m.response_domain
+
+
+def test_registry_versions_and_digest(mesh8):
+    fr = _rich_frame(n=400, seed=3)
+    reg = ModelRegistry("mem://test_versions")
+    v1 = reg.publish(_gbm(fr, seed=1), "scorer")
+    v2 = reg.publish(_gbm(fr, seed=2, ntrees=7), "scorer")
+    assert (v1, v2) == (1, 2)
+    assert reg.latest("scorer") == 2
+    assert reg.fetch("scorer", 1) != reg.fetch("scorer", 2)
+    with pytest.raises(KeyError):
+        reg.latest("nope")
+    # corrupted blob must refuse to serve
+    from h2o_kubernetes_tpu import persist
+
+    path = reg.artifact_path("scorer", 2)
+    persist.write_bytes(path, b"garbage" + reg.fetch("scorer", 1))
+    with pytest.raises(IOError, match="digest"):
+        reg.fetch("scorer", 2)
+
+
+def test_registry_rejects_v1_artifact(mesh8):
+    """A format-v1 artifact (heap trees + edges, pre-flattening) has
+    no serving arrays — the registry load must reject it cleanly."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("model.json", json.dumps(
+            {"format": "h2o_kubernetes_tpu/mojo/1", "algo": "gbm"}))
+        nz = io.BytesIO()
+        np.savez_compressed(nz)
+        z.writestr("arrays.npz", nz.getvalue())
+    with pytest.raises(ValueError, match="format-v1"):
+        load_artifact(buf.getvalue())
+    # non-zip garbage: loud, not a crash deeper in
+    with pytest.raises(Exception):
+        load_artifact(b"not a zip at all")
+
+
+def test_flat_scorer_pickle_roundtrip(tmp_path, mesh8):
+    """A registry scorer must survive save_model/load_model: the base
+    __getstate__ drops _flat_trees assuming a lazy rebuild from heap
+    trees, which a FlatTreeScorer does not have — it pickles its
+    artifact parts instead and rebuilds from them."""
+    import pickle
+
+    from h2o_kubernetes_tpu.persist import load_model, save_model
+
+    fr = _rich_frame(n=400, seed=19)
+    m = _gbm(fr, ntrees=4)
+    reg = ModelRegistry("mem://test_pickle")
+    sc = load_artifact(reg.fetch("scorer", reg.publish(m, "scorer")))
+    X = np.asarray(m._design_matrix(fr))[: fr.nrows]
+    want = sc.score_numpy(X)
+    sc2 = pickle.loads(pickle.dumps(sc))
+    assert np.array_equal(sc2.score_numpy(X), want)
+    p = str(tmp_path / "sc.model")
+    save_model(sc, p)
+    sc3 = load_model(p)
+    assert np.array_equal(sc3.score_numpy(X), want)
+
+
+def test_registry_rejects_nontree(mesh8):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=300).astype(np.float32)
+    y = np.where(x > 0, "p", "n")
+    fr = h2o.Frame.from_arrays({"x": x, "y": y})
+    glm = GLM(family="binomial").train(y="y", training_frame=fr)
+    reg = ModelRegistry("mem://test_nontree")
+    with pytest.raises(ValueError, match="scorer pool"):
+        reg.publish(glm, "scorer")
+
+
+# ---------------------------------------------------------------------------
+# Warm-up contract
+# ---------------------------------------------------------------------------
+
+
+def test_warm_up_pow2_ladder_zero_misses(mesh8):
+    """warm_up traces the FULL pow2 ladder up to the largest bucket;
+    afterwards any batch size in range adds only hits — the
+    freshly-provisioned-replica acceptance (warm_cache_misses=0 on
+    the first scoring request after readyz flips)."""
+    fr = _rich_frame(n=500, seed=21)
+    m = _gbm(fr)
+    reg = ModelRegistry("mem://test_warm")
+    sc = load_artifact(reg.fetch("scorer", reg.publish(m, "scorer")))
+    assert sc.warm_up([600]) == [128, 256, 512, 1024]
+    X = np.asarray(m._design_matrix(fr))[: fr.nrows]
+    s0 = scorer_cache_stats()
+    for n in (1, 77, 128, 200, 513, 1024):
+        sc.score_numpy(X[np.arange(n) % fr.nrows])
+    s1 = scorer_cache_stats()
+    assert s1["misses"] == s0["misses"], \
+        "a warmed replica paid a trace on in-range traffic"
+    assert s1["hits"] == s0["hits"] + 6
+
+
+def test_warm_up_validation(mesh8):
+    m = Model.__new__(Model)        # _serving_jit is False on the base
+    with pytest.raises(ValueError, match="no jitted serving scorer"):
+        m.warm_up([128])
+    fr = _rich_frame(n=300, seed=5)
+    g = _gbm(fr, ntrees=3)
+    with pytest.raises(ValueError, match="bucket"):
+        g.warm_up(["nope"])
+    with pytest.raises(ValueError, match="bucket"):
+        g.warm_up([0])
+    # a JSON string would iterate as DIGITS and silently warm the
+    # wrong ladder — must reject, not misinterpret
+    with pytest.raises(ValueError, match="string"):
+        g.warm_up("512")
+
+
+# ---------------------------------------------------------------------------
+# Reconciler orchestration (fake replicas — subprocess legs in chaos.py)
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """Scripted in-process stand-in for ScorerReplica: healthy one
+    tick after spawn, loaded+ready one tick after the push, dies on
+    terminate/kill. Lets the reconcile policy be tested in
+    milliseconds."""
+
+    def __init__(self, rid, version, spec):
+        self.rid = rid
+        self.version = int(version)
+        self.model_key = spec.model_key
+        self.artifact = spec.artifact
+        self.warm_buckets = None if spec.warm_buckets is None \
+            else tuple(spec.warm_buckets)
+        self.port = 0
+        self.state = "PENDING"
+        self.created_at = 0.0
+        self.cordoned_at = 0.0
+        self.drain_at = 0.0
+        self._alive = False
+        self._loaded = False
+        self._load_done = False
+        self.stats_payload = None
+
+    @property
+    def url(self):
+        return f"fake://{self.rid}"
+
+    def spawn(self):
+        import time
+
+        self._alive = True
+        self.state = STARTING
+        self.created_at = time.monotonic()
+
+    def alive(self):
+        return self._alive
+
+    def pid(self):
+        return None
+
+    def mark_dead(self):
+        self.state = DEAD
+
+    def healthz_ok(self):
+        return self._alive
+
+    def readyz_ok(self):
+        return self._alive and self._loaded
+
+    def stats(self):
+        return self.stats_payload
+
+    def loaded_version(self):
+        return self.version if self._loaded else None
+
+    def start_load(self, registry):
+        self.state = LOADING
+        self._loaded = True
+        self._load_done = True
+
+    def load_finished(self):
+        return self._load_done
+
+    def load_error(self):
+        return None
+
+    def cordon(self):
+        import time
+
+        self.state = CORDONED
+        self.cordoned_at = time.monotonic()
+
+    def terminate(self):
+        import time
+
+        self.state = DRAINING
+        self.drain_at = time.monotonic()
+        self._alive = False           # fake drains instantly
+
+    def kill(self):
+        self._alive = False
+
+
+def _fake_pool(replicas=2, version=1, **spec_kw):
+    store = PoolStore()
+    spec = ScorerPoolSpec(name="p", artifact="a", version=version,
+                          model_key="m", replicas=replicas, **spec_kw)
+    store.apply(spec)
+    rec = Reconciler(store, registry=None, pool="p",
+                     replica_factory=FakeReplica)
+    return store, rec
+
+
+def _settle(rec, passes=30):
+    for _ in range(passes):
+        rec.reconcile_once()
+        if rec.converged():
+            return True
+    return rec.converged()
+
+
+def test_reconciler_converges_and_replaces_dead(monkeypatch, mesh8):
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    store, rec = _fake_pool(replicas=2)
+    assert _settle(rec)
+    assert [r.state for r in rec.replicas] == [READY, READY]
+    # replica death (the SIGKILL drill's orchestration half)
+    rec.replicas[0]._alive = False
+    assert not rec.converged()
+    assert _settle(rec)
+    kinds = [e["kind"] for e in store.events("p")]
+    died = kinds.index("replica_died")
+    assert "replica_start" in kinds[died:]
+    assert "replica_ready" in kinds[died:]
+
+
+def test_reconciler_resize(monkeypatch, mesh8):
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    store, rec = _fake_pool(replicas=1, max_replicas=8)
+    assert _settle(rec)
+    store.apply_update("p", replicas=3)
+    assert _settle(rec)
+    assert sum(1 for r in rec.replicas if r.state == READY) == 3
+    store.apply_update("p", replicas=1)
+    assert _settle(rec)
+    assert sum(1 for r in rec.replicas if r.state == READY) == 1
+    # scale-down retired via cordon (never a hard kill of READY)
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "replica_cordon" in kinds
+
+
+def test_reconciler_rolling_update_surge_one(monkeypatch, mesh8):
+    """Version bump rolls surge-one: capacity never exceeds
+    replicas+1, ready count never dips below replicas once converged,
+    and the pool ends with every replica on v2."""
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    store, rec = _fake_pool(replicas=2)
+    assert _settle(rec)
+    store.apply_update("p", version=2)
+    min_ready, max_capacity = 99, 0
+    for _ in range(40):
+        rec.reconcile_once()
+        live = [r for r in rec.replicas if r.state != DEAD]
+        ready = [r for r in live if r.state == READY and r.alive()]
+        capacity = [r for r in live
+                    if r.state in (STARTING, LOADING, READY)]
+        min_ready = min(min_ready, len(ready))
+        max_capacity = max(max_capacity, len(capacity))
+        if rec.converged():
+            break
+    assert rec.converged()
+    assert min_ready >= 2, "rolling update dropped serving capacity"
+    assert max_capacity <= 3, "surge exceeded one extra replica"
+    assert all(r.version == 2 for r in rec.replicas)
+    kinds = [e["kind"] for e in store.events("p")]
+    # old replicas retire ONLY after a new-version READY exists
+    assert kinds.index("replica_cordon") > kinds.index("replica_ready")
+
+
+def test_reconciler_startup_timeout_replaces(monkeypatch, mesh8):
+    monkeypatch.setenv("H2O_TPU_POOL_STARTUP_DEADLINE", "1")
+
+    class NeverHealthy(FakeReplica):
+        def healthz_ok(self):
+            return False
+
+    store = PoolStore()
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=1,
+                               model_key="m", replicas=1))
+    made = []
+
+    def factory(rid, version, spec):
+        r = (NeverHealthy if len(made) == 0 else FakeReplica)(
+            rid, version, spec)
+        made.append(r)
+        return r
+
+    rec = Reconciler(store, registry=None, pool="p",
+                     replica_factory=factory)
+    rec.reconcile_once()            # spawns the wedged one
+    import time
+
+    time.sleep(1.1)                 # past the 1s startup deadline
+    assert _settle(rec)
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "replica_startup_timeout" in kinds
+    assert made[0].state == DEAD and len(made) == 2
+
+
+# ---------------------------------------------------------------------------
+# Autoscale signal
+# ---------------------------------------------------------------------------
+
+
+def _stats(depth=0, shed=0, d504=0, requests=0):
+    return {"batcher": {"queue_depth": depth, "shed": shed,
+                        "requests": requests},
+            "counters": {"deadline_504": d504}}
+
+
+def test_autoscale_signal(mesh8):
+    spec = ScorerPoolSpec(name="p", artifact="a", version=1,
+                          model_key="m", replicas=2, min_replicas=1,
+                          max_replicas=4)
+    # queue pressure scales up
+    n, why, tot = desired_replicas(spec, [_stats(depth=10),
+                                          _stats(depth=8)])
+    assert n == 3 and "queue depth" in why
+    # shed delta scales up (cumulative counters -> rate via prev)
+    prev = desired_replicas(spec, [_stats(shed=5)])[2]
+    n, why, _ = desired_replicas(spec, [_stats(shed=7)], prev)
+    assert n == 3 and "shed" in why
+    # deadline 504 delta scales up
+    prev = desired_replicas(spec, [_stats(d504=1)])[2]
+    n, why, _ = desired_replicas(spec, [_stats(d504=3)], prev)
+    assert n == 3 and "deadline" in why
+    # clamped at max_replicas
+    spec4 = ScorerPoolSpec(name="p", artifact="a", version=1,
+                           model_key="m", replicas=4, max_replicas=4)
+    assert desired_replicas(spec4, [_stats(depth=99)])[0] == 4
+    # idle pool scales down (zero depth, zero deltas)
+    prev = desired_replicas(spec, [_stats(requests=100)])[2]
+    n, why, _ = desired_replicas(spec, [_stats(requests=100)], prev)
+    assert n == 1 and "idle" in why
+    # live traffic holds
+    prev = desired_replicas(spec, [_stats(requests=100)])[2]
+    n, _, _ = desired_replicas(spec, [_stats(requests=150)], prev)
+    assert n == 2
+    # counter RESET (replica restart / rolling update zeroed the
+    # cumulative counters) must HOLD, not read as idleness
+    prev = desired_replicas(spec, [_stats(requests=1000)])[2]
+    n, why, _ = desired_replicas(spec, [_stats(requests=50)], prev)
+    assert n == 2 and "reset" in why
+    # no samples: hold (pool still converging)
+    assert desired_replicas(spec, [])[0] == 2
+    # floor respected
+    spec1 = ScorerPoolSpec(name="p", artifact="a", version=1,
+                           model_key="m", replicas=1, min_replicas=1)
+    prev = desired_replicas(spec1, [_stats()])[2]
+    assert desired_replicas(spec1, [_stats()], prev)[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# REST surface: /3/ModelRegistry/load, readiness gate, /3/Stats, cordon
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def pool_server(mesh8):
+    rest.install_pool_replica_gate()
+    # counters are process-global: earlier modules in a monolithic
+    # pytest run may have admitted scoring on a non-SERVING node —
+    # zero them so the ==0 assertions below measure THIS fixture's span
+    rest.STATS["scored_while_unready"] = 0
+    rest.STATS["deadline_504"] = 0
+    port = _free_port()
+    srv = rest.start_server(port)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    rest.READINESS_GATES.clear()
+    rest.REGISTRY_MODELS.clear()
+    rest.MODELS.clear()
+    from h2o_kubernetes_tpu.runtime import lifecycle
+
+    lifecycle.uncordon()
+
+
+def _get(base, path):
+    try:
+        with urllib.request.urlopen(base + path, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_registry_route_gate_and_warm_contract(pool_server):
+    """The full replica handshake in-process: gated unready -> push ->
+    warmed ready -> first scoring request with warm_cache_misses=0 and
+    scored_while_unready=0 (the two drill acceptance counters)."""
+    base = pool_server
+    code, out = _get(base, "/readyz")
+    assert code == 503
+    assert any("model-registry" in r for r in out["reasons"])
+
+    fr = _rich_frame(n=400, seed=31)
+    m = _gbm(fr, ntrees=4)
+    reg = ModelRegistry("mem://test_route")
+    v = reg.publish(m, "scorer")
+    out = reg.push(base, "scorer", v, "pm", warm_buckets=[128])
+    assert out["warmed_buckets"] == [128]
+    assert _get(base, "/readyz")[0] == 200
+    code, out = _get(base, "/3/ModelRegistry")
+    assert code == 200 and out["models"]["pm"]["version"] == v
+
+    # first scoring request after readyz flips: zero warm misses
+    rows = [{n: (0.5 if m.feature_domains.get(n) is None else "L1")
+             for n in m.feature_names} for _ in range(8)]
+    code, out = _post(base, "/3/Predictions/models/pm", {"rows": rows})
+    assert code == 200 and len(out["predict"]) == 8
+    code, st = _get(base, "/3/Stats")
+    assert code == 200
+    assert st["registry"]["pm"]["warm_cache_misses"] == 0
+    assert st["counters"]["scored_while_unready"] == 0
+
+    # the standard mojo-download verb must work on a registry scorer
+    # (no heap trees — it serves its kept artifact parts) and the
+    # downloaded artifact must load back into an identical scorer
+    req = urllib.request.Request(base + "/3/Models/pm/mojo")
+    with urllib.request.urlopen(req, timeout=60) as r:
+        assert r.status == 200
+        blob = r.read()
+    sc2 = load_artifact(blob)
+    X = np.asarray(m._design_matrix(fr))[: fr.nrows]
+    assert np.array_equal(sc2.score_numpy(X), m.score_numpy(X))
+    # and a loaded scorer can be re-published (replica promotion)
+    assert reg.publish(rest.MODELS["pm"], "promoted") == 1
+
+
+def test_registry_push_env_default_buckets(pool_server, monkeypatch):
+    """A spec without pinned warm_buckets defers to the REPLICA's
+    H2O_TPU_POOL_WARM_BUCKETS — push omits the field, the route's
+    warm_up(None) resolves the env knob."""
+    monkeypatch.setenv("H2O_TPU_POOL_WARM_BUCKETS", "64, 256")
+    base = pool_server
+    fr = _rich_frame(n=300, seed=41)
+    reg = ModelRegistry("mem://test_envbuckets")
+    v = reg.publish(_gbm(fr, ntrees=3), "scorer")
+    out = reg.push(base, "scorer", v, "pm")     # warm_buckets=None
+    assert out["warmed_buckets"] == [128, 256]  # full pow2 ladder
+    assert _get(base, "/readyz")[0] == 200
+
+
+def test_registry_route_rejections(pool_server):
+    base = pool_server
+    assert _post(base, "/3/ModelRegistry/load", {})[0] == 400
+    assert _post(base, "/3/ModelRegistry/load",
+                 {"model_id": "x"})[0] == 400
+    assert _post(base, "/3/ModelRegistry/load",
+                 {"model_id": "x", "artifact_b64": "!!!"})[0] == 400
+    assert _post(base, "/3/ModelRegistry/load",
+                 {"model_id": "x", "path": "mem://nope/a.mojo"}
+                 )[0] == 404
+    # v1 artifact inline -> 400 with the re-export message
+    import base64
+
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("model.json", json.dumps(
+            {"format": "h2o_kubernetes_tpu/mojo/1", "algo": "gbm"}))
+        nz = io.BytesIO()
+        np.savez_compressed(nz)
+        z.writestr("arrays.npz", nz.getvalue())
+    code, out = _post(base, "/3/ModelRegistry/load", {
+        "model_id": "x",
+        "artifact_b64": base64.b64encode(buf.getvalue()).decode()})
+    assert code == 400 and "format-v1" in out["msg"]
+    # digest mismatch -> 409
+    fr = _rich_frame(n=300, seed=7)
+    reg = ModelRegistry("mem://test_rej")
+    v = reg.publish(_gbm(fr, ntrees=3), "scorer")
+    code, out = _post(base, "/3/ModelRegistry/load", {
+        "model_id": "x", "path": reg.artifact_path("scorer", v),
+        "sha256": "0" * 64})
+    assert code == 409
+    # nothing published: the gate still holds readiness down
+    assert _get(base, "/readyz")[0] == 503
+
+
+def test_stats_route_exposes_counters(pool_server):
+    """The satellite fix: scorer_cache_stats() and breaker/shed
+    counters were process-local — /3/Stats is their REST surface."""
+    code, st = _get(pool_server, "/3/Stats")
+    assert code == 200
+    for k in ("hits", "misses", "models", "evictions"):
+        assert k in st["scorer_cache"]
+    for k in ("requests", "batches", "shed", "queue_depth"):
+        assert k in st["batcher"]
+    assert st["breaker"]["state"] == "closed"
+    assert "deadline_504" in st["counters"]
+    assert st["ready"] is False          # gate installed, nothing loaded
+
+
+def test_cordon_flips_readyz_not_serving(pool_server):
+    """Cordon = endpoint removal: readyz 503 while healthz stays 200
+    AND scoring still serves (the straggler window of a rolling
+    update); uncordon restores readiness."""
+    base = pool_server
+    fr = _rich_frame(n=300, seed=9)
+    m = _gbm(fr, ntrees=3)
+    reg = ModelRegistry("mem://test_cordon")
+    reg.push(base, "scorer", reg.publish(m, "scorer"), "pm",
+             warm_buckets=[128])
+    assert _get(base, "/readyz")[0] == 200
+    assert _post(base, "/3/Cordon", {"reason": "test"})[0] == 200
+    code, out = _get(base, "/readyz")
+    assert code == 503 and any("cordon" in r for r in out["reasons"])
+    assert _get(base, "/healthz")[0] == 200
+    rows = [{n: (0.1 if m.feature_domains.get(n) is None else "L2")
+             for n in m.feature_names}]
+    code, _ = _post(base, "/3/Predictions/models/pm", {"rows": rows})
+    assert code == 200, "cordoned replica refused a straggler"
+    _, st = _get(base, "/3/Stats")
+    assert st["counters"]["scored_while_unready"] == 0
+    assert _post(base, "/3/Uncordon", {})[0] == 200
+    assert _get(base, "/readyz")[0] == 200

@@ -46,6 +46,19 @@ Scenarios:
   contract), no scheduler thread may outlive the run, and the
   ``H2O_TPU_AUTOML_PIPELINE=0`` kill switch must drain the same
   scenario clean on the serial path with an identical manifest.
+- ``rolling-update``  a 2-replica operator scorer pool rolls its
+  registry artifact v1 → v2 under closed-loop multi-target REST load
+  (tools/score_load.py run_load_multi): ZERO 5xx responses, zero
+  requests routed to a not-ready replica, both replicas end on v2,
+  every replica reports ``warm_cache_misses == 0`` and
+  ``scored_while_unready == 0`` (the warm-up-gated readiness
+  contract), and the cordon → grace → drain event sequence lands in
+  operator status.
+- ``replica-kill``  SIGKILL one replica of a converged 2-replica pool:
+  the reconciler observes the death, provisions a warmed replacement,
+  and the pool returns to spec count with aggregate readiness inside
+  the drill deadline — replica_died → replica_start → replica_ready
+  visible in the operator event log.
 """
 
 from __future__ import annotations
@@ -756,6 +769,178 @@ def scenario_automl_pipelined_fault() -> None:
                f"switch run: {norm(m_pipe)} vs {norm(m_serial)}")
 
 
+# ---------------------------------------------------------------------------
+# Operator scorer-pool drills (docs/OPERATOR.md)
+# ---------------------------------------------------------------------------
+
+
+class _PoolFixture:
+    """A converged 2-replica scorer pool on artifact v1 (+v2 staged in
+    the registry) — the shared setup of the rolling-update and
+    replica-kill drills. Always tear down via close(): subprocess pods
+    must not outlive a failed drill (tools/run_tests.py's preflight
+    would reap them, but a clean drill leaves a clean box)."""
+
+    def __init__(self, tag: str):
+        import tempfile
+
+        import numpy as np
+
+        import h2o_kubernetes_tpu as h2o
+        from h2o_kubernetes_tpu.models import GBM
+        from h2o_kubernetes_tpu.operator import (ModelRegistry,
+                                                 PoolStore, Reconciler,
+                                                 ScorerPoolSpec)
+
+        self.td = tempfile.mkdtemp(prefix=f"chaos_{tag}_")
+        rng = np.random.default_rng(0)
+        n = 500
+        cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+                for i in range(4)}
+        cols["y"] = np.where(cols["x0"] - cols["x1"] > 0, "late",
+                             "ontime")
+        self.feature_cols = [f"x{i}" for i in range(4)]
+        fr = h2o.Frame.from_arrays(cols)
+        m1 = GBM(ntrees=4, max_depth=3, seed=1).train(
+            y="y", training_frame=fr)
+        m2 = GBM(ntrees=6, max_depth=3, seed=2).train(
+            y="y", training_frame=fr)
+        self.registry = ModelRegistry(os.path.join(self.td, "registry"))
+        self.v1 = self.registry.publish(m1, "scorer")
+        self.v2 = self.registry.publish(m2, "scorer")
+        self.store = PoolStore()
+        self.store.apply(ScorerPoolSpec(
+            name="pool", artifact="scorer", version=self.v1,
+            model_key="pm", replicas=2, warm_buckets=(128,)))
+        self.rec = Reconciler(self.store, self.registry, "pool",
+                              log_dir=os.path.join(self.td, "logs"))
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.rec.run, args=(self.stop,),
+            kwargs={"interval": 0.25}, daemon=True)
+        self.thread.start()
+        try:
+            _check(self.rec.wait_converged(timeout=240),
+                   f"pool never converged on v1: "
+                   f"{self.store.get_status('pool')} "
+                   f"(pod logs under {self.td}/logs)")
+        except BaseException:
+            # raising out of __init__ means the drill's try/finally
+            # never runs — tear the pods down HERE or they leak as the
+            # exact orphans the preflight reaper exists to catch
+            # (keep_dir: the failure message points at the pod logs)
+            self.close(keep_dir=True)
+            raise
+
+    def event_kinds(self) -> list[str]:
+        return [e["kind"] for e in self.store.events("pool")]
+
+    def close(self, keep_dir: bool = False) -> None:
+        try:
+            self.rec.shutdown(timeout=60)
+        finally:
+            self.stop.set()
+            self.thread.join(timeout=10)
+            if not keep_dir:
+                import shutil
+
+                shutil.rmtree(self.td, ignore_errors=True)
+
+
+def scenario_rolling_update() -> None:
+    """Artifact v1 → v2 across a 2-replica pool under closed-loop
+    load: zero 5xx, zero unready routing, both replicas end on v2 with
+    the warm-up contract intact."""
+    from tools.score_load import run_load_multi
+
+    fx = _PoolFixture("roll")
+    try:
+        load_stop = threading.Event()
+        result: dict = {}
+
+        def drive():
+            result.update(run_load_multi(
+                fx.rec.endpoints, "pm", fx.feature_cols,
+                concurrency=3, rows_per_request=8,
+                stop_event=load_stop))
+
+        lt = threading.Thread(target=drive, daemon=True)
+        lt.start()
+        time.sleep(1.5)              # load in flight on v1
+        fx.store.apply_update("pool", version=fx.v2)
+        rolled = fx.rec.wait_converged(timeout=300)
+        time.sleep(0.5)              # post-roll traffic on v2
+        load_stop.set()
+        lt.join(timeout=60)
+        _check(rolled, "pool never converged on v2: "
+               f"{fx.store.get_status('pool')}")
+        _check(result.get("requests", 0) > 50,
+               f"load generator barely ran: {result}")
+        _check(result["fivexx"] == 0,
+               f"{result['fivexx']} 5xx during the rolling update: "
+               f"{result['fivexx_sample']}")
+        _check(result["errors"] == 0,
+               f"non-HTTP client errors during the roll: "
+               f"{result['error_sample']}")
+        versions = [r.loaded_version() for r in fx.rec.replicas]
+        _check(versions == [fx.v2, fx.v2],
+               f"replicas did not end on v2: {versions}")
+        for r in fx.rec.replicas:
+            st = r.stats()
+            _check(st is not None, f"{r.rid}: /3/Stats unreachable")
+            _check(st["counters"]["scored_while_unready"] == 0,
+                   f"{r.rid} admitted scoring while unready: "
+                   f"{st['counters']}")
+            _check(st["registry"]["pm"]["warm_cache_misses"] == 0,
+                   f"{r.rid} compiled on live traffic after warm-up: "
+                   f"{st['registry']}")
+        kinds = fx.event_kinds()
+        for needed in ("replica_cordon", "replica_drain",
+                       "replica_exit"):
+            _check(needed in kinds,
+                   f"event '{needed}' missing from operator status: "
+                   f"{kinds}")
+    finally:
+        fx.close()
+
+
+def scenario_replica_kill() -> None:
+    """SIGKILL one replica of a converged pool: the reconciler
+    replaces it and the pool recovers spec count + aggregate readiness
+    inside the deadline, with the event sequence in status."""
+    import signal
+
+    fx = _PoolFixture("kill")
+    try:
+        victim = fx.rec.replicas[0]
+        vid = victim.rid
+        os.kill(victim.pid(), signal.SIGKILL)
+        # SIGKILL delivery is async: wait until the process is
+        # OBSERVABLY dead before polling convergence, or the first
+        # converged() check can race the kill and declare victory over
+        # a still-listed dead replica
+        deadline = time.monotonic() + 10
+        while victim.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        _check(not victim.alive(), f"SIGKILL did not kill {vid}")
+        _check(fx.rec.wait_converged(timeout=240),
+               "pool never reconverged after SIGKILL: "
+               f"{fx.store.get_status('pool')}")
+        for r in fx.rec.replicas:
+            _check(r.readyz_ok(), f"{r.rid} not ready after recovery")
+        _check(len(fx.rec.replicas) == 2,
+               f"pool not back at spec count: "
+               f"{fx.store.get_status('pool')}")
+        kinds = fx.event_kinds()
+        died = kinds.index("replica_died")
+        _check("replica_start" in kinds[died:]
+               and "replica_ready" in kinds[died:],
+               f"no replacement start/ready after replica_died ({vid}):"
+               f" {kinds}")
+    finally:
+        fx.close()
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -766,6 +951,8 @@ SCENARIOS = {
     "breaker-trip": scenario_breaker_trip,
     "drain-under-load": scenario_drain_under_load,
     "automl-pipelined-fault": scenario_automl_pipelined_fault,
+    "rolling-update": scenario_rolling_update,
+    "replica-kill": scenario_replica_kill,
 }
 
 

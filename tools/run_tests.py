@@ -45,7 +45,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # exactly that — CHANGES.md PR 4 ops note)
 _ORPHAN_PATTERNS = ("automl_scale", "bench_suite", "bench.py",
                     "boost_profile", "tpu_watch", "score_load",
-                    "automl_wall")
+                    "automl_wall", "operator.pod")
+
+# operator scorer-pool pods are REAPED (SIGKILL), not just reported —
+# but ONLY when their parent reconciler is gone (the pod has been
+# reparented to init): a pod only exists as a child of a reconciler,
+# so an orphaned one is unambiguously a wedged drill's leftover — a
+# full JAX interpreter holding a port and a core, guaranteed to starve
+# the tier-1 run that follows. A pod whose parent is still alive may
+# belong to a drill or operator running concurrently on this box and
+# is reported, never killed. The other patterns stay warn-only.
+_REAP_PATTERNS = ("operator.pod",)
+
+
+def _ppid(pid: int) -> int | None:
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return int(f.read().split(")")[-1].split()[1])
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 def find_orphan_processes() -> list[tuple[int, str]]:
@@ -88,12 +106,41 @@ def find_orphan_processes() -> list[tuple[int, str]]:
     return out
 
 
+def reap_orphan_pods(orphans: list[tuple[int, str]]
+                     ) -> list[tuple[int, str]]:
+    """SIGKILL orphaned scorer-pool pods — pods whose reconciler
+    parent is gone (ppid reparented to init); see _REAP_PATTERNS.
+    Returns the orphans still left to report: pods with a live parent
+    (a concurrent drill/operator owns them) and anything that refuses
+    to die, so a strict preflight still fails on them."""
+    import signal
+
+    remaining = []
+    for pid, cmd in orphans:
+        ppid = _ppid(pid)
+        if not any(pat in cmd for pat in _REAP_PATTERNS) \
+                or ppid is None or ppid > 1:
+            remaining.append((pid, cmd))
+            continue
+        try:
+            os.kill(pid, signal.SIGKILL)
+            print(f"[preflight] reaped orphaned scorer-pool pod "
+                  f"{pid} (parent gone): {cmd}", flush=True)
+        except ProcessLookupError:
+            pass                     # already gone
+        except PermissionError:
+            remaining.append((pid, cmd))
+    return remaining
+
+
 def preflight(strict: bool) -> bool:
     """Scan for orphaned bench/AutoML processes BEFORE timing anything;
     returns False (and prints the PIDs) when the box is not clean.
-    Warns by default; fails the run under --strict-preflight or
+    Orphaned scorer-pool pods are reaped outright (a wedged drill's
+    leftover must not starve the run); the rest warn by default and
+    fail the run under --strict-preflight or
     H2O_TPU_PREFLIGHT_STRICT=1."""
-    orphans = find_orphan_processes()
+    orphans = reap_orphan_pods(find_orphan_processes())
     if not orphans:
         return True
     print(f"[preflight] {len(orphans)} orphaned bench/automl "

@@ -15,6 +15,18 @@ Usage::
         # starts an in-process REST server with a synthetic GBM
     python tools/score_load.py --url http://host:54321 --model gbm1
     python tools/score_load.py --concurrency 16 --rows 32 --seconds 10
+    python tools/score_load.py \
+        --url http://h1:54321,http://h2:54321 --model pool \
+        --columns x0,...  --assert-zero-5xx      # drive a scorer POOL
+
+Multi-target mode (a comma list of ``--url`` targets, or a dynamic
+target provider via :func:`run_load_multi`) is the Service analog the
+operator drills ride: a background poller tracks each target's
+``/readyz`` and workers round-robin over the READY set only — a
+replica mid-warm-up or cordoned for a rolling update receives nothing,
+like a pod pulled from a Service's endpoints. ``--assert-zero-5xx``
+makes the run fail loudly (rc 1) on ANY 5xx response — the
+rolling-update acceptance bar (docs/OPERATOR.md).
 
 The gain this measures is recorded by ``bench_suite``'s
 ``gbm_score_rows_per_sec`` config; this tool is the REST-level
@@ -75,28 +87,53 @@ def _self_server(port: int = 0):
             [f"x{i}" for i in range(8)] + ["c1"])
 
 
+def _result_record(latencies: list[float], wall: float,
+                   rows_per_request: int, concurrency: int,
+                   fivexx: list[str], errors: list[str],
+                   **extra) -> dict:
+    """The one result-record shape shared by both load modes — a new
+    field lands in single-target AND multi-target output or neither."""
+    lat = sorted(latencies)
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2) \
+            if lat else None
+
+    return {
+        "metric": "rest_score_rows_per_sec",
+        "value": round(len(lat) * rows_per_request / max(wall, 1e-9), 1),
+        "unit": "rows/s",
+        "requests": len(lat),
+        "requests_per_s": round(len(lat) / max(wall, 1e-9), 1),
+        "fivexx": len(fivexx),
+        "fivexx_sample": fivexx[:5],
+        "errors": len(errors),
+        "error_sample": errors[:3],
+        "p50_ms": pct(0.50),
+        "p95_ms": pct(0.95),
+        "p99_ms": pct(0.99),
+        "concurrency": concurrency,
+        "rows_per_request": rows_per_request,
+        "seconds": round(wall, 2),
+        **extra,
+    }
+
+
 def run_load(url: str, model_key: str, columns: list[str],
              concurrency: int = 8, rows_per_request: int = 32,
              seconds: float = 10.0, seed: int = 0) -> dict:
     """Closed-loop drive; returns the result record (also printable)."""
-    import numpy as np
-
-    rng = np.random.default_rng(seed)
     route = f"{url}/3/Predictions/models/{model_key}"
-    # pre-generate a pool of request bodies (list-shaped rows) so the
-    # workers spend their loop on HTTP + scoring, not on JSON building
-    bodies = []
-    for _ in range(16):
-        rows = [[(float(rng.normal()) if c != "c1" else
-                  ["a", "b", "c", "d"][int(rng.integers(0, 4))])
-                 for c in columns] for _ in range(rows_per_request)]
-        bodies.append({"rows": rows, "columns": columns})
+    bodies = _make_bodies(columns, rows_per_request, seed)
     deadline = time.perf_counter() + seconds
     lock = threading.Lock()
     latencies: list[float] = []
     errors: list[str] = []
+    fivexx: list[str] = []
 
     def worker(wid: int) -> None:
+        import urllib.error
+
         i = wid
         while time.perf_counter() < deadline:
             body = bodies[i % len(bodies)]
@@ -105,6 +142,13 @@ def run_load(url: str, model_key: str, columns: list[str],
             try:
                 out = _post_json(route, body)
                 ok = len(out["predict"]) == rows_per_request
+            except urllib.error.HTTPError as e:
+                # 5xx tracked apart from transport noise so
+                # --assert-zero-5xx has a precise needle
+                label = f"HTTP {e.code} {e.read()[:120]!r}"
+                with lock:
+                    (fivexx if e.code >= 500 else errors).append(label)
+                continue
             except Exception as e:  # noqa: BLE001 — record, keep going
                 with lock:
                     errors.append(repr(e)[:200])
@@ -127,33 +171,155 @@ def run_load(url: str, model_key: str, columns: list[str],
     for t in threads:
         t.join()
     wall = time.perf_counter() - t_start
-    lat = sorted(latencies)
+    return _result_record(latencies, wall, rows_per_request,
+                          concurrency, fivexx, errors)
 
-    def pct(p):
-        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2) \
-            if lat else None
 
-    return {
-        "metric": "rest_score_rows_per_sec",
-        "value": round(len(lat) * rows_per_request / wall, 1),
-        "unit": "rows/s",
-        "requests": len(lat),
-        "requests_per_s": round(len(lat) / wall, 1),
-        "errors": len(errors),
-        "error_sample": errors[:3],
-        "p50_ms": pct(0.50),
-        "p95_ms": pct(0.95),
-        "p99_ms": pct(0.99),
-        "concurrency": concurrency,
-        "rows_per_request": rows_per_request,
-        "seconds": round(wall, 2),
-    }
+def _make_bodies(columns: list[str], rows_per_request: int, seed: int,
+                 pool: int = 16) -> list[dict]:
+    """Pre-generated list-shaped request bodies (shared by both load
+    modes so workers spend their loop on HTTP, not JSON building)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    bodies = []
+    for _ in range(pool):
+        rows = [[(float(rng.normal()) if c != "c1" else
+                  ["a", "b", "c", "d"][int(rng.integers(0, 4))])
+                 for c in columns] for _ in range(rows_per_request)]
+        bodies.append({"rows": rows, "columns": columns})
+    return bodies
+
+
+def run_load_multi(targets, model_key: str, columns: list[str],
+                   concurrency: int = 4, rows_per_request: int = 8,
+                   seconds: float | None = None, stop_event=None,
+                   seed: int = 0, ready_poll_s: float = 0.05,
+                   request_timeout: float = 30.0) -> dict:
+    """Round-robin closed-loop drive over a DYNAMIC set of pool
+    replicas — the k8s-Service analog for operator drills.
+
+    ``targets`` is a list of base URLs or a zero-arg callable returning
+    one (the reconciler's live endpoint list: replicas join as they
+    are provisioned, leave the instant they are cordoned). A poller
+    thread refreshes each target's ``/readyz`` every ``ready_poll_s``
+    and workers pick targets FROM the ready set under its lock, so the
+    generator never *chooses* an unready target by construction. (The
+    pick→arrival in-flight race is the router race the operator's
+    deregister grace exists for; the measured check of that contract
+    is the SERVER-side ``scored_while_unready`` counter on /3/Stats,
+    which the drills assert — not a client-side literal.) ``fivexx``
+    counts real 5xx contract violations.
+
+    Runs until ``stop_event`` is set (or ``seconds`` elapses). Returns
+    the single-target record plus ``fivexx``/``fourxx``/``by_target``/
+    ``no_ready_target_waits``."""
+    import urllib.error
+
+    get_targets = targets if callable(targets) else (lambda: targets)
+    stop = stop_event or threading.Event()
+    deadline = (time.perf_counter() + seconds) if seconds else None
+    bodies = _make_bodies(columns, rows_per_request, seed)
+    lock = threading.Lock()
+    ready: set[str] = set()
+    latencies: list[float] = []
+    fivexx: list[str] = []
+    fourxx: list[str] = []
+    errors: list[str] = []
+    by_target: dict[str, dict] = {}
+    no_ready_waits = [0]
+
+    def _done() -> bool:
+        return stop.is_set() or \
+            (deadline is not None and time.perf_counter() >= deadline)
+
+    def poller():
+        while not _done():
+            now_ready = set()
+            for t in list(get_targets()):
+                try:
+                    with urllib.request.urlopen(
+                            t.rstrip("/") + "/readyz", timeout=2.0) as r:
+                        if r.status == 200:
+                            now_ready.add(t.rstrip("/"))
+                except Exception:  # noqa: BLE001 — down/503 = unready
+                    pass
+            with lock:
+                ready.clear()
+                ready.update(now_ready)
+            time.sleep(ready_poll_s)
+
+    rr = [0]
+
+    def worker(wid: int) -> None:
+        i = wid
+        while not _done():
+            with lock:
+                pool = sorted(ready)
+                if pool:
+                    target = pool[rr[0] % len(pool)]
+                    rr[0] += 1
+                else:
+                    no_ready_waits[0] += 1   # under lock: workers race
+            if not pool:
+                time.sleep(0.02)
+                continue
+            body = bodies[i % len(bodies)]
+            i += 1
+            route = f"{target}/3/Predictions/models/{model_key}"
+            t0 = time.perf_counter()
+            try:
+                out = _post_json(route, body, timeout=request_timeout)
+                ok = len(out["predict"]) == rows_per_request
+                dt = time.perf_counter() - t0
+                with lock:
+                    rec = by_target.setdefault(
+                        target, {"requests": 0, "fivexx": 0})
+                    rec["requests"] += 1
+                    if ok:
+                        latencies.append(dt)
+                    else:
+                        errors.append(f"{target}: short response")
+            except urllib.error.HTTPError as e:
+                label = f"{target}: HTTP {e.code} {e.read()[:120]!r}"
+                with lock:
+                    rec = by_target.setdefault(
+                        target, {"requests": 0, "fivexx": 0})
+                    rec["requests"] += 1
+                    if e.code >= 500:
+                        rec["fivexx"] += 1
+                        fivexx.append(label)
+                    else:
+                        fourxx.append(label)
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                with lock:
+                    errors.append(f"{target}: {e!r}"[:200])
+
+    t_start = time.perf_counter()
+    pt = threading.Thread(target=poller, daemon=True,
+                          name="score-load-ready-poller")
+    pt.start()
+    workers = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    pt.join(timeout=5.0)
+    wall = time.perf_counter() - t_start
+    return _result_record(latencies, wall, rows_per_request,
+                          concurrency, fivexx, errors,
+                          fourxx=len(fourxx),
+                          no_ready_target_waits=no_ready_waits[0],
+                          by_target=by_target)
 
 
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", default=None,
-                    help="server base URL; omit to self-host")
+                    help="server base URL, or a comma list of pool "
+                    "replica URLs (round-robin multi-target mode); "
+                    "omit to self-host")
     ap.add_argument("--model", default=None, help="model key to score")
     ap.add_argument("--columns", default=None,
                     help="comma list of feature columns (remote mode)")
@@ -161,29 +327,47 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--rows", type=int, default=32,
                     help="rows per request")
     ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--assert-zero-5xx", action="store_true",
+                    help="fail (rc 1) if ANY response was a 5xx — the "
+                    "rolling-update drill's acceptance bar")
     args = ap.parse_args(argv)
 
     srv = None
+    multi = args.url is not None and "," in args.url
     if args.url is None:
         srv, url, model_key, columns = _self_server()
     else:
-        url = args.url.rstrip("/")
+        url = args.url.rstrip(",")
         if not args.model or not args.columns:
             print("--url mode needs --model and --columns",
                   file=sys.stderr)
             return 2
         model_key, columns = args.model, args.columns.split(",")
     try:
-        out = run_load(url, model_key, columns,
-                       concurrency=args.concurrency,
-                       rows_per_request=args.rows,
-                       seconds=args.seconds)
+        if multi:
+            targets = [u.strip().rstrip("/")
+                       for u in url.split(",") if u.strip()]
+            out = run_load_multi(targets, model_key, columns,
+                                 concurrency=args.concurrency,
+                                 rows_per_request=args.rows,
+                                 seconds=args.seconds)
+        else:
+            out = run_load(url.rstrip("/"), model_key, columns,
+                           concurrency=args.concurrency,
+                           rows_per_request=args.rows,
+                           seconds=args.seconds)
         if srv is not None:
             from h2o_kubernetes_tpu import rest
 
             out["batcher"] = dict(rest.BATCHER.stats)
         print(json.dumps(out))
-        return 0 if out["errors"] == 0 and out["requests"] > 0 else 1
+        if args.assert_zero_5xx and out.get("fivexx", 0) > 0:
+            print(f"FAIL: {out['fivexx']} 5xx responses "
+                  f"(sample: {out.get('fivexx_sample')})",
+                  file=sys.stderr)
+            return 1
+        return 0 if out["errors"] == 0 and out["requests"] > 0 \
+            and out.get("fivexx", 0) == 0 else 1
     finally:
         if srv is not None:
             srv.shutdown()
