@@ -28,7 +28,12 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_OOC | auto | out-of-core tree training: 1 force, 0 never, auto = binned matrix past the budget headroom (models/gbm, docs/SCALING.md) |
 | H2O_TPU_OOC_CHUNK_ROWS | derived | rows per host-pinned binned chunk in out-of-core mode (models/tree/ooc) |
 | H2O_TPU_OOC_RESIDENT | 0 | debug: keep out-of-core chunks device-resident (the bitwise streamed-vs-resident parity harness) |
-| H2O_TPU_SCORER_CACHE_MAX | 64 | LRU cap on models with live jitted-scorer caches; evictions counted in scorer_cache_stats() (models/base) |
+| H2O_TPU_SCORER_CACHE_BYTES | 1 GiB | byte budget over every resident model's serving state (live traces + LUTs + device flat arrays); past it the least-recently-scored model's executables/device arrays are evicted and re-promote via the persistent XLA cache; <=0 unbounded (models/base, docs/SERVING.md) |
+| H2O_TPU_SCORER_CACHE_MAX | 0 (off) | optional resident-model COUNT cap on top of the byte budget; evictions counted in scorer_cache_stats() (models/base) |
+| H2O_TPU_SCORE_FAIRNESS | 1 | per-model queue-share caps + SLO-priority dispatch in the micro-batcher; 0 = unfair FIFO baseline (rest.py, docs/SERVING.md) |
+| H2O_TPU_SCORE_MODEL_QUEUE_SHARE | per class | global override of the admission-queue fraction ONE model may occupy (rest.py) |
+| H2O_TPU_SLO_DEFAULT | standard | SLO class (interactive/standard/batch) when neither the X-H2O-SLO header nor the model's registry default applies (rest.py) |
+| H2O_TPU_PCACHE_MIN_SECS | — | persistent-XLA-cache compile-time threshold override; serving pods pin 0 so every tenant compile persists and evictions re-promote from disk (runtime/backend.py) |
 | H2O_TPU_PROBE_BUDGET | 600 | backend-probe stubbornness seconds (runtime/backend) |
 | H2O_TPU_SCORE_BATCH_US | 2000 | REST scoring micro-batcher window, µs; 0 = dispatch immediately (rest.py, docs/SERVING.md) |
 | H2O_TPU_SCORE_TIMEOUT | 60 | seconds a scoring request may wait for its micro-batched result before 503 (rest.py) |

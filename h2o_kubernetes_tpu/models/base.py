@@ -172,47 +172,136 @@ def resolve_x(frame: Frame, x: Sequence[str] | None = None,
 # sizes are bucketed to powers of two (score_numpy pads), and compiles
 # land in the round-4 persistent XLA cache (runtime/backend.py) so even
 # a fresh process warm-starts from disk.
+#
+# Multi-tenant residency (docs/SERVING.md "Multi-tenant serving"): the
+# cache is BYTE-budgeted, not count-capped.  Every resident model is
+# charged its live trace + LUT + flat-array device bytes
+# (_serving_resident_bytes); past H2O_TPU_SCORER_CACHE_BYTES the
+# least-recently-scored model's executables AND device arrays are
+# dropped (_serving_evict) while its host-side state (heap trees /
+# artifact arrays) stays loaded.  The next score re-promotes: the
+# re-trace recompiles the SAME HLO (same constants rebuilt from the
+# same host arrays), so with the persistent XLA cache enabled an
+# eviction costs a disk cache-hit, never a cold compile — and scores
+# are bitwise-identical across evict→promote (tests/test_multitenant).
 
 _SCORE_MIN_BATCH = 128          # smallest padded-batch bucket
 
-_SCORER_STATS = {"hits": 0, "misses": 0, "models": 0, "evictions": 0}
+_SCORER_STATS = {"hits": 0, "misses": 0, "models": 0, "evictions": 0,
+                 "promotions": 0}
 # guards cache-entry/jit creation + stats: an HTTP handler thread and
 # the REST micro-batcher thread can first-score one model concurrently
 _SCORER_LOCK = threading.Lock()
 
-# LRU over models holding a live jitted-scorer cache. Without a cap a
-# long-lived REST server scoring many models/shapes grows the set of
-# per-model jitted callables (and jax's per-callable executable caches)
-# without bound; evicting the least-recently-scored model's cache frees
-# its executables while the model itself stays loaded — the next score
-# just pays one re-trace (a normal `miss`).
+# LRU over models holding a live jitted-scorer cache, plus each
+# resident model's byte charge. Without a budget a long-lived REST
+# server serving a tenant population grows the set of per-model jitted
+# callables (and the flat constant arrays each executable embeds)
+# without bound; evicting the least-recently-scored model frees its
+# executables + device arrays while the model itself stays loaded.
 import collections
 import os
 import weakref
 
 _SCORER_LRU: "collections.OrderedDict[int, weakref.ref]" = \
     collections.OrderedDict()
+_SCORER_BYTES: dict[int, int] = {}      # id(model) -> charged bytes
+
+# per-executable overhead beyond embedded constants + I/O buffers:
+# generated code, thunk schedules, jax bookkeeping. Deliberately a
+# round conservative constant — the accounting is a budget, not a
+# profiler.
+_TRACE_OVERHEAD = 64 * 1024
+_LUT_BYTES_PER_ENTRY = 80       # dict slot + boxed float + key str
 
 
 def _scorer_cache_cap() -> int:
-    """H2O_TPU_SCORER_CACHE_MAX (default 64), read per call so a live
+    """H2O_TPU_SCORER_CACHE_MAX — optional resident-model COUNT cap on
+    top of the byte budget (<= 0 = off, the default since the byte
+    budget took over residency control). Read per call so a live
     server can be re-tuned without a restart."""
     try:
-        cap = int(os.environ.get("H2O_TPU_SCORER_CACHE_MAX", "64"))
+        cap = int(os.environ.get("H2O_TPU_SCORER_CACHE_MAX", "0"))
     except ValueError:
-        cap = 64
-    return max(1, cap)
+        cap = 0
+    return max(0, cap)
+
+
+def _scorer_cache_budget() -> int:
+    """H2O_TPU_SCORER_CACHE_BYTES (default 1 GiB) — the resident-bytes
+    budget over every model's live serving state; <= 0 = unbounded."""
+    try:
+        b = int(float(os.environ.get("H2O_TPU_SCORER_CACHE_BYTES",
+                                     str(2 ** 30))))
+    except ValueError:
+        b = 2 ** 30
+    return b
 
 
 def scorer_cache_stats() -> dict[str, int]:
     """Shape-level cache counters: a `miss` is a (model, schema, padded
     batch) triple seen for the first time — i.e. an expected XLA
     trace/compile; warm traffic must add only `hits` (the bench's
-    recompile check asserts exactly that). `evictions` counts models
-    whose jitted-scorer cache was dropped by the LRU cap
-    (H2O_TPU_SCORER_CACHE_MAX); `models` counts cache CREATIONS, so an
-    evicted model scoring again increments it again."""
-    return dict(_SCORER_STATS)
+    recompile check asserts exactly that). `promotions` is the subset
+    of misses that re-traced a shape a previous eviction dropped —
+    expected churn under a byte budget, not an SLO violation (the
+    /3/Stats warm_cache_misses contract subtracts them). `evictions`
+    counts models whose live serving state was dropped by the byte
+    budget (H2O_TPU_SCORER_CACHE_BYTES) or the optional count cap
+    (H2O_TPU_SCORER_CACHE_MAX); `models` counts cache CREATIONS (the
+    historical total), while `resident` counts models holding live
+    executables right now, charged `resident_bytes` against
+    `budget_bytes`."""
+    with _SCORER_LOCK:
+        out = dict(_SCORER_STATS)
+        resident, rbytes = 0, 0
+        for vid, ref in _SCORER_LRU.items():
+            # skip GC'd models' stale charges: a re-pushed model_id's
+            # old instance may linger in _SCORER_BYTES until the next
+            # _cached_score purge, and counting it could report
+            # resident_bytes over budget for models that no longer
+            # exist (a spurious budget_exceeded in the drills)
+            if ref() is not None:
+                resident += 1
+                rbytes += _SCORER_BYTES.get(vid, 0)
+        out["resident"] = resident
+        out["resident_bytes"] = rbytes
+        out["budget_bytes"] = _scorer_cache_budget()
+    return out
+
+
+def model_scorer_counters(model) -> dict[str, int]:
+    """Per-model cache counters (hits/misses/promotions). They live on
+    the MODEL (host-side) and survive eviction, so /3/Stats can report
+    warm_cache_misses = (misses - promotions) - warm-up baseline: a
+    re-trace caused by byte-budget eviction re-baselines out instead
+    of reading as an SLO-violating first-request compile."""
+    return dict(model.__dict__.get("_scorer_counters")
+                or {"hits": 0, "misses": 0, "promotions": 0})
+
+
+def evict_scorer_cache(model=None) -> int:
+    """Ops/test hook: drop one model's live serving state (or EVERY
+    resident model's when ``model`` is None) exactly as the byte
+    budget would — executables + device arrays go, host-side state
+    stays, the next score re-promotes through the persistent XLA
+    cache. Returns the number of models evicted."""
+    with _SCORER_LOCK:
+        victims = []
+        if model is None:
+            for vid, ref in list(_SCORER_LRU.items()):
+                del _SCORER_LRU[vid]
+                _SCORER_BYTES.pop(vid, None)
+                m = ref()
+                if m is not None:
+                    victims.append(m)
+        elif _SCORER_LRU.pop(id(model), None) is not None:
+            _SCORER_BYTES.pop(id(model), None)
+            victims.append(model)
+        for m in victims:
+            m._serving_evict()
+            _SCORER_STATS["evictions"] += 1
+    return len(victims)
 
 
 def _batch_bucket(n: int) -> int:
@@ -272,6 +361,8 @@ class Model:
         d.pop("_scorer_cache", None)
         d.pop("_flat_trees", None)
         d.pop("_serving_luts", None)    # rest.py enum-code LUT cache
+        d.pop("_scorer_counters", None)  # process-local accounting
+        d.pop("_evicted_shapes", None)
         return d
 
     def _serving_prepare(self) -> None:
@@ -279,10 +370,48 @@ class Model:
         flattened ensemble) OUTSIDE the jit trace — device constants
         created while tracing would leak as tracers."""
 
+    def _serving_evict(self) -> None:
+        """Drop every piece of serving state that is rebuildable from
+        this model's host-side state: the jitted executables, the
+        device-resident flat arrays, and the enum-code LUTs. The warm
+        shape set is remembered (host-side) so the re-trace on the next
+        score is accounted a `promotion`, not a fresh miss."""
+        ent = self.__dict__.pop("_scorer_cache", None)
+        if ent is not None and ent.get("shapes"):
+            self.__dict__.setdefault(
+                "_evicted_shapes", set()).update(ent["shapes"])
+        self.__dict__.pop("_flat_trees", None)
+        self.__dict__.pop("_serving_luts", None)
+
+    def _serving_resident_bytes(self) -> int:
+        """Estimated bytes this model's live serving state pins:
+        device flat arrays + enum-code LUTs + one executable per
+        traced shape. XLA:CPU embeds closed-over constants per
+        compiled executable, so each traced batch bucket is charged
+        its own copy of the flat arrays plus its padded I/O buffers —
+        deliberately conservative: the budget is for capacity
+        planning, not byte-exact profiling."""
+        flat = 0
+        ft = self.__dict__.get("_flat_trees")
+        if ft is not None:
+            for leaf in jax.tree_util.tree_leaves(ft):
+                flat += int(getattr(leaf, "nbytes", 0) or 0)
+        total = flat
+        for lut in (self.__dict__.get("_serving_luts") or {}).values():
+            total += _LUT_BYTES_PER_ENTRY * len(lut)
+        ent = self.__dict__.get("_scorer_cache")
+        if ent:
+            K = max(int(getattr(self, "nclasses", 1) or 1), 1)
+            for F, batch, _off in ent["shapes"]:
+                total += flat + 4 * batch * (F + K) + _TRACE_OVERHEAD
+        return total
+
     def _cached_score(self, X: jax.Array,
                       offset: jax.Array | None = None) -> jax.Array:
         """Score through this model's jitted scorer, tracking warm
-        shapes per (model, schema, padded batch, offset?) key."""
+        shapes per (model, schema, padded batch, offset?) key and
+        charging this model's resident bytes against the cache
+        budget."""
         self._serving_prepare()
         with _SCORER_LOCK:
             ent = self.__dict__.get("_scorer_cache")
@@ -290,26 +419,63 @@ class Model:
                 ent = {"shapes": set()}
                 self._scorer_cache = ent
                 _SCORER_STATS["models"] += 1
-            # LRU bookkeeping + cap: evict the least-recently-scored
-            # model's cache so the jitted-callable population stays
-            # bounded on long-lived servers
+            ctr = self.__dict__.get("_scorer_counters")
+            if ctr is None:
+                ctr = {"hits": 0, "misses": 0, "promotions": 0}
+                self._scorer_counters = ctr
             mid = id(self)
             _SCORER_LRU[mid] = weakref.ref(self)
             _SCORER_LRU.move_to_end(mid)
-            cap = _scorer_cache_cap()
-            while len(_SCORER_LRU) > cap:
-                _, ref = _SCORER_LRU.popitem(last=False)
-                victim = ref()
-                if victim is None:
-                    continue      # model already GC'd: just reclaim
-                victim.__dict__.pop("_scorer_cache", None)
-                _SCORER_STATS["evictions"] += 1
             skey = (X.shape[1], X.shape[0], offset is not None)
             if skey in ent["shapes"]:
                 _SCORER_STATS["hits"] += 1
+                ctr["hits"] += 1
             else:
                 ent["shapes"].add(skey)
                 _SCORER_STATS["misses"] += 1
+                ctr["misses"] += 1
+                ev = self.__dict__.get("_evicted_shapes")
+                if ev and skey in ev:
+                    # re-trace of a shape a byte-budget eviction
+                    # dropped: a PROMOTION — with the persistent XLA
+                    # cache on, its compile is a disk hit (the same
+                    # constants rebuilt from the same host arrays
+                    # lower to the same HLO), never a cold compile
+                    ev.discard(skey)
+                    _SCORER_STATS["promotions"] += 1
+                    ctr["promotions"] += 1
+                # byte accounting + eviction on the MISS branch only:
+                # a model's charge changes only when a new shape is
+                # traced (device arrays + LUTs are in place before the
+                # first score), so the warm hit path pays none of this
+                # O(resident models + traced shapes) work under the
+                # one lock every scoring thread shares. Purge GC'd
+                # models, re-charge this model, then evict least-
+                # recently-scored models until the population fits
+                # the byte budget (and the optional count cap). The
+                # model being scored is never its own victim — a
+                # single over-budget model keeps serving.
+                for vid in [v for v, r in _SCORER_LRU.items()
+                            if r() is None]:
+                    del _SCORER_LRU[vid]
+                    _SCORER_BYTES.pop(vid, None)
+                _SCORER_BYTES[mid] = self._serving_resident_bytes()
+                cap = _scorer_cache_cap()
+                budget = _scorer_cache_budget()
+                while len(_SCORER_LRU) > 1 and (
+                        (cap and len(_SCORER_LRU) > cap)
+                        or (budget > 0
+                            and sum(_SCORER_BYTES.values()) > budget)):
+                    vid, ref = next(iter(_SCORER_LRU.items()))
+                    if vid == mid:
+                        break
+                    del _SCORER_LRU[vid]
+                    _SCORER_BYTES.pop(vid, None)
+                    victim = ref()
+                    if victim is None:
+                        continue  # model already GC'd: just reclaim
+                    victim._serving_evict()
+                    _SCORER_STATS["evictions"] += 1
             key = "fn_off" if offset is not None else "fn"
             fn = ent.get(key)
             if fn is None:

@@ -37,7 +37,17 @@ def main(argv: list[str] | None = None) -> int:
     os.environ.setdefault("H2O_TPU_POOL_REPLICA", "1")
     from ..runtime.backend import enable_persistent_compile_cache
 
-    enable_persistent_compile_cache()
+    # threshold 0: tenant models compile in well under the default
+    # 0.5 s on CPU, and the byte-budget cache's evict→promote contract
+    # needs EVERY serving compile persisted so a promotion is a disk
+    # hit, never a cold compile (H2O_TPU_PCACHE_MIN_SECS overrides;
+    # parsed tolerantly — a typo'd knob must not crash-loop every
+    # replica the reconciler spawns)
+    try:
+        mcs = float(os.environ.get("H2O_TPU_PCACHE_MIN_SECS") or 0.0)
+    except ValueError:
+        mcs = 0.0
+    enable_persistent_compile_cache(min_compile_secs=mcs)
     from ..runtime import lifecycle, make_mesh, set_global_mesh
 
     set_global_mesh(make_mesh())

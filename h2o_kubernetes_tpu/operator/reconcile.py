@@ -88,6 +88,13 @@ class ScorerReplica:
         self.version = int(version)
         self.model_key = spec.model_key
         self.artifact = spec.artifact
+        # the FULL tenant set this replica must serve (primary pinned
+        # to the rollout version + every extra artifact): pushed as
+        # one required-set so /readyz can't flip mid-push
+        self.artifacts = [(spec.artifact, int(version), spec.model_key,
+                           spec.slo)]
+        for ent in spec.all_artifacts()[1:]:
+            self.artifacts.append(ent)
         # None = the replica resolves H2O_TPU_POOL_WARM_BUCKETS itself
         self.warm_buckets = None if spec.warm_buckets is None \
             else tuple(spec.warm_buckets)
@@ -182,9 +189,12 @@ class ScorerReplica:
 
         def push():
             try:
-                registry.push(self.url, self.artifact, self.version,
-                              self.model_key, self.warm_buckets,
-                              timeout=_startup_deadline())
+                # the whole tenant set (primary + extras), required-
+                # set declared first: readiness flips only after
+                # EVERY artifact is loaded + warmed
+                registry.push_many(self.url, self.artifacts,
+                                   warm_buckets=self.warm_buckets,
+                                   timeout=_startup_deadline())
             except Exception as e:  # noqa: BLE001 — reconciler decides
                 self._load_err = repr(e)[:300]
             finally:

@@ -81,17 +81,41 @@ class FlatTreeScorer(Model):
         self.max_depth = int(meta["max_depth"])
         self.drf_mode = bool(meta["drf_mode"])
         self.margin_scale = float(meta.get("margin_scale", 1.0))
+        self.init_score = np.asarray(arrays["init_score"])
+        # device state (_flat_trees, _enum_mask) is built lazily by
+        # _serving_prepare from the kept host arrays, so the byte-
+        # budgeted scorer cache can evict it and a later score
+        # re-promotes — rebuilding the SAME constants means the same
+        # HLO, a persistent-cache hit, and bitwise-identical output
+        self._serving_prepare()
+
+    def _serving_prepare(self):
+        """Build (or fetch) the device arrays; RETURNS them so callers
+        hold locals — a concurrent byte-budget eviction may pop the
+        attributes between a check and a read (the evict loop runs
+        under _SCORER_LOCK, a trace in flight does not), and a
+        check-then-self-read would AttributeError mid-score."""
+        ft = self.__dict__.get("_flat_trees")
+        em = self.__dict__.get("_enum_mask")
+        if ft is not None and em is not None:
+            return ft, em
         import jax.numpy as jnp
 
         from ..models.tree.core import FlatTrees
 
-        self.init_score = np.asarray(arrays["init_score"])
-        self._enum_mask = jnp.asarray(
-            np.asarray(arrays["enum_mask"]).astype(bool))
-        self._flat_trees = FlatTrees(
+        arrays = self._artifact_arrays
+        em = jnp.asarray(np.asarray(arrays["enum_mask"]).astype(bool))
+        ft = FlatTrees(
             *(jnp.asarray(arrays[f"flat_{f}"])
               for f in ("split_feat", "thresh", "left", "na_left",
                         "value")))
+        self._enum_mask = em
+        self._flat_trees = ft
+        return ft, em
+
+    def _serving_evict(self) -> None:
+        super()._serving_evict()
+        self.__dict__.pop("_enum_mask", None)
 
     def export_artifact(self) -> bytes:
         """Re-serialize this scorer as a MOJO-v2 zip from its kept
@@ -128,9 +152,14 @@ class FlatTreeScorer(Model):
 
         from ..models.tree.core import flat_margin
 
+        # the eager predict() path reaches here without _cached_score
+        # having run _serving_prepare; after an eviction the device
+        # arrays must be rebuilt (concrete host→device constants —
+        # safe even under a jit trace). LOCALS, not self-reads: a
+        # concurrent eviction may pop the attributes mid-score.
+        ft, em = self._serving_prepare()
         K = self.nclasses if self.nclasses > 2 else 1
-        lv = flat_margin(self._flat_trees, X, self._enum_mask,
-                         self.max_depth, K)                 # [K, rows]
+        lv = flat_margin(ft, X, em, self.max_depth, K)      # [K, rows]
         if K == 1:
             m = lv[0]
             if self.drf_mode:
@@ -278,7 +307,8 @@ class ModelRegistry:
 
     def push(self, base_url: str, name: str, version: int,
              model_key: str, warm_buckets: Sequence[int] | None = None,
-             timeout: float = 300.0, inline: bool | None = None) -> dict:
+             timeout: float = 300.0, inline: bool | None = None,
+             slo: str | None = None) -> dict:
         """POST the artifact to a replica's /3/ModelRegistry/load and
         block until it has loaded AND warmed (the route warms before
         it returns, so success here means the replica's readiness gate
@@ -286,26 +316,61 @@ class ModelRegistry:
 
         ``warm_buckets=None`` omits the field so the REPLICA resolves
         its own ``H2O_TPU_POOL_WARM_BUCKETS`` — a spec-pinned tuple
-        overrides it. ``inline=None`` sends the artifact PATH when the
+        overrides it. ``slo`` sets the model's default SLO class on
+        the replica (rest.py SLO_CLASSES; per-request X-H2O-SLO still
+        wins). ``inline=None`` sends the artifact PATH when the
         backend is host-visible (local FS / cloud schemes the replica
         can read) and falls back to inline base64 bytes for mem://
         roots, which exist only in THIS process."""
-        import urllib.request
-
         if inline is None:
             inline = self.root.startswith("mem://")
         body = {"model_id": model_key, "name": name,
                 "version": int(version)}
         if warm_buckets is not None:
             body["warm_buckets"] = [int(b) for b in warm_buckets]
+        if slo is not None:
+            body["slo"] = slo
         if inline:
             body["artifact_b64"] = base64.b64encode(
                 self.fetch(name, version)).decode()
         else:
             body["path"] = self.artifact_path(name, version)
             body["sha256"] = self.info(name, version)["sha256"]
+        return self._post_json(base_url, "/3/ModelRegistry/load",
+                               body, timeout)
+
+    def push_many(self, base_url: str, items: Sequence[Sequence],
+                  warm_buckets: Sequence[int] | None = None,
+                  timeout: float = 300.0,
+                  require: bool = True) -> list[dict]:
+        """Push a TENANT SET to one replica: ``items`` is a sequence
+        of (artifact, version, model_key[, slo]) entries
+        (ScorerPoolSpec.all_artifacts). With ``require`` (the
+        default), the replica's required-model readiness set is
+        declared FIRST — so ``/readyz`` cannot flip green between
+        artifact 1 landing and artifact N, whatever order the pushes
+        complete in. Returns the per-artifact load responses."""
+        items = [tuple(it) for it in items]
+        if require:
+            self._post_json(base_url, "/3/ModelRegistry/require",
+                            {"model_ids": [it[2] for it in items]},
+                            timeout)
+        out = []
+        for it in items:
+            name, version, model_key = it[0], it[1], it[2]
+            slo = it[3] if len(it) > 3 else None
+            out.append(self.push(base_url, name, version, model_key,
+                                 warm_buckets=warm_buckets,
+                                 timeout=timeout, slo=slo))
+        return out
+
+    @staticmethod
+    def _post_json(base_url: str, path: str, body: dict,
+                   timeout: float) -> dict:
+        import urllib.request
+
         req = urllib.request.Request(
-            base_url.rstrip("/") + "/3/ModelRegistry/load",
+            base_url.rstrip("/") + path,
             data=json.dumps(body).encode(), method="POST",
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=timeout) as r:

@@ -41,6 +41,16 @@ class ScorerPoolSpec:
     # resolve H2O_TPU_POOL_WARM_BUCKETS (default 128,1024) — pinning a
     # tuple here overrides the env knob for this pool
     warm_buckets: tuple | None = None
+    # default SLO class for the primary artifact's traffic (rest.py
+    # SLO_CLASSES; None = the replica's H2O_TPU_SLO_DEFAULT)
+    slo: str | None = None
+    # multi-tenant pools: extra (artifact, version, model_key[, slo])
+    # tuples pushed to EVERY replica alongside the primary — /readyz
+    # holds until ALL of them are loaded + warmed (the replica's
+    # required-model readiness set is declared before the first push).
+    # The PRIMARY artifact/version still drives rolling updates; a
+    # changed extra artifact rides the next primary version bump.
+    extra_artifacts: tuple = ()
     env: dict = field(default_factory=dict)   # extra pod env overrides
 
     def validate(self) -> "ScorerPoolSpec":
@@ -60,7 +70,49 @@ class ScorerPoolSpec:
             raise ValueError("warm_buckets must name at least one "
                              "batch bucket, or be None to defer to "
                              "the replica's H2O_TPU_POOL_WARM_BUCKETS")
+        # SLO classes validate at APPLY time: a typo'd class would
+        # otherwise pass here and 400 on every replica's artifact
+        # push — the pool wedging in a replace loop instead of the
+        # spec being rejected (validate()'s whole job)
+        from ..rest import SLO_CLASSES
+
+        def _check_slo(slo, where):
+            if slo is not None and slo not in SLO_CLASSES:
+                raise ValueError(
+                    f"unknown SLO class {slo!r} for {where} "
+                    f"(known: {', '.join(sorted(SLO_CLASSES))})")
+
+        _check_slo(self.slo, "the primary artifact")
+        keys = [self.model_key]
+        for ent in self.extra_artifacts:
+            ent = tuple(ent)
+            if len(ent) not in (3, 4) or not ent[0] or not ent[2]:
+                raise ValueError(
+                    "extra_artifacts entries must be (artifact, "
+                    f"version, model_key[, slo]) tuples, got {ent!r}")
+            if int(ent[1]) < 1:
+                raise ValueError(
+                    f"extra artifact {ent[0]!r} version must be >= 1")
+            if len(ent) > 3:
+                _check_slo(ent[3], f"extra artifact {ent[0]!r}")
+            keys.append(ent[2])
+        if len(set(keys)) != len(keys):
+            raise ValueError(
+                f"duplicate model_key across the pool's artifacts: "
+                f"{sorted(k for k in set(keys) if keys.count(k) > 1)}")
         return self
+
+    def all_artifacts(self) -> list[tuple]:
+        """Every (artifact, version, model_key, slo) a replica must
+        serve, primary first — the push list AND the required-model
+        readiness set."""
+        items = [(self.artifact, int(self.version), self.model_key,
+                  self.slo)]
+        for ent in self.extra_artifacts:
+            ent = tuple(ent)
+            items.append((ent[0], int(ent[1]), ent[2],
+                          ent[3] if len(ent) > 3 else None))
+        return items
 
 
 _EVENT_CAP = 256        # bounded: a flapping pool must not grow memory

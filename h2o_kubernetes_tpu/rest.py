@@ -62,14 +62,22 @@ _MODEL_SEQ = 0
 # replica that would pay a compile on its first request.
 READINESS_GATES: dict[str, object] = {}
 
-# model_id -> {name, version, algo, warmed_buckets,
-#              warm_baseline_misses, loaded_at} for artifacts loaded
-# over POST /3/ModelRegistry/load. `warm_baseline_misses` snapshots
-# the global scorer-cache miss counter right after warm-up, so
-# /3/Stats can report warm_cache_misses (misses since the replica
-# went warm — 0 is the contract; meaningful on single-model pods,
-# which is what the operator provisions).
+# model_id -> {name, version, algo, slo, warmed_buckets,
+#              warm_baseline, loaded_at} for artifacts loaded over
+# POST /3/ModelRegistry/load. `warm_baseline` snapshots the MODEL's
+# own (misses - promotions) right after warm-up, so /3/Stats reports
+# warm_cache_misses = (misses - promotions) - baseline per model: a
+# re-trace caused by byte-budget eviction (a `promotion`) re-baselines
+# out instead of reading as an SLO-violating first-request compile,
+# and one hot tenant's traces never pollute another tenant's counter.
 REGISTRY_MODELS: dict[str, dict] = {}
+
+# model_ids that must ALL be loaded+warmed before the model-registry
+# readiness gate passes (POST /3/ModelRegistry/require — the
+# multi-artifact push contract: the operator declares the full tenant
+# set up front so /readyz cannot flip between pushes). Empty = the
+# legacy ">= 1 artifact loaded" gate.
+REQUIRED_MODEL_IDS: set[str] = set()
 
 # REST-level counters scraped by the operator's autoscale signal
 # (GET /3/Stats): 504s from expired X-H2O-Deadline-Ms budgets, and
@@ -87,7 +95,115 @@ def _bump_stat(key: str) -> None:
         STATS[key] += 1
 
 
+# -- SLO classes + per-model fairness (multi-tenant serving) ----------------
+#
+# One hot model must not starve the tail of a tenant population: every
+# scoring request carries an SLO class (X-H2O-SLO header, else the
+# model's registry default, else H2O_TPU_SLO_DEFAULT) that sets (a)
+# its dispatch priority inside a batch window, (b) the share of the
+# admission queue any ONE model in that class may occupy, and (c) an
+# implicit per-request deadline for latency-class traffic.
+# H2O_TPU_SCORE_FAIRNESS=0 turns both the share cap and the priority
+# ordering off (the unfair baseline the Zipf bench measures against).
+
+SLO_CLASSES: dict[str, dict] = {
+    # latency-sensitive: dispatched first, smallest queue share, and
+    # an implicit deadline so a starved request 504s instead of
+    # silently blowing its budget
+    "interactive": {"priority": 0, "deadline_ms": 500.0,
+                    "queue_share": 0.25},
+    # the default: no implicit deadline (H2O_TPU_SCORE_TIMEOUT rules)
+    "standard": {"priority": 1, "deadline_ms": None,
+                 "queue_share": 0.5},
+    # throughput traffic: dispatched last, may fill the whole queue
+    "batch": {"priority": 2, "deadline_ms": None, "queue_share": 1.0},
+}
+
+# model_key -> per-tenant serving counters, scraped via GET /3/Stats
+# (the operator/autoscaler read per-model shed/deadline/breaker
+# pressure off this). Guarded by _STATS_LOCK.
+MODEL_STATS: dict[str, dict] = {}
+
+
+def _fairness_on() -> bool:
+    """H2O_TPU_SCORE_FAIRNESS (default on): per-model queue-share caps
+    + SLO-priority dispatch ordering. 0 restores the unfair FIFO
+    coalescer — kept as a measurable baseline, not a recommendation."""
+    return os.environ.get("H2O_TPU_SCORE_FAIRNESS", "1") != "0"
+
+
+def _default_slo() -> str:
+    raw = (os.environ.get("H2O_TPU_SLO_DEFAULT") or "standard").lower()
+    return raw if raw in SLO_CLASSES else "standard"
+
+
+def _model_queue_share(cls: dict) -> float:
+    """Fraction of the admission queue ONE model may occupy:
+    H2O_TPU_SCORE_MODEL_QUEUE_SHARE when set (> 0 — one global
+    override for every class), else the SLO class's own share."""
+    share = _env_float("H2O_TPU_SCORE_MODEL_QUEUE_SHARE", 0.0)
+    return min(share, 1.0) if share > 0 else cls["queue_share"]
+
+
+def _slo_class(name: str | None) -> dict:
+    return SLO_CLASSES.get(name or "", SLO_CLASSES["standard"])
+
+
+def _model_stats(key: str, slo: str | None = None) -> dict:
+    """The per-model counter record (created on first touch); caller
+    must hold _STATS_LOCK."""
+    rec = MODEL_STATS.get(key)
+    if rec is None:
+        rec = {"slo": slo or _default_slo(), "requests": 0, "shed": 0,
+               "deadline_504": 0, "breaker_rejects": 0, "batches": 0,
+               "rows": 0}
+        MODEL_STATS[key] = rec
+    elif slo:
+        rec["slo"] = slo
+    return rec
+
+
+def _bump_model_stat(key: str | None, stat: str, n: int = 1,
+                     slo: str | None = None) -> None:
+    if key is None:
+        return
+    with _STATS_LOCK:
+        _model_stats(key, slo)[stat] += n
+
+
+def _request_slo(headers) -> str | None:
+    """SLO class from X-H2O-SLO, or None. Unknown classes are a 400 —
+    silently downgrading a request that asked for 'interactive' to
+    best-effort would hide the typo until the p99 regression."""
+    raw = headers.get("X-H2O-SLO")
+    if raw is None:
+        return None
+    name = str(raw).strip().lower()
+    if name not in SLO_CLASSES:
+        raise ValueError(
+            f"unknown X-H2O-SLO class {raw!r} "
+            f"(known: {', '.join(sorted(SLO_CLASSES))})")
+    return name
+
+
+def _resolve_slo(mkey: str, header_slo: str | None) -> str:
+    """Per-request header wins, else the model's registry default
+    (set at artifact push), else H2O_TPU_SLO_DEFAULT."""
+    if header_slo:
+        return header_slo
+    info = REGISTRY_MODELS.get(mkey)
+    if info and info.get("slo") in SLO_CLASSES:
+        return info["slo"]
+    return _default_slo()
+
+
 def _registry_gate():
+    if REQUIRED_MODEL_IDS:
+        missing = sorted(REQUIRED_MODEL_IDS - set(REGISTRY_MODELS))
+        if missing:
+            return False, (f"required artifact(s) not loaded+warmed "
+                           f"yet: {missing[:4]}")
+        return True, ""
     if REGISTRY_MODELS:
         return True, ""
     return False, "no model artifact loaded+warmed yet"
@@ -166,9 +282,9 @@ def _score_row_cap() -> int:
 
 class _ScoreJob:
     __slots__ = ("model", "X", "offset", "event", "out", "err",
-                 "deadline")
+                 "deadline", "key", "slo")
 
-    def __init__(self, model, X, offset):
+    def __init__(self, model, X, offset, key=None, slo=None):
         self.model = model
         self.X = X
         self.offset = offset
@@ -176,19 +292,30 @@ class _ScoreJob:
         self.out = None
         self.err = None
         self.deadline = float("inf")
+        self.key = key          # model key (per-tenant accounting)
+        self.slo = slo          # SLO class name (fairness + priority)
 
 
 class ScoreBatcher:
-    """Collects concurrent scoring requests into per-model batches."""
+    """Collects concurrent scoring requests into per-model batches.
+
+    Per-MODEL aware (multi-tenant serving): jobs coalesce per
+    (model, offset?) group into one padded dispatch each; with
+    fairness on, any one model's share of the admission queue is
+    capped by its SLO class and groups dispatch in SLO-priority order
+    (smallest first within a class), so a hot model's flood cannot
+    starve a tail model out of its deadline."""
 
     def __init__(self):
         self._cond = threading.Condition()
         self._pending: list[_ScoreJob] = []
         self._inflight: list[_ScoreJob] = []
+        self._pending_by_key: dict[str, int] = {}
         self._thread: threading.Thread | None = None
         self._stopped = False
         self.stats = {"requests": 0, "batches": 0, "batched_rows": 0,
-                      "max_batch_requests": 0, "shed": 0}
+                      "max_batch_requests": 0, "shed": 0,
+                      "fairness_shed": 0}
 
     def _ensure_thread(self) -> None:
         if self._thread is None or not self._thread.is_alive():
@@ -208,7 +335,9 @@ class ScoreBatcher:
 
     def submit(self, model, X: np.ndarray, offset=None,
                timeout: float | None = None,
-               deadline: float | None = None) -> np.ndarray:
+               deadline: float | None = None,
+               model_key: str | None = None,
+               slo: str | None = None) -> np.ndarray:
         """Enqueue one scoring request; blocks until its slice of the
         batched result (or raises: health/breaker/drain fail-fast,
         queue-full load shed, timeout).
@@ -216,7 +345,10 @@ class ScoreBatcher:
         ``deadline`` is an absolute ``time.monotonic()`` instant (the
         per-request X-H2O-Deadline-Ms contract): the waiter stops
         waiting there, and the dispatcher drops the job unscored if it
-        only reaches it afterwards."""
+        only reaches it afterwards. ``model_key``/``slo`` drive the
+        per-tenant fairness cap + accounting; a deadline-less request
+        in a latency SLO class inherits the class's implicit
+        deadline."""
         from .runtime import health
 
         if self._stopped or not lifecycle.accepting():
@@ -232,10 +364,20 @@ class ScoreBatcher:
         # an OPEN breaker must reject at the front door — before the
         # queue, before the batch window. check() never claims the
         # half-open probe slot; that belongs to the dispatch itself.
-        lifecycle.BREAKER.check()
+        try:
+            lifecycle.BREAKER.check()
+        except CircuitOpenError:
+            _bump_model_stat(model_key, "breaker_rejects", slo=slo)
+            raise
+        cls = _slo_class(slo)
+        if deadline is None and cls["deadline_ms"]:
+            # latency-class traffic without an explicit budget still
+            # gets one: a starved interactive request must 504 inside
+            # its SLO, not wait out H2O_TPU_SCORE_TIMEOUT
+            deadline = time.monotonic() + cls["deadline_ms"] / 1000.0
         if timeout is None:
             timeout = _env_float("H2O_TPU_SCORE_TIMEOUT", 60.0)
-        job = _ScoreJob(model, X, offset)
+        job = _ScoreJob(model, X, offset, key=model_key, slo=slo)
         # the dispatcher drops jobs whose waiter has already timed out
         # (503'd and gone) instead of burning device time on them
         job.deadline = time.monotonic() + timeout
@@ -252,19 +394,48 @@ class ScoreBatcher:
                     f"node {lifecycle.state()}: draining — new scoring "
                     "requests are not admitted (finish in-flight work, "
                     "then route to a ready replica)")
-            if len(self._pending) >= self._queue_max():
+            qmax = self._queue_max()
+            if len(self._pending) >= qmax:
                 # load shedding: a full queue means latency is already
                 # past the batch window × depth — a fast 429 beats a
                 # slow 503 (and the OOM that unbounded queueing risks)
                 self.stats["shed"] += 1
+                _bump_model_stat(model_key, "shed", slo=slo)
                 raise QueueFullError(
                     f"scoring admission queue is full "
                     f"({len(self._pending)} pending, "
-                    f"H2O_TPU_SCORE_QUEUE_MAX={self._queue_max()}); "
+                    f"H2O_TPU_SCORE_QUEUE_MAX={qmax}); "
                     "shed — retry with backoff", retry_after=1.0)
+            if model_key is not None and _fairness_on():
+                # per-model fairness: ONE model may hold at most its
+                # SLO class's share of the admission queue, so a hot
+                # tenant's flood sheds against ITS OWN cap while tail
+                # tenants still find queue room — the starvation
+                # bound the Zipf bench measures
+                cap_m = max(1, int(qmax * _model_queue_share(cls)))
+                if self._pending_by_key.get(model_key, 0) >= cap_m:
+                    # counted as fairness_shed (+ the model's own
+                    # shed), NOT the global `shed` the autoscaler
+                    # scales up on: one hot tenant pinned at its
+                    # queue share is the cap working as designed,
+                    # not node capacity pressure — feeding it into
+                    # the autoscale signal would ride the pool to
+                    # max_replicas on an otherwise idle node
+                    self.stats["fairness_shed"] += 1
+                    _bump_model_stat(model_key, "shed", slo=slo)
+                    raise QueueFullError(
+                        f"model '{model_key}' holds its fair share of "
+                        f"the scoring queue ({cap_m} of {qmax}, SLO "
+                        f"class {slo or _default_slo()}); shed — "
+                        "retry with backoff "
+                        "(H2O_TPU_SCORE_FAIRNESS=0 disables)",
+                        retry_after=0.5)
+                self._pending_by_key[model_key] = \
+                    self._pending_by_key.get(model_key, 0) + 1
             self._ensure_thread()
             self._pending.append(job)
             self.stats["requests"] += 1
+            _bump_model_stat(model_key, "requests", slo=slo)
             self._cond.notify_all()
         # admitted: account serving-while-not-capable. The full
         # _ready_state() would add several lock acquisitions per
@@ -291,10 +462,11 @@ class ScoreBatcher:
                 # the CLIENT's budget ran out while queued: 504, same
                 # status as pre-admission expiry — a 503 would invite
                 # a retry of a request whose budget is already spent
+                _bump_model_stat(model_key, "deadline_504", slo=slo)
                 raise _DeadlineExpired(
                     "request deadline expired while queued in the "
-                    "micro-batcher (X-H2O-Deadline-Ms) — dropped "
-                    "unscored")
+                    "micro-batcher (X-H2O-Deadline-Ms / SLO class "
+                    "deadline) — dropped unscored")
             raise TimeoutError(
                 f"scoring request timed out after {wait_s:.0f}s in "
                 "the micro-batcher (H2O_TPU_SCORE_TIMEOUT / "
@@ -316,6 +488,7 @@ class ScoreBatcher:
             t.join(timeout)
         with self._cond:
             leftovers, self._pending = self._pending, []
+            self._pending_by_key.clear()
             # a batch the dispatcher already popped but never finished
             # (wedged dispatch) holds waiters too — fail them, don't
             # leave them to time out after os._exit
@@ -353,6 +526,8 @@ class ScoreBatcher:
                 time.sleep(min(win, 1.0))    # collect concurrent arrivals
             with self._cond:
                 batch, self._pending = self._pending, []
+                self._pending_by_key.clear()   # fairness counts queue
+                # occupancy only — popped jobs free their share
                 # tracked so stop() can fail these waiters too if this
                 # dispatch wedges past the drain deadline — a popped
                 # batch is otherwise invisible to the flush
@@ -377,12 +552,22 @@ class ScoreBatcher:
         for job in live:
             groups.setdefault(
                 (id(job.model), job.offset is not None), []).append(job)
+        ordered = list(groups.values())
+        if _fairness_on() and len(ordered) > 1:
+            # SLO-priority dispatch order, smallest group first within
+            # a class: a tail model's 8-row interactive request goes
+            # to the device BEFORE the hot model's coalesced flood,
+            # so its latency is bounded by its own work + one small
+            # dispatch — not by the hot model's batch size
+            ordered.sort(key=lambda jobs: (
+                min(_slo_class(j.slo)["priority"] for j in jobs),
+                sum(j.X.shape[0] for j in jobs)))
         # the per-request H2O_TPU_SCORE_MAX_ROWS cap must also bound
         # the COALESCED dispatch: N capped requests in one window would
         # otherwise concatenate into an N×-cap device program (the OOM
         # → locked-cloud outage the cap exists to prevent)
         cap = _score_row_cap()
-        for jobs in groups.values():
+        for jobs in ordered:
             while jobs:
                 rows = 0
                 chunk = []
@@ -405,6 +590,10 @@ class ScoreBatcher:
             self.stats["batches"] += 1
             self.stats["max_batch_requests"] = max(
                 self.stats["max_batch_requests"], len(jobs))
+            if jobs[0].key is not None:
+                _bump_model_stat(jobs[0].key, "batches")
+                _bump_model_stat(jobs[0].key, "rows",
+                                 sum(j.X.shape[0] for j in jobs))
             if len(jobs) == 1:
                 jobs[0].out = model.score_numpy(
                     jobs[0].X, offset=jobs[0].offset)
@@ -431,7 +620,8 @@ class ScoreBatcher:
 BATCHER = ScoreBatcher()
 
 
-def _predict_via_batcher(model, frame, deadline=None):
+def _predict_via_batcher(model, frame, deadline=None, model_key=None,
+                         slo=None):
     """Frame prediction through the micro-batcher: design matrix ->
     one (possibly coalesced) scoring dispatch -> prediction Frame.
     Models outside the jitted serving set keep the classic path."""
@@ -450,7 +640,8 @@ def _predict_via_batcher(model, frame, deadline=None):
         off = model._frame_offset(frame)   # the predict_raw contract
         if off is not None:
             off = np.asarray(off)[: frame.nrows]
-    out = BATCHER.submit(model, X, offset=off, deadline=deadline)
+    out = BATCHER.submit(model, X, offset=off, deadline=deadline,
+                         model_key=model_key, slo=slo)
     return model._prediction_frame(out)
 
 
@@ -756,35 +947,57 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/3/Stats":
                 # ONE scrape for operators + the autoscale signal:
                 # process-local serving counters that were previously
-                # invisible over REST (scorer cache, admission queue
-                # depth/shed, breaker, deadline 504s, registry warm
-                # state). Device-free: safe to poll on a wedged node.
-                from .models.base import scorer_cache_stats
+                # invisible over REST (scorer cache incl. resident
+                # bytes vs budget, admission queue depth/shed, breaker,
+                # deadline 504s, per-MODEL fairness counters, registry
+                # warm state, XLA compile watch). Device-free: safe to
+                # poll on a wedged node.
+                from .models.base import (model_scorer_counters,
+                                          scorer_cache_stats)
+                from .runtime.backend import compile_watch_snapshot
 
                 ready, reasons, st = _ready_state()
                 sc = scorer_cache_stats()
                 reg = {}
                 for mid, info in list(REGISTRY_MODELS.items()):
+                    # warm_cache_misses is PER MODEL and eviction-
+                    # aware: (misses - promotions) since the warm-up
+                    # baseline — a byte-budget eviction's re-trace is
+                    # a promotion, not an SLO-violating compile
+                    model = MODELS.get(mid)
+                    wcm = None
+                    if model is not None:
+                        ctr = model_scorer_counters(model)
+                        wcm = max(0, ctr["misses"] - ctr["promotions"]
+                                  - info.get("warm_baseline", 0))
                     reg[mid] = {
                         "name": info.get("name"),
                         "version": info.get("version"),
                         "algo": info.get("algo"),
+                        "slo": info.get("slo"),
                         "warmed_buckets": info.get("warmed_buckets"),
-                        "warm_cache_misses": sc["misses"]
-                        - info.get("warm_baseline_misses", sc["misses"]),
+                        "warm_cache_misses": wcm,
                     }
+                with _STATS_LOCK:
+                    per_model = {k: dict(v)
+                                 for k, v in MODEL_STATS.items()}
                 return self._json({
                     "ready": ready, "reasons": reasons, **st,
                     "scorer_cache": sc,
                     "batcher": {**BATCHER.stats,
                                 "queue_depth": BATCHER.queue_depth()},
                     "counters": dict(STATS),
+                    "models": per_model,
+                    "fairness": _fairness_on(),
+                    "compiles": compile_watch_snapshot(),
                     "registry": reg})
             if path == "/3/ModelRegistry":
-                return self._json({"models": {
-                    mid: {k: v for k, v in info.items()
-                          if k != "warm_baseline_misses"}
-                    for mid, info in REGISTRY_MODELS.items()}})
+                return self._json({
+                    "models": {
+                        mid: {k: v for k, v in info.items()
+                              if k != "warm_baseline"}
+                        for mid, info in REGISTRY_MODELS.items()},
+                    "required": sorted(REQUIRED_MODEL_IDS)})
             if path in ("", "/flow", "/flow/index.html"):
                 # the h2o-web Flow analog (SURVEY §2b C19): one
                 # self-contained page, same REST verbs as any client
@@ -954,6 +1167,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # per-request deadline: parsed up front so an expired
                 # budget is rejected before any queue slot or dispatch
                 deadline = _request_deadline(self.headers)
+                slo = _request_slo(self.headers)
             except ValueError as e:
                 # bad request envelope only: malformed JSON body or an
                 # unparseable X-H2O-Deadline-Ms — a ValueError from a
@@ -976,6 +1190,30 @@ class _Handler(BaseHTTPRequestHandler):
             # every POST verb does device work (parse shards onto the
             # mesh, builds/predictions dispatch collectives): on a dead
             # cloud degrade to 503 up front — reads (GET) stay served
+            if path == "/3/ModelRegistry/require":
+                # multi-artifact readiness: the operator declares the
+                # FULL tenant set before pushing, so /readyz cannot
+                # flip between artifact 1 landing and artifact N —
+                # device-free, allowed whatever the cloud's health
+                ids = params.get("model_ids")
+                if not isinstance(ids, list) or \
+                        not all(isinstance(i, str) and i for i in ids):
+                    return self._error(
+                        400, "need 'model_ids' (list of model id "
+                        "strings; [] clears the requirement)")
+                # monotone-safe swap (no lock shared with the /readyz
+                # gate): add the new ids FIRST, then drop the stale
+                # ones — between the two steps the set is a superset
+                # of old ∪ new, so a concurrent gate read can only be
+                # MORE strict, never observe an empty set and fall
+                # through to the legacy any-model-loaded gate
+                new_ids = set(ids)
+                REQUIRED_MODEL_IDS.update(new_ids)
+                REQUIRED_MODEL_IDS.intersection_update(new_ids)
+                ok, why = _registry_gate()
+                return self._json({"required": sorted(
+                    REQUIRED_MODEL_IDS), "satisfied": ok,
+                    "reason": why})
             if self._unhealthy_503():
                 return None
             if path == "/3/ModelRegistry/load":
@@ -1019,11 +1257,14 @@ class _Handler(BaseHTTPRequestHandler):
                     # out — no frame registration, scored through the
                     # micro-batcher + jitted-scorer cache
                     return self._score_rows(MODELS[mkey], mkey, params,
-                                            deadline=deadline)
+                                            deadline=deadline,
+                                            slo=slo)
                 if fpart not in FRAMES:
                     return self._error(404, f"frame '{fpart}' not found")
                 pred = _predict_via_batcher(MODELS[mkey], FRAMES[fpart],
-                                            deadline=deadline)
+                                            deadline=deadline,
+                                            model_key=mkey,
+                                            slo=_resolve_slo(mkey, slo))
                 key = f"prediction_{mkey}_{fpart}"
                 FRAMES[key] = pred
                 return self._json({"predictions_frame": {"name": key},
@@ -1104,12 +1345,17 @@ class _Handler(BaseHTTPRequestHandler):
         import hashlib
 
         from . import persist
-        from .models.base import scorer_cache_stats
+        from .models.base import model_scorer_counters
         from .operator.registry import load_artifact
 
         model_id = params.get("model_id")
         if not model_id or not isinstance(model_id, str):
             return self._error(400, "missing 'model_id'")
+        slo = params.get("slo")
+        if slo is not None and slo not in SLO_CLASSES:
+            return self._error(
+                400, f"unknown SLO class {slo!r} "
+                f"(known: {', '.join(sorted(SLO_CLASSES))})")
         b64 = params.get("artifact_b64")
         path = params.get("path")
         if b64:
@@ -1145,24 +1391,35 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._error(400, str(e))
         MODELS[model_id] = model
+        ctr = model_scorer_counters(model)
         REGISTRY_MODELS[model_id] = {
             "name": params.get("name"),
             "version": params.get("version"),
             "algo": model.algo,
+            "slo": slo,
             "warmed_buckets": warmed,
-            "warm_baseline_misses": scorer_cache_stats()["misses"],
+            # per-MODEL baseline: traces paid so far that were not
+            # promotions — /3/Stats diffs against this, so eviction
+            # re-traces (promotions) can never read as warm misses
+            "warm_baseline": ctr["misses"] - ctr["promotions"],
             "loaded_at": time.time(),
         }
+        with _STATS_LOCK:
+            _model_stats(model_id, slo)
         return self._json({"model_id": {"name": model_id},
                            "name": params.get("name"),
                            "version": params.get("version"),
                            "algo": model.algo,
+                           "slo": slo,
                            "warmed_buckets": warmed})
 
     def _score_rows(self, model, mkey: str, params: dict,
-                    deadline: float | None = None):
+                    deadline: float | None = None,
+                    slo: str | None = None):
         """POST /3/Predictions/models/{key} — serving-shaped scoring:
-        JSON rows in, predictions out, one micro-batched dispatch."""
+        JSON rows in, predictions out, one micro-batched dispatch
+        under the model's SLO class (header > registry default >
+        H2O_TPU_SLO_DEFAULT)."""
         if not getattr(model, "_serving_jit", False):
             # kmeans/isolationforest/stackedensemble & co. have no raw-
             # matrix serving contract (predict() overrides / composed
@@ -1201,7 +1458,9 @@ class _Handler(BaseHTTPRequestHandler):
                      for r in rows], dtype=np.float32)
         except (ValueError, TypeError, KeyError, IndexError) as e:
             return self._error(400, f"bad scoring payload: {e!r}")
-        out = BATCHER.submit(model, X, offset=off, deadline=deadline)
+        out = BATCHER.submit(model, X, offset=off, deadline=deadline,
+                             model_key=mkey,
+                             slo=_resolve_slo(mkey, slo))
         resp: dict = {"model_id": {"name": mkey}, "rows": len(rows)}
         if getattr(model, "nclasses", 1) > 1:
             dom = model.response_domain or \
@@ -1383,6 +1642,12 @@ def start_server(port: int = 54321, host: str = "127.0.0.1",
     completes — inside ``terminationGracePeriodSeconds``, ahead of the
     kubelet's SIGKILL."""
     srv = ThreadingHTTPServer((host, port), _Handler)
+    # compile accounting from server start: /3/Stats exposes the watch
+    # so operators (and the tenant-storm drill) can assert promotion
+    # compiles are persistent-cache hits, not cold compiles
+    from .runtime.backend import start_compile_watch
+
+    start_compile_watch()
     if os.environ.get("H2O_TPU_POOL_REPLICA") == "1":
         # operator-provisioned scorer replica: readiness additionally
         # requires a pushed+warmed registry artifact, so the Service

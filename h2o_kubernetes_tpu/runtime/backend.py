@@ -84,7 +84,8 @@ def backends_initialized() -> bool:
         return False
 
 
-def enable_persistent_compile_cache() -> None:
+def enable_persistent_compile_cache(
+        min_compile_secs: float | None = None) -> None:
     """Point jax's persistent compilation cache at a repo-local dir.
 
     Every capture tool runs in its own subprocess, so without this each
@@ -93,12 +94,38 @@ def enable_persistent_compile_cache() -> None:
     cross-process reuse is exact; bench warm-up/AutoML cold paths drop
     from minutes of compiles to reads.
 
+    ``min_compile_secs`` (or ``H2O_TPU_PCACHE_MIN_SECS``) overrides
+    the 0.5 s persistence threshold. Serving pods pass 0.0: the
+    byte-budgeted scorer cache's evict→promote contract ("an eviction
+    costs a pcache hit, never a cold compile") needs even sub-second
+    tenant-model compiles persisted, or a promotion would silently
+    recompile from scratch.
+
     Never IMPORTS jax (preserving this module's never-hang contract —
     the probe must run before any backend touch): env vars cover a
     not-yet-imported jax, and when jax IS already imported (its config
     no longer reads env) the config is updated through sys.modules,
-    which touches no backend. Fully a no-op when the user already set
-    JAX_COMPILATION_CACHE_DIR (their cache policy wins)."""
+    which touches no backend. Cache-DIR selection is a no-op when the
+    user already set JAX_COMPILATION_CACHE_DIR (their cache policy
+    wins), but an explicit ``min_compile_secs`` still applies."""
+    if min_compile_secs is None:
+        raw = os.environ.get("H2O_TPU_PCACHE_MIN_SECS")
+        if raw:
+            try:
+                min_compile_secs = float(raw)
+            except ValueError:
+                min_compile_secs = None
+    if min_compile_secs is not None:
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = \
+            str(min_compile_secs)
+        j = sys.modules.get("jax")
+        if j is not None:
+            try:
+                j.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    float(min_compile_secs))
+            except Exception:   # noqa: BLE001 — acceleration only
+                pass
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return
     try:

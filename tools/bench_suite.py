@@ -388,6 +388,52 @@ def main() -> int:
                leader=p0["leader"], leader_auc=p0["leader_auc"],
                scheduler_stats=p0.get("scheduler_stats"))
 
+    if _want("multitenant_zipf_p99"):
+        # config #5b (ISSUE 7): multi-tenant serving under a byte-
+        # budgeted executable cache — ≥100 registry-pushed tenants,
+        # Zipf(s) popularity, per-decile p99, residency vs budget,
+        # evict→promote pcache proof, and the hot-model storm with
+        # fairness ON vs OFF (the unfair leg must provably miss the
+        # tail's SLO). Runs in THIS process (self-hosted REST server);
+        # see tools/score_load.run_zipf_bench for the contract.
+        from tools.score_load import run_zipf_bench
+
+        mt_models = int(os.environ.get("BENCH_MT_MODELS", 100))
+        t0 = time.perf_counter()
+        mt = run_zipf_bench(
+            n_models=mt_models,
+            seconds=float(os.environ.get("BENCH_MT_SECONDS", 20)),
+            zipf_s=float(os.environ.get("BENCH_MT_ZIPF_S", 1.1)),
+            budget_mb=float(os.environ.get("BENCH_MT_BUDGET_MB", 4.0)))
+        dt = time.perf_counter() - t0
+        sweep = mt["sweep"]
+        res = sweep["residency"]
+        tail_decile = sweep["deciles"][-1] if sweep["deciles"] else {}
+        record("multitenant_zipf_p99",
+               sweep["p99_ms"] or 0.0, "p99_ms", dt, 1, 0.0,
+               models=mt["models"], zipf_s=mt["zipf_s"],
+               budget_mb=mt["budget_mb"],
+               sweep_requests=sweep["requests"],
+               sweep_rows_per_s=sweep["value"],
+               sweep_p50_ms=sweep["p50_ms"],
+               sweep_fivexx=sweep["fivexx"],
+               tail_decile_p99_ms=tail_decile.get("p99_ms"),
+               deciles=sweep["deciles"],
+               residency=res,
+               budget_held=bool(res["samples"] > 0
+                                and res["budget_exceeded"] == 0),
+               promotions=res["promotions_delta"],
+               promotion_compiles_all_pcache_hits=bool(
+                   res["pcache_misses_delta"] == 0
+                   and res["compiles_delta"]
+                   == res["pcache_hits_delta"]),
+               evict_promote_bitwise=mt["evict_promote_bitwise"],
+               storm_fair=mt["storm_fair"],
+               storm_unfair=mt["storm_unfair"],
+               fair_tail_slo_met=mt["storm_fair"]["tail_slo_met"],
+               unfair_tail_slo_met=mt["storm_unfair"]["tail_slo_met"],
+               scorer_cache_final=mt["scorer_cache_final"])
+
     # -- config #6: the 10M-row chunked-path proofs --------------------
     rows_10m = int(os.environ.get("BENCH_ROWS_10M", 10_000_000))
 
@@ -446,7 +492,7 @@ def main() -> int:
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r07{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r08{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

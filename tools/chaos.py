@@ -776,12 +776,18 @@ def scenario_automl_pipelined_fault() -> None:
 
 class _PoolFixture:
     """A converged 2-replica scorer pool on artifact v1 (+v2 staged in
-    the registry) — the shared setup of the rolling-update and
-    replica-kill drills. Always tear down via close(): subprocess pods
-    must not outlive a failed drill (tools/run_tests.py's preflight
-    would reap them, but a clean drill leaves a clean box)."""
+    the registry) — the shared setup of the rolling-update,
+    replica-kill and tenant-storm drills. ``tenants`` > 0 adds that
+    many EXTRA artifacts to the spec (multi-artifact push: the pool
+    serves a tenant population, /readyz held until every one is
+    loaded+warmed); ``pod_env`` injects env overrides into the pods
+    (the tenant-storm drill pins a tiny scorer-cache byte budget).
+    Always tear down via close(): subprocess pods must not outlive a
+    failed drill (tools/run_tests.py's preflight would reap them, but
+    a clean drill leaves a clean box)."""
 
-    def __init__(self, tag: str):
+    def __init__(self, tag: str, tenants: int = 0,
+                 pod_env: dict | None = None):
         import tempfile
 
         import numpy as np
@@ -808,10 +814,27 @@ class _PoolFixture:
         self.registry = ModelRegistry(os.path.join(self.td, "registry"))
         self.v1 = self.registry.publish(m1, "scorer")
         self.v2 = self.registry.publish(m2, "scorer")
+        extra = ()
+        self.tenant_keys = ["pm"]
+        if tenants:
+            # a second, structurally different artifact (more trees =
+            # different HLO) so the tenant set is not one program
+            # compiled once — the storm's pcache assertions must hold
+            # across genuinely distinct executables
+            m3 = GBM(ntrees=8, max_depth=3, seed=3).train(
+                y="y", training_frame=fr)
+            self.registry.publish(m3, "scorer2")
+            keys = [f"t{i:02d}" for i in range(1, tenants + 1)]
+            extra = tuple(
+                ("scorer" if i % 2 else "scorer2",
+                 self.v1 if i % 2 else 1, k)
+                for i, k in enumerate(keys, start=1))
+            self.tenant_keys += keys
         self.store = PoolStore()
         self.store.apply(ScorerPoolSpec(
             name="pool", artifact="scorer", version=self.v1,
-            model_key="pm", replicas=2, warm_buckets=(128,)))
+            model_key="pm", replicas=2, warm_buckets=(128,),
+            extra_artifacts=extra, env=dict(pod_env or {})))
         self.rec = Reconciler(self.store, self.registry, "pool",
                               log_dir=os.path.join(self.td, "logs"))
         self.stop = threading.Event()
@@ -941,6 +964,58 @@ def scenario_replica_kill() -> None:
         fx.close()
 
 
+def scenario_tenant_storm() -> None:
+    """Zipf tenant flood against a 2-replica multi-artifact pool under
+    a deliberately tiny executable-cache byte budget: resident scorer
+    bytes never exceed the budget on either replica, zero 5xx on any
+    tenant (an evicted model must re-promote transparently, never
+    error), eviction→promotion churn actually happens, and every
+    compile during the flood is a persistent-XLA-cache HIT — the
+    "eviction costs a pcache hit, never a cold compile" contract
+    proven on real subprocess pods."""
+    from tools.score_load import run_load_zipf
+
+    budget = 400_000
+    fx = _PoolFixture("storm", tenants=10, pod_env={
+        "H2O_TPU_SCORER_CACHE_BYTES": str(budget)})
+    try:
+        out = run_load_zipf(fx.rec.endpoints, fx.tenant_keys,
+                            fx.feature_cols, concurrency=4,
+                            rows_per_request=8, seconds=8.0,
+                            zipf_s=1.1)
+        _check(out["requests"] > 50,
+               f"tenant flood barely ran: {out}")
+        _check(out["fivexx"] == 0,
+               f"{out['fivexx']} 5xx during the tenant storm "
+               f"(sample: {out['fivexx_sample']}) — an evicted tenant "
+               "must re-promote, not error")
+        _check(out["errors"] == 0,
+               f"client errors during the storm: {out['error_sample']}")
+        served = [k for k, r in out["by_model"].items()
+                  if r["requests"] > 0]
+        _check(len(served) == len(fx.tenant_keys),
+               f"only {len(served)}/{len(fx.tenant_keys)} tenants saw "
+               "traffic — the Zipf flood did not cover the tail")
+        res = out["residency"]
+        _check(res["samples"] > 0, "no /3/Stats residency samples")
+        _check(res["budget_bytes"] == budget,
+               f"pods did not pick up the byte budget: {res}")
+        _check(res["budget_exceeded"] == 0
+               and res["max_resident_bytes"] <= budget,
+               f"resident bytes exceeded the budget: {res}")
+        _check((res["promotions_delta"] or 0) > 0,
+               f"no eviction→promotion churn under a {budget}B budget "
+               f"with {len(fx.tenant_keys)} tenants: {res}")
+        _check(res["pcache_misses_delta"] == 0,
+               f"a promotion compiled COLD (persistent-cache miss) "
+               f"during the flood: {res}")
+        _check(res["compiles_delta"] == res["pcache_hits_delta"],
+               f"flood-window compiles not fully served from the "
+               f"persistent cache: {res}")
+    finally:
+        fx.close()
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -953,6 +1028,7 @@ SCENARIOS = {
     "automl-pipelined-fault": scenario_automl_pipelined_fault,
     "rolling-update": scenario_rolling_update,
     "replica-kill": scenario_replica_kill,
+    "tenant-storm": scenario_tenant_storm,
 }
 
 

@@ -27,6 +27,18 @@ _CARRIERS = ["AA", "AS", "B6", "CO", "DL", "EV", "F9", "FL", "HA",
              "YV", "9E", "OH", "TZ"]
 
 
+def zipf_probs(n: int, s: float = 1.0) -> np.ndarray:
+    """Normalized Zipf(s) probabilities over ranks 1..n (rank 1
+    hottest). The ONE popularity shape this repo uses — airport hubs
+    (airlines_arrays), word frequencies (text8_like_tokens), and
+    model-popularity traffic shaping (tools/score_load.py's
+    multi-tenant mode all draw from it)."""
+    if n < 1:
+        raise ValueError(f"zipf_probs needs n >= 1, got {n}")
+    p = 1.0 / (np.arange(1, n + 1, dtype=np.float64) ** float(s))
+    return p / p.sum()
+
+
 def airlines_arrays(rows: int, seed: int = 0, na_frac: float = 0.02):
     """Airlines-10M shape: ~30 mixed columns, NAs, binary target.
 
@@ -75,8 +87,7 @@ def airlines_arrays(rows: int, seed: int = 0, na_frac: float = 0.02):
     cols["AirTime"] = with_na(elapsed * 0.8
                               + rng.normal(0, 5, size=rows))
     # Zipf-ish airport popularity (hubs dominate, like the real table)
-    pop = 1.0 / (np.arange(1, n_airports + 1) ** 0.8)
-    pop /= pop.sum()
+    pop = zipf_probs(n_airports, s=0.8)
     origin_idx = rng.choice(n_airports, size=rows, p=pop)
     dest_idx = rng.choice(n_airports, size=rows, p=pop)
     cols["Origin"] = origin_idx.astype(f32)
@@ -208,10 +219,8 @@ def text8_like_tokens(n_tokens: int, vocab_size: int = 10_000,
     NA sentence delimiters every ~sentence_len tokens (the h2o-3 W2V
     frame convention)."""
     rng = np.random.default_rng(seed)
-    ranks = np.arange(1, vocab_size + 1)
-    p = 1.0 / ranks
-    p /= p.sum()
-    idx = rng.choice(vocab_size, size=n_tokens, p=p)
+    idx = rng.choice(vocab_size, size=n_tokens,
+                     p=zipf_probs(vocab_size, s=1.0))
     toks = np.array([f"w{i}" for i in range(vocab_size)],
                     dtype=object)[idx]
     toks[::sentence_len] = None

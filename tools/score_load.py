@@ -47,12 +47,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def _post_json(url: str, payload: dict, timeout: float = 120.0) -> dict:
+def _post_json(url: str, payload: dict, timeout: float = 120.0,
+               headers: dict | None = None) -> dict:
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode(), method="POST",
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     with urllib.request.urlopen(req, timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:  # noqa: BLE001 — scrape is best-effort
+        return None
 
 
 def _self_server(port: int = 0):
@@ -87,31 +96,36 @@ def _self_server(port: int = 0):
             [f"x{i}" for i in range(8)] + ["c1"])
 
 
+def _percentile_ms(lat: list[float], p: float):
+    """Index-pick percentile in ms over raw seconds latencies (None
+    when empty) — the ONE percentile formula every load mode uses."""
+    if not lat:
+        return None
+    lat = sorted(lat)
+    return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2)
+
+
 def _result_record(latencies: list[float], wall: float,
                    rows_per_request: int, concurrency: int,
                    fivexx: list[str], errors: list[str],
                    **extra) -> dict:
-    """The one result-record shape shared by both load modes — a new
-    field lands in single-target AND multi-target output or neither."""
-    lat = sorted(latencies)
-
-    def pct(p):
-        return round(lat[min(len(lat) - 1, int(p * len(lat)))] * 1e3, 2) \
-            if lat else None
-
+    """The one result-record shape shared by every load mode — a new
+    field lands in single-target AND multi-target AND zipf output or
+    none of them."""
+    n = len(latencies)
     return {
         "metric": "rest_score_rows_per_sec",
-        "value": round(len(lat) * rows_per_request / max(wall, 1e-9), 1),
+        "value": round(n * rows_per_request / max(wall, 1e-9), 1),
         "unit": "rows/s",
-        "requests": len(lat),
-        "requests_per_s": round(len(lat) / max(wall, 1e-9), 1),
+        "requests": n,
+        "requests_per_s": round(n / max(wall, 1e-9), 1),
         "fivexx": len(fivexx),
         "fivexx_sample": fivexx[:5],
         "errors": len(errors),
         "error_sample": errors[:3],
-        "p50_ms": pct(0.50),
-        "p95_ms": pct(0.95),
-        "p99_ms": pct(0.99),
+        "p50_ms": _percentile_ms(latencies, 0.50),
+        "p95_ms": _percentile_ms(latencies, 0.95),
+        "p99_ms": _percentile_ms(latencies, 0.99),
         "concurrency": concurrency,
         "rows_per_request": rows_per_request,
         "seconds": round(wall, 2),
@@ -314,6 +328,487 @@ def run_load_multi(targets, model_key: str, columns: list[str],
                           by_target=by_target)
 
 
+# ---------------------------------------------------------------------------
+# Multi-tenant Zipf traffic (docs/SERVING.md "Multi-tenant serving")
+# ---------------------------------------------------------------------------
+#
+# ``--models N --zipf-s S`` drives N registry-pushed models with
+# Zipf(s) popularity (rank 1 hottest) — the tenant-population shape a
+# fleet node actually serves.  Per-model latency/5xx/shed accounting
+# rides the same body-pool / result-record plumbing as the pool modes,
+# plus popularity-DECILE percentiles (the tail decile is the fairness
+# contract's needle) and a /3/Stats scrape of the byte-budgeted scorer
+# cache (resident bytes vs budget, evictions, promotions, compile
+# watch).  ``run_zipf_bench`` is the bench_suite entry: residency
+# sweep + the hot-model storm legs (fairness on vs off).
+
+
+def _self_server_tenants(n_models: int, seed: int = 0,
+                         base_variants: int = 4,
+                         warm_buckets=(128,), port: int = 0):
+    """In-process REST server with ``n_models`` registry-loaded tiny
+    FlatTreeScorers under keys m000..m{N-1}; returns
+    (server, url, model_keys, feature_columns).
+
+    A handful of distinct base GBMs rotate across the tenant keys:
+    every tenant is its OWN model instance (own jitted executables,
+    own byte charge) while the artifact variety keeps warm-up cost
+    bounded — same-HLO tenants warm from the persistent XLA cache."""
+    import socket
+
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu import rest
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.operator.registry import ModelRegistry
+    from h2o_kubernetes_tpu.runtime import make_mesh, set_global_mesh
+    from h2o_kubernetes_tpu.runtime.backend import \
+        enable_persistent_compile_cache
+
+    # every serving compile must persist (threshold 0): the
+    # evict→promote contract under a byte budget is "a pcache hit,
+    # never a cold compile", and tenant models compile in << 0.5s
+    enable_persistent_compile_cache(min_compile_secs=0.0)
+    set_global_mesh(make_mesh())
+    rng = np.random.default_rng(seed)
+    n = 2000
+    cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+            for i in range(6)}
+    cols["y"] = np.where(cols["x0"] - cols["x1"] > 0, "late", "ontime")
+    fr = h2o.Frame.from_arrays(cols)
+    reg = ModelRegistry(f"mem://score_load_tenants_{os.getpid()}")
+    nb = max(1, min(base_variants, n_models))
+    arts = []
+    for b in range(nb):
+        m = GBM(ntrees=2 + b, max_depth=2, seed=b + 1).train(
+            y="y", training_frame=fr)
+        reg.publish(m, f"tenant{b}")
+        arts.append(f"tenant{b}")
+    if port == 0:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+    srv = rest.start_server(port)
+    url = f"http://127.0.0.1:{port}"
+    keys = [f"m{i:03d}" for i in range(n_models)]
+    for i, key in enumerate(keys):
+        reg.push(url, arts[i % nb], 1, key,
+                 warm_buckets=list(warm_buckets))
+    return srv, url, keys, [f"x{i}" for i in range(6)]
+
+
+def _popularity_deciles(model_keys: list[str],
+                        per_model: dict) -> list[dict]:
+    """Aggregate per-model records into 10 popularity-rank deciles
+    (decile 1 = hottest ranks). The TAIL decile's p99 is the fairness
+    acceptance needle: it must hold its SLO while a hot decile
+    floods."""
+    N = len(model_keys)
+    out = []
+    for d in range(10):
+        lo, hi = (d * N) // 10, ((d + 1) * N) // 10
+        ks = model_keys[lo:hi]
+        if not ks:
+            continue
+        lats = [t for k in ks for t in per_model[k]["lat"]]
+        out.append({
+            "decile": d + 1,
+            "models": len(ks),
+            "requests": sum(per_model[k]["requests"] for k in ks),
+            "fivexx": sum(per_model[k]["fivexx"] for k in ks),
+            "shed": sum(per_model[k]["shed"] for k in ks),
+            "p50_ms": _percentile_ms(lats, 0.50),
+            "p99_ms": _percentile_ms(lats, 0.99),
+        })
+    return out
+
+
+def run_load_zipf(targets, model_keys: list[str], columns: list[str],
+                  concurrency: int = 8, rows_per_request: int = 16,
+                  seconds: float = 15.0, zipf_s: float = 1.1,
+                  seed: int = 0, stop_event=None,
+                  request_timeout: float = 30.0,
+                  stats_poll_s: float = 0.5) -> dict:
+    """Closed-loop Zipf(s) model-popularity drive: each request picks
+    its model by popularity rank (key order = rank, 1 hottest) and
+    round-robins over the READY targets, exactly like the pool mode.
+
+    Returns the shared result record plus ``by_model`` (per-tenant
+    requests/latency/5xx/shed), popularity ``deciles``, and a
+    ``residency`` section sampled off /3/Stats every ``stats_poll_s``
+    (max resident bytes observed, whether the byte budget was ever
+    exceeded, eviction/promotion/compile deltas over the run)."""
+    import urllib.error
+
+    import numpy as np
+
+    from tools.datasets import zipf_probs
+
+    if isinstance(targets, str):
+        targets = [targets]
+    get_targets = targets if callable(targets) else (lambda: targets)
+    probs = zipf_probs(len(model_keys), zipf_s)
+    stop = stop_event or threading.Event()
+    deadline = time.perf_counter() + seconds
+    bodies = _make_bodies(columns, rows_per_request, seed)
+    lock = threading.Lock()
+    ready: set[str] = set()
+    latencies: list[float] = []
+    fivexx: list[str] = []
+    errors: list[str] = []
+    per_model = {k: {"requests": 0, "fivexx": 0, "shed": 0,
+                     "fourxx": 0, "lat": []} for k in model_keys}
+    residency = {"samples": 0, "max_resident_bytes": 0,
+                 "budget_bytes": None, "budget_exceeded": 0,
+                 "max_resident_models": 0}
+    stats_first: dict[str, dict] = {}   # per TARGET: deltas must not
+    stats_last: dict[str, dict] = {}    # mix one replica into another
+
+    def _done() -> bool:
+        return stop.is_set() or time.perf_counter() >= deadline
+
+    def poller():
+        while not _done():
+            now_ready = set()
+            for t in list(get_targets()):
+                st = _get_json(t.rstrip("/") + "/readyz", timeout=2.0)
+                if st is not None:
+                    now_ready.add(t.rstrip("/"))
+            with lock:
+                ready.clear()
+                ready.update(now_ready)
+            # residency watch: the budget contract is "never exceeded
+            # WHILE the storm runs", so it is sampled live, not once
+            # at the end
+            for t in sorted(now_ready):
+                st = _get_json(t + "/3/Stats", timeout=2.0)
+                if not st:
+                    continue
+                sc = st.get("scorer_cache") or {}
+                with lock:
+                    stats_first.setdefault(t, st)
+                    stats_last[t] = st
+                    residency["samples"] += 1
+                    rb = int(sc.get("resident_bytes") or 0)
+                    bb = int(sc.get("budget_bytes") or 0)
+                    residency["max_resident_bytes"] = max(
+                        residency["max_resident_bytes"], rb)
+                    residency["max_resident_models"] = max(
+                        residency["max_resident_models"],
+                        int(sc.get("resident") or 0))
+                    residency["budget_bytes"] = bb
+                    if bb > 0 and rb > bb:
+                        residency["budget_exceeded"] += 1
+            time.sleep(stats_poll_s)
+
+    rr = [0]
+
+    def worker(wid: int) -> None:
+        rng = np.random.default_rng(seed * 1000 + wid + 1)
+        i = wid
+        while not _done():
+            with lock:
+                pool = sorted(ready)
+                if pool:
+                    target = pool[rr[0] % len(pool)]
+                    rr[0] += 1
+            if not pool:
+                time.sleep(0.02)
+                continue
+            key = model_keys[int(rng.choice(len(model_keys), p=probs))]
+            body = bodies[i % len(bodies)]
+            i += 1
+            route = f"{target}/3/Predictions/models/{key}"
+            t0 = time.perf_counter()
+            try:
+                out = _post_json(route, body, timeout=request_timeout)
+                ok = len(out["predict"]) == rows_per_request
+                dt = time.perf_counter() - t0
+                with lock:
+                    rec = per_model[key]
+                    rec["requests"] += 1
+                    if ok:
+                        rec["lat"].append(dt)
+                        latencies.append(dt)
+                    else:
+                        errors.append(f"{key}: short response")
+            except urllib.error.HTTPError as e:
+                label = f"{key}: HTTP {e.code} {e.read()[:120]!r}"
+                with lock:
+                    rec = per_model[key]
+                    rec["requests"] += 1
+                    if e.code >= 500:
+                        rec["fivexx"] += 1
+                        fivexx.append(label)
+                    elif e.code == 429:
+                        rec["shed"] += 1
+                    else:
+                        rec["fourxx"] += 1
+                        errors.append(label[:200])
+                if e.code == 429:
+                    time.sleep(0.005)   # shed: brief backoff, retry on
+            except Exception as e:  # noqa: BLE001 — record, keep going
+                with lock:
+                    errors.append(f"{key}: {e!r}"[:200])
+
+    t_start = time.perf_counter()
+    pt = threading.Thread(target=poller, daemon=True,
+                          name="score-load-zipf-poller")
+    pt.start()
+    workers = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    for t in workers:
+        t.start()
+    for t in workers:
+        t.join()
+    pt.join(timeout=5.0)
+    wall = time.perf_counter() - t_start
+
+    def _delta(section: str, field: str):
+        tot, seen = 0, False
+        for t, st0 in stats_first.items():
+            st1 = stats_last.get(t)
+            if not st1:
+                continue
+            a = (st0.get(section) or {}).get(field)
+            b = (st1.get(section) or {}).get(field)
+            if a is None or b is None:
+                continue
+            tot += b - a
+            seen = True
+        return tot if seen else None
+
+    residency["evictions_delta"] = _delta("scorer_cache", "evictions")
+    residency["promotions_delta"] = _delta("scorer_cache",
+                                           "promotions")
+    residency["compiles_delta"] = _delta("compiles", "compiles")
+    residency["pcache_hits_delta"] = _delta("compiles", "pcache_hits")
+    residency["pcache_misses_delta"] = _delta("compiles",
+                                              "pcache_misses")
+    shed = sum(r["shed"] for r in per_model.values())
+    return _result_record(
+        latencies, wall, rows_per_request, concurrency, fivexx, errors,
+        zipf_s=zipf_s, models=len(model_keys), shed=shed,
+        by_model={k: {"requests": r["requests"],
+                      "fivexx": r["fivexx"], "shed": r["shed"],
+                      "p50_ms": _percentile_ms(r["lat"], 0.50),
+                      "p99_ms": _percentile_ms(r["lat"], 0.99)}
+                  for k, r in per_model.items()},
+        deciles=_popularity_deciles(model_keys, per_model),
+        residency=residency)
+
+
+def _storm_leg(url: str, hot_key: str, tail_key: str,
+               columns: list[str], fair: bool,
+               hot_workers: int = 16, hot_rows: int = 256,
+               tail_rows: int = 8, seconds: float = 6.0,
+               queue_max: int = 8, tail_deadline_ms: float = 500.0,
+               seed: int = 0) -> dict:
+    """One hot-model storm leg: ``hot_workers`` closed-loop threads
+    flood ``hot_key`` (standard class) while ONE tail worker sends
+    small ``interactive``-class requests to ``tail_key``. The tail's
+    SLO is met iff it was never shed and never 5xx'd/504'd: the
+    interactive class carries an IMPLICIT server-side deadline
+    (rest.SLO_CLASSES), so every 200 response proves its result was
+    ready inside that deadline — zero 504s IS the server-side p99 ≤
+    deadline proof, immune to the load generator's own scheduling
+    noise (client-observed p99 is recorded alongside, informational:
+    on a 1-core box it includes generator GIL/scheduler time). With
+    fairness ON the hot model sheds against its own queue share and
+    the tail is admitted + dispatched first by construction; with
+    fairness OFF the hot flood owns the whole queue and the tail
+    provably misses (shed and/or 504)."""
+    import urllib.error
+
+    os.environ["H2O_TPU_SCORE_FAIRNESS"] = "1" if fair else "0"
+    os.environ["H2O_TPU_SCORE_QUEUE_MAX"] = str(queue_max)
+    # a wide batch window makes the storm's queue dynamics structural
+    # instead of timing-dependent: while the dispatcher collects, the
+    # closed-loop hot flood refills the queue to its cap — unfair, the
+    # tail then finds it FULL (shed/504, the provable miss); fair, the
+    # hot model's share cap leaves tail room by construction
+    os.environ["H2O_TPU_SCORE_BATCH_US"] = "20000"
+    hot_bodies = _make_bodies(columns, hot_rows, seed, pool=4)
+    tail_bodies = _make_bodies(columns, tail_rows, seed + 1, pool=4)
+    stop = threading.Event()
+    lock = threading.Lock()
+    hot = {"requests": 0, "shed": 0, "fivexx": 0}
+    tail = {"requests": 0, "shed": 0, "fivexx": 0, "deadline_504": 0,
+            "fourxx": 0, "lat": []}
+
+    def hot_worker(wid: int) -> None:
+        i = wid
+        route = f"{url}/3/Predictions/models/{hot_key}"
+        while not stop.is_set():
+            body = hot_bodies[i % len(hot_bodies)]
+            i += 1
+            try:
+                _post_json(route, body, timeout=30.0)
+                with lock:
+                    hot["requests"] += 1
+            except urllib.error.HTTPError as e:
+                with lock:
+                    hot["requests"] += 1
+                    if e.code == 429:
+                        hot["shed"] += 1
+                    elif e.code >= 500:
+                        hot["fivexx"] += 1
+                e.read()
+                if e.code == 429:
+                    time.sleep(0.01)    # shed backoff: don't spin
+            except Exception:  # noqa: BLE001 — the leg keeps driving
+                pass
+
+    def tail_worker() -> None:
+        i = 0
+        route = f"{url}/3/Predictions/models/{tail_key}"
+        while not stop.is_set():
+            body = tail_bodies[i % len(tail_bodies)]
+            i += 1
+            t0 = time.perf_counter()
+            try:
+                _post_json(route, body, timeout=30.0,
+                           headers={"X-H2O-SLO": "interactive"})
+                with lock:
+                    tail["requests"] += 1
+                    tail["lat"].append(time.perf_counter() - t0)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    tail["requests"] += 1
+                    if e.code == 429:
+                        tail["shed"] += 1
+                    elif e.code == 504:
+                        tail["deadline_504"] += 1
+                    elif e.code >= 500:
+                        tail["fivexx"] += 1
+                    else:
+                        # residual 4xx (bad key/payload): counted, so
+                        # an all-errors leg cannot read as SLO-met
+                        tail["fourxx"] += 1
+                e.read()
+                time.sleep(0.005)
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.01)    # ~100 rps offered tail rate
+
+    # warm both request shapes before the clock starts: the leg
+    # measures fairness under load, not a first-dispatch compile
+    # (hot_rows may pad to a bucket warm-up never traced)
+    try:
+        _post_json(f"{url}/3/Predictions/models/{hot_key}",
+                   hot_bodies[0], timeout=120.0)
+        _post_json(f"{url}/3/Predictions/models/{tail_key}",
+                   tail_bodies[0], timeout=120.0)
+    except Exception:  # noqa: BLE001 — the leg's own counters judge
+        pass
+    threads = [threading.Thread(target=hot_worker, args=(w,),
+                                daemon=True)
+               for w in range(hot_workers)]
+    threads.append(threading.Thread(target=tail_worker, daemon=True))
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    p99 = _percentile_ms(tail["lat"], 0.99)
+    # zero shed + zero 504 + zero 5xx/4xx AND at least one SUCCESSFUL
+    # score == the SLO held: every admitted tail request produced its
+    # result inside the interactive class's server-enforced deadline
+    # (a late result would have 504'd). len(lat) > 0, not requests >
+    # 0: a leg that only ever errored (bad key, unloaded artifact)
+    # must never read as a passing fairness proof.
+    slo_met = (tail["shed"] == 0 and tail["fivexx"] == 0
+               and tail["deadline_504"] == 0 and tail["fourxx"] == 0
+               and len(tail["lat"]) > 0)
+    return {"fair": fair, "seconds": seconds,
+            "queue_max": queue_max, "hot_workers": hot_workers,
+            "hot_rows": hot_rows, "tail_rows": tail_rows,
+            "hot": dict(hot),
+            "tail": {**{k: v for k, v in tail.items() if k != "lat"},
+                     "p50_ms": _percentile_ms(tail["lat"], 0.50),
+                     "p99_ms": p99,
+                     "deadline_ms": tail_deadline_ms},
+            "tail_slo_met": slo_met}
+
+
+def run_zipf_bench(n_models: int = 100, seconds: float = 15.0,
+                   zipf_s: float = 1.1, budget_mb: float = 4.0,
+                   concurrency: int = 6, rows_per_request: int = 16,
+                   storm_seconds: float = 6.0, seed: int = 0) -> dict:
+    """The BENCH_SUITE multi-tenant leg (one self-contained record):
+
+    1. **Residency sweep** — ``n_models`` registry-pushed tenants
+       under a ``budget_mb`` byte budget, Zipf(s) traffic: resident
+       bytes must never exceed the budget, evictions/promotions churn,
+       and every compile during the sweep is a persistent-cache HIT
+       (promotion re-traces recompile known HLO — the "eviction costs
+       a pcache hit, never a cold compile" contract).
+    2. **Evict→promote parity** — one tenant force-evicted and
+       re-scored: output must be bitwise-identical.
+    3. **Hot-model storm** — fairness ON vs OFF: the tail tenant's
+       interactive SLO must hold under fairness and provably miss
+       without it."""
+    import numpy as np
+
+    saved = {k: os.environ.get(k) for k in
+             ("H2O_TPU_SCORER_CACHE_BYTES", "H2O_TPU_SCORE_FAIRNESS",
+              "H2O_TPU_SCORE_QUEUE_MAX", "H2O_TPU_SCORE_BATCH_US")}
+    os.environ["H2O_TPU_SCORER_CACHE_BYTES"] = \
+        str(int(budget_mb * 2 ** 20))
+    srv = None
+    try:
+        srv, url, keys, columns = _self_server_tenants(
+            n_models, seed=seed)
+        sweep = run_load_zipf(
+            url, keys, columns, concurrency=concurrency,
+            rows_per_request=rows_per_request, seconds=seconds,
+            zipf_s=zipf_s, seed=seed)
+
+        # 2. evict→promote bitwise parity on a live tenant
+        from h2o_kubernetes_tpu import rest
+        from h2o_kubernetes_tpu.models.base import evict_scorer_cache
+
+        probe = rest.MODELS[keys[-1]]
+        rng = np.random.default_rng(seed + 7)
+        Xp = rng.normal(size=(64, len(columns))).astype(np.float32)
+        before = probe.score_numpy(Xp)
+        evict_scorer_cache(probe)
+        after = probe.score_numpy(Xp)
+        bitwise = bool(np.array_equal(before, after))
+
+        storm_fair = _storm_leg(url, keys[0], keys[-1], columns,
+                                fair=True, seconds=storm_seconds,
+                                seed=seed)
+        storm_unfair = _storm_leg(url, keys[0], keys[-1], columns,
+                                  fair=False, seconds=storm_seconds,
+                                  seed=seed)
+        final = _get_json(url + "/3/Stats") or {}
+        return {
+            "metric": "multitenant_zipf_p99",
+            "models": n_models,
+            "zipf_s": zipf_s,
+            "budget_mb": budget_mb,
+            "sweep": {k: sweep[k] for k in
+                      ("value", "requests", "p50_ms", "p99_ms",
+                       "fivexx", "shed", "deciles", "residency")},
+            "evict_promote_bitwise": bitwise,
+            "storm_fair": storm_fair,
+            "storm_unfair": storm_unfair,
+            "scorer_cache_final": final.get("scorer_cache"),
+            "compiles_final": final.get("compiles"),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        if srv is not None:
+            srv.shutdown()
+
+
 def main(argv: list[str]) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", default=None,
@@ -327,6 +822,14 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--rows", type=int, default=32,
                     help="rows per request")
     ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--models", type=int, default=0,
+                    help="multi-tenant mode: drive N models under "
+                    "Zipf popularity (self-host: N tiny registry-"
+                    "pushed tenants m000..; with --url, keys "
+                    "'{--model}{i:03d}' must already be loaded)")
+    ap.add_argument("--zipf-s", type=float, default=1.1,
+                    help="Zipf exponent for --models popularity "
+                    "(rank 1 hottest; higher = hotter head)")
     ap.add_argument("--assert-zero-5xx", action="store_true",
                     help="fail (rc 1) if ANY response was a 5xx — the "
                     "rolling-update drill's acceptance bar")
@@ -334,6 +837,38 @@ def main(argv: list[str]) -> int:
 
     srv = None
     multi = args.url is not None and "," in args.url
+    if args.models > 0:
+        # multi-tenant Zipf traffic mode
+        if args.url is None:
+            srv, url, keys, columns = _self_server_tenants(
+                args.models, warm_buckets=(max(args.rows, 1),))
+            targets = [url]
+        else:
+            if not args.model or not args.columns:
+                print("--url + --models needs --model (key prefix) "
+                      "and --columns", file=sys.stderr)
+                return 2
+            targets = [u.strip().rstrip("/")
+                       for u in args.url.split(",") if u.strip()]
+            keys = [f"{args.model}{i:03d}" for i in range(args.models)]
+            columns = args.columns.split(",")
+        try:
+            out = run_load_zipf(targets, keys, columns,
+                                concurrency=args.concurrency,
+                                rows_per_request=args.rows,
+                                seconds=args.seconds,
+                                zipf_s=args.zipf_s)
+            print(json.dumps(out))
+            if args.assert_zero_5xx and out.get("fivexx", 0) > 0:
+                print(f"FAIL: {out['fivexx']} 5xx responses "
+                      f"(sample: {out.get('fivexx_sample')})",
+                      file=sys.stderr)
+                return 1
+            return 0 if out["errors"] == 0 and out["requests"] > 0 \
+                and out.get("fivexx", 0) == 0 else 1
+        finally:
+            if srv is not None:
+                srv.shutdown()
     if args.url is None:
         srv, url, model_key, columns = _self_server()
     else:
