@@ -25,6 +25,10 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_INGEST_CHUNK_BYTES | 16 MiB | pyarrow record-batch size for streamed CSV ingest (frame/parse, docs/SCALING.md) |
 | H2O_TPU_DEVICE_GATHER_MIN | 65536 | row threshold for the on-device Vec.select_rows gather; 0 forces it, below it the host path wins (frame/frame) |
 | H2O_TPU_BIN_BLOCK_COLS | derived | columns binned per block in Frame.binned (≤256 MB f32 transient; models/tree/binning) |
+| H2O_TPU_EFB | auto | Exclusive Feature Bundling for wide sparse frames: 0 kill switch, 1 force, auto = plan on >= MIN_F-feature frames, keep when the shrink gate passes (models/tree/efb, docs/SCALING.md) |
+| H2O_TPU_EFB_CONFLICT | 0 | allowed conflict-ROW fraction per bundle (LightGBM max_conflict_rate analog); 0 = exact exclusivity, the parity-gated default |
+| H2O_TPU_EFB_MIN_F | 64 | feature-count floor below which auto mode skips EFB planning entirely (narrow frames keep the fused no-host-sync prologue) |
+| H2O_TPU_EFB_MIN_SHRINK | 0.75 | auto mode keeps a plan only when bundled width Fb <= this fraction of F |
 | H2O_TPU_OOC | auto | out-of-core tree training: 1 force, 0 never, auto = binned matrix past the budget headroom (models/gbm, docs/SCALING.md) |
 | H2O_TPU_OOC_CHUNK_ROWS | derived | rows per host-pinned binned chunk in out-of-core mode (models/tree/ooc) |
 | H2O_TPU_OOC_RESIDENT | 0 | debug: keep out-of-core chunks device-resident (the bitwise streamed-vs-resident parity harness) |
@@ -33,6 +37,7 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_SCORE_FAIRNESS | 1 | per-model queue-share caps + SLO-priority dispatch in the micro-batcher; 0 = unfair FIFO baseline (rest.py, docs/SERVING.md) |
 | H2O_TPU_SCORE_MODEL_QUEUE_SHARE | per class | global override of the admission-queue fraction ONE model may occupy (rest.py) |
 | H2O_TPU_SLO_DEFAULT | standard | SLO class (interactive/standard/batch) when neither the X-H2O-SLO header nor the model's registry default applies (rest.py) |
+| H2O_TPU_MODEL_RATE_LIMIT | 0 (off) | per-tenant token bucket: sustained scoring requests/second any ONE model key may submit (burst = 1 s of traffic); past it 429 + Retry-After at admission, counted in /3/Stats `rate_limited` (rest.py, docs/SERVING.md) |
 | H2O_TPU_PCACHE_MIN_SECS | — | persistent-XLA-cache compile-time threshold override; serving pods pin 0 so every tenant compile persists and evictions re-promote from disk (runtime/backend.py) |
 | H2O_TPU_PROBE_BUDGET | 600 | backend-probe stubbornness seconds (runtime/backend) |
 | H2O_TPU_SCORE_BATCH_US | 2000 | REST scoring micro-batcher window, µs; 0 = dispatch immediately (rest.py, docs/SERVING.md) |

@@ -531,6 +531,33 @@ class GBM:
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 
+        # Exclusive Feature Bundling (models/tree/efb.py,
+        # docs/SCALING.md "Wide sparse frames"): on wide frames
+        # dominated by one-hot / near-empty columns, mutually
+        # exclusive sparse features pack into single bundle columns at
+        # bin time, so the binned matrix, every per-level scatter-add,
+        # and the cross-shard histogram psum all run at the bundled
+        # width.  Splits decode back to ORIGINAL (feature, bin) before
+        # tree emission — bin_spec/trees/artifacts/serving are
+        # bundle-free.  H2O_TPU_EFB=0 kills it; plan-less frames fall
+        # through to the fused prologue unchanged.
+        from .tree import efb as efb_mod
+
+        efb_plan = None
+        efb = None
+        F_eff = F
+        if bin_spec is None and efb_mod.efb_eligible(F, ckpt):
+            spec_efb, efb_plan = efb_mod.fit_plan_cached(
+                training_frame, data.feature_names, p.nbins)
+            # reuse the fitted spec either way: when the plan is
+            # rejected (shrink gate / no exclusive sets) re-fitting
+            # through the fused prologue would just duplicate the
+            # quantile fit this pass already paid
+            bin_spec = spec_efb
+            if efb_plan is not None:
+                efb = efb_plan.device_luts()
+                F_eff = efb_plan.fb
+
         # deep-tree memory validation: the dense heap's per-level
         # histogram working set is O(2^d·F·B·C) — the SAME accounting
         # (core.level_hist_bytes) the multinomial vmap branch and the
@@ -543,8 +570,12 @@ class GBM:
         # mid-boost.
         from .tree.core import level_hist_bytes, multi_grow_vmapped
 
-        hist_bytes = level_hist_bytes(tp, F)
-        if K > 1 and multi_grow_vmapped(tp, F, K):
+        # histogram accounting at the width histograms actually have:
+        # the BUNDLED width when EFB engaged (the memory win is exactly
+        # what buys deeper trees / more grouped-DRF parallelism on
+        # wide sparse frames)
+        hist_bytes = level_hist_bytes(tp, F_eff)
+        if K > 1 and multi_grow_vmapped(tp, F_eff, K):
             # validate the memory that will actually be live: K× only
             # when the grower really vmaps (past its budget it falls
             # to lax.map with one class's histograms live)
@@ -554,9 +585,10 @@ class GBM:
         if hist_bytes > budget:
             need_mb = hist_bytes / 2 ** 20
             raise ValueError(
-                f"max_depth={p.max_depth} with {F} features x "
-                f"{p.nbins} bins needs ~{need_mb:.0f} MiB of level "
-                f"histograms (> budget {budget / 2 ** 20:.0f} MiB). "
+                f"max_depth={p.max_depth} with {F_eff} histogram "
+                f"columns x {p.nbins} bins needs ~{need_mb:.0f} MiB of "
+                f"level histograms (> budget "
+                f"{budget / 2 ** 20:.0f} MiB). "
                 "Lower max_depth or nbins, drop features, or raise "
                 "H2O_TPU_HIST_BYTES_BUDGET if the device has room.")
 
@@ -565,10 +597,16 @@ class GBM:
         # resident in chunks and stream per boosting iteration
         # (models/tree/ooc.py). `binned` is only materialized on device
         # for the in-HBM path.
-        ooc_chunk = _ooc_chunk_rows(p, data, K, F, hist_bytes, budget,
-                                    ckpt)
+        ooc_chunk = _ooc_chunk_rows(p, data, K, F_eff, hist_bytes,
+                                    budget, ckpt)
         binned = None
-        if bin_spec is None:
+        if efb_plan is not None:
+            # bundled training matrix [padded, Fb] (host-built during
+            # planning, device-cached on the plan); the out-of-core
+            # branch slices the same host matrix into its chunk grid
+            if ooc_chunk is None:
+                binned = efb_plan.binned_device()
+        elif bin_spec is None:
             # fresh fit: on the in-HBM path the quantile fit and the
             # bin apply fuse into ONE dispatch with no host sync in
             # between (binning.fused_fit_bins; H2O_TPU_FUSED_BINNING=0
@@ -678,14 +716,15 @@ class GBM:
             require_healthy()
             with device_dispatch("gbm out-of-core boost"):
                 cks = make_chunks(training_frame, bin_spec, data.y,
-                                  data.w, margin, ooc_chunk)
+                                  data.w, margin, ooc_chunk,
+                                  plan=efb_plan)
                 margin_np, trees = boost_trees_chunked(
-                    cks, key, p.ntrees, tp, bp)
+                    cks, key, p.ntrees, tp, bp, efb=efb)
             margin = shard_rows(margin_np)
         else:
             trees, margin, history = self._boost_in_hbm(
-                p, tp, bp, data, binned, margin, key, K, F, ckpt,
-                start_t, history)
+                p, tp, bp, data, binned, margin, key, K, F_eff, ckpt,
+                start_t, history, efb=efb)
         if isinstance(init, jax.Array):
             # read the device init back AFTER the boost chunks are
             # enqueued (async dispatch: this blocks only on the tiny
@@ -728,8 +767,10 @@ class GBM:
             validation_frame)
 
     def _boost_in_hbm(self, p, tp, bp, data, binned, margin, key, K, F,
-                      ckpt, start_t, history):
-        """The fused in-HBM boosting loop (all rows device-resident)."""
+                      ckpt, start_t, history, efb=None):
+        """The fused in-HBM boosting loop (all rows device-resident).
+        ``F`` is the HISTOGRAM width (the bundled width under EFB) —
+        it sizes the dispatch-budget chunks to the actual work."""
         chunks: list[Tree] = [] if ckpt is None else [ckpt.trees]
         # cap ONE compiled dispatch's work: the TPU worker (behind
         # its RPC deadline) kills executions that run for minutes —
@@ -760,13 +801,16 @@ class GBM:
                     # (the class-flattening kernel rule): G× fuller MXU
                     # M at shallow levels, G× fewer sequential steps
                     margin, tchunk = boost_trees_drf(
-                        binned, data.y, data.w, margin, kc, n, tp, bp)
+                        binned, data.y, data.w, margin, kc, n, tp, bp,
+                        efb=efb)
                 elif K == 1:
                     margin, tchunk = boost_trees(
-                        binned, data.y, data.w, margin, kc, n, tp, bp)
+                        binned, data.y, data.w, margin, kc, n, tp, bp,
+                        efb=efb)
                 else:
                     margin, tchunk = boost_trees_multi(
-                        binned, data.y, data.w, margin, kc, n, K, tp, bp)
+                        binned, data.y, data.w, margin, kc, n, K, tp,
+                        bp, efb=efb)
                     # [n, K, ...] -> interleaved [n*K, ...] (class
                     # fastest), the layout _margins de-interleaves with
                     # a[k::K]
@@ -821,6 +865,14 @@ class GBM:
             n for n in frame.names if n not in ignored and
             frame.vec(n).kind in ("numeric", "enum", "time")]
         if not names or ignored.intersection(names):
+            return []
+        from .tree import efb as efb_mod
+
+        if efb_mod.efb_eligible(len(names), None):
+            # EFB may rebundle this frame to a DATA-dependent width —
+            # pre-lowering F-width executables would be dead compile
+            # work burning the compile stream while train() compiles
+            # the bundled shapes on demand anyway
             return []
         for n in names:
             if n not in frame or frame.vec(n).kind not in (
@@ -901,22 +953,26 @@ class GBM:
                 thunks.append(functools.partial(
                     _aot, _init_margin, row_s, row_s, row_s, dist, K))
             for nt in sorted(set(_chunk_sizes(p, padded, F, K))):
+                # efb=None mirrors train(): compile-ahead covers the
+                # unbundled dispatch shapes (EFB plans are
+                # data-dependent, and the auto gate keeps narrow
+                # frames — everything this mirror serves — unbundled)
                 if K == 1 and p._drf_mode:
                     G, rounds = drf_group_size(nt, tp, F)
                     keys_s = jax.ShapeDtypeStruct((rounds, G), keydt)
                     thunks.append(functools.partial(
                         _aot, _core._boost_drf_jit, binned_s, row_s,
-                        row_s, margin_s, keys_s, tp, bp, G, mesh))
+                        row_s, margin_s, keys_s, None, tp, bp, G, mesh))
                 elif K == 1:
                     keys_s = jax.ShapeDtypeStruct((nt,), keydt)
                     thunks.append(functools.partial(
                         _aot, _core._boost_jit, binned_s, row_s, row_s,
-                        margin_s, keys_s, tp, bp, mesh))
+                        margin_s, keys_s, None, tp, bp, mesh))
                 else:
                     keys_s = jax.ShapeDtypeStruct((nt,), keydt)
                     thunks.append(functools.partial(
                         _aot, _core._boost_multi_jit, binned_s, row_s,
-                        row_s, margin_s, keys_s, tp, bp, K, mesh))
+                        row_s, margin_s, keys_s, None, tp, bp, K, mesh))
         return thunks
 
 

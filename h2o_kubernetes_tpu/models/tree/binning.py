@@ -13,6 +13,14 @@ Layout: B total bins per feature. Bin B-1 is reserved for NA. Numeric
 features use quantile edges (≤ B-2 finite bins); categorical features
 use their codes directly; past B-1 levels, contiguous code ranges share
 bins (the reference's DHistogram grouping past nbins_cats [U3]).
+
+Wide sparse frames additionally go through Exclusive Feature Bundling
+at bin time (models/tree/efb.py, docs/SCALING.md "Wide sparse
+frames"): mutually exclusive sparse features pack into single uint8
+bundle columns, reusing this module's per-column `_bin_block_jit`
+apply so the dense [rows, F] matrix — float32 OR uint8 — never
+materializes; the fused prologue below stays the unbundled fast path
+(narrow frames never pay the planning pass).
 """
 
 from __future__ import annotations

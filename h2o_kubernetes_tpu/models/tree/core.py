@@ -84,19 +84,44 @@ from ...ops.histogram import expand_unit_hess as _expand_unit_hess
 from ...ops.histogram import resolve_impl as _resolve_impl
 
 
-def _find_splits(hist, p: TreeParams, feat_ok=None):
+def _split_gains(left, tot4, p: TreeParams):
+    """Split gain of every candidate's left stats [..., 3] against the
+    node totals ``tot4`` [n, 1, 1, 3] — THE one gain formula, shared
+    by `_find_splits` and `_find_splits_efb` so the EFB exactness
+    contract (identical gains for identical left stats) cannot drift."""
+    right = tot4 - left
+    Gl, Hl, Cl = left[..., 0], left[..., 1], left[..., 2]
+    Gr, Hr, Cr = right[..., 0], right[..., 1], right[..., 2]
+    parent = _gain_term(tot4[..., 0], tot4[..., 1], p)
+    raw = _gain_term(Gl, Hl, p) + _gain_term(Gr, Hr, p) - parent
+    ok = (Cl >= p.min_rows) & (Cr >= p.min_rows)
+    if p.min_child_weight > 0:
+        ok &= (Hl >= p.min_child_weight) & (Hr >= p.min_child_weight)
+    return jnp.where(ok, raw, -jnp.inf)
+
+
+def _find_splits(hist, p: TreeParams, feat_ok=None, efb=None):
     """Best split per node from a [n_nodes, F, B, 3] histogram.
+
+    With ``efb`` (an efb.EFBLuts pytree) the histogram is in BUNDLED
+    column space and split finding dispatches to ``_find_splits_efb``,
+    which decodes the winner back to the ORIGINAL (feature, bin) pair
+    — downstream (tree emission, flattening, MOJO, serving) never sees
+    a bundle.
 
     Scores every (feature, threshold-bin) cut with the NA bin (last)
     assigned to each side in turn, XGBoost-style learned NA direction.
     `feat_ok`: optional [n_nodes, F] bool mask of allowed features
-    (per-tree column sampling and DRF per-node mtries).
+    (per-tree column sampling and DRF per-node mtries) — always in
+    ORIGINAL feature space, whatever the histogram width.
     Returns (feat, bin, na_left, can_split, node_value, best_gain,
     cover, left, right) per node — cover is the node's total weight
     mass (TreeSHAP's r_j); left/right are the chosen split's side
     totals [n, 3] (== the children's node totals, NA side applied),
     which the grower uses as the final level's leaf stats.
     """
+    if efb is not None:
+        return _find_splits_efb(hist, p, efb, feat_ok)
     nb = hist.shape[2]
     na = hist[:, :, nb - 1, :]                 # [n, F, 3]
     body = hist[:, :, : nb - 1, :]
@@ -106,19 +131,8 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
 
     tot4 = totn[:, :, None, :]                 # [n, 1, 1, 3]
 
-    def gains(left):                           # left: [n, F, B-1, 3]
-        right = tot4 - left
-        Gl, Hl, Cl = left[..., 0], left[..., 1], left[..., 2]
-        Gr, Hr, Cr = right[..., 0], right[..., 1], right[..., 2]
-        parent = _gain_term(tot4[..., 0], tot4[..., 1], p)
-        raw = _gain_term(Gl, Hl, p) + _gain_term(Gr, Hr, p) - parent
-        ok = (Cl >= p.min_rows) & (Cr >= p.min_rows)
-        if p.min_child_weight > 0:
-            ok &= (Hl >= p.min_child_weight) & (Hr >= p.min_child_weight)
-        return jnp.where(ok, raw, -jnp.inf)
-
-    gain_na_r = gains(cum)                              # NA goes right
-    gain_na_l = gains(cum + na[:, :, None, :])          # NA goes left
+    gain_na_r = _split_gains(cum, tot4, p)              # NA goes right
+    gain_na_l = _split_gains(cum + na[:, :, None, :], tot4, p)  # NA left
     na_left_better = gain_na_l > gain_na_r
     gain = jnp.maximum(gain_na_l, gain_na_r)            # [n, F, B-1]
     if feat_ok is not None:
@@ -153,15 +167,126 @@ def _find_splits(hist, p: TreeParams, feat_ok=None):
             left, right)
 
 
-def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
+def _find_splits_efb(hist, p: TreeParams, efb, feat_ok):
+    """EFB split finding: the histogram is [n_nodes, Fb, B, 3] in
+    BUNDLED column space (models/tree/efb.py); every candidate slot is
+    scored as the ORIGINAL (feature, threshold-bin) cut it encodes and
+    the winner is returned decoded.
+
+    Exactness contract (docs/SCALING.md "Wide sparse frames"): the
+    candidate set and the tie-break order (original feat-major /
+    bin-minor via ``efb.perm``) match `_find_splits` exactly;
+    passthrough (dense) columns' gains are computed by the identical
+    masked-cumsum program and are bitwise-equal; bundled members'
+    default-bin mass is reconstructed as ``node_total - member_mass``
+    — an exact set identity under zero conflicts whose f32
+    reassociation is bitwise-neutral whenever the sums are exact
+    (integer counts, dyadic gradients) and float-tolerance otherwise,
+    the same caveat ooc.py documents for chunk-boundary sums."""
+    nb = hist.shape[2]
+    n, Fb = hist.shape[0], hist.shape[1]
+    S = nb - 1
+    sf = efb.slot_feat[:, :S]                    # [Fb, S]
+    sb = efb.slot_bin[:, :S]
+    body_mask = (efb.slot_feat >= 0) & (efb.slot_bin < nb - 1)  # [Fb, nb]
+    body = hist[:, :, :S, :] * body_mask[None, :, :S, None]
+    cum = jnp.cumsum(body, axis=2)               # [n, Fb, S, 3]
+    # node totals from column 0: body cumsum tail + the non-body mass
+    # (default slot, member NA slots; zeros only for a passthrough
+    # column, where this reduces to the unbundled cum[-1] + na)
+    nonbody0 = ~body_mask[0]
+    totn = cum[:, 0, -1, :] + jnp.sum(
+        hist[:, 0, :, :] * nonbody0[None, :, None], axis=1)     # [n, 3]
+    tot4 = totn[:, None, None, :]
+    # per-candidate member stats: NA mass, member-local prefix (left
+    # stats excluding default/NA), member total (body + NA)
+    na_idx = jnp.broadcast_to(efb.na_slot[None, :, :S, None],
+                              (n, Fb, S, 3))
+    na_c = jnp.take_along_axis(hist, na_idx, axis=2)            # [n,Fb,S,3]
+    mstart = efb.mstart[:, :S]
+    pre_idx = jnp.broadcast_to(
+        jnp.maximum(mstart - 1, 0)[None, :, :, None], cum.shape)
+    pre = jnp.take_along_axis(cum, pre_idx, axis=2)
+    started = (mstart > 0)[None, :, :, None]
+    mleft = jnp.where(started, cum - pre, cum)
+    end_idx = jnp.broadcast_to(efb.mend[None, :, :S, None], cum.shape)
+    mtot = jnp.take_along_axis(cum, end_idx, axis=2)
+    mtot = jnp.where(started, mtot - pre, mtot)
+    has_rem = efb.has_rem[:, :S]
+    # default-bin remainder: every node row not in this member's own
+    # slots sits at the member's default bin (zero-conflict identity)
+    rem = jnp.where(has_rem[None, :, :, None],
+                    tot4 - (mtot + na_c), 0.0)
+    add_rem = has_rem & (sb >= efb.dbin[:, :S])
+    left = mleft + jnp.where(add_rem[None, :, :, None], rem, 0.0)
+
+    gain_na_r = _split_gains(left, tot4, p)          # NA goes right
+    gain_na_l = _split_gains(left + na_c, tot4, p)   # NA goes left
+    na_left_better = gain_na_l > gain_na_r
+    gain = jnp.maximum(gain_na_l, gain_na_r)     # [n, Fb, S]
+    cand = body_mask[:, :S]
+    if feat_ok is None:
+        feat_ok = jnp.ones((n, efb.feat_col.shape[0]), dtype=bool)
+    fok = feat_ok[:, jnp.maximum(sf, 0).reshape(-1)].reshape(n, Fb, S)
+    gain = jnp.where(cand[None, :, :] & fok, gain, -jnp.inf)
+    flat = gain.reshape(n, Fb * S)[:, efb.perm]  # (feat, bin) order
+    best_rank = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best_rank[:, None], 1)[:, 0]
+    best = efb.perm[best_rank]                   # flat (col, slot) index
+    feat = jnp.maximum(sf.reshape(-1)[best], 0).astype(jnp.int32)
+    bin_ = jnp.clip(sb.reshape(-1)[best], 0, nb - 2).astype(jnp.int32)
+    na_l = jnp.take_along_axis(
+        na_left_better.reshape(n, -1), best[:, None], 1)[:, 0]
+
+    def pick(l4):
+        return jnp.take_along_axis(
+            l4.reshape(n, Fb * S, 3), best[:, None, None], 1)[:, 0]
+    left_w = jnp.where(na_l[:, None], pick(left + na_c), pick(left))
+    right_w = totn - left_w
+
+    G, H, C = totn[:, 0], totn[:, 1], totn[:, 2]
+    can_split = (best_gain > p.gamma) & (C >= 2 * p.min_rows) & \
+        jnp.isfinite(best_gain)
+    value = _leaf_value(G, H, p)
+    return (feat, bin_, na_l, can_split, value, best_gain, C,
+            left_w, right_w)
+
+
+def row_orig_bins(binned, f, efb):
+    """Per-row ORIGINAL-space bin of (per-row) feature ``f`` — the ONE
+    decode both the fused grower and the out-of-core descent use.
+    Unbundled: a plain column gather. Bundled: gather the row's bundle
+    slot from feature f's column, then LUT-decode (rows whose slot
+    belongs to another member sit at f's default bin; a member NA slot
+    decodes to the NA bin, preserving learned NA routing)."""
+    if efb is None:
+        return jnp.take_along_axis(
+            binned, f[:, None].astype(jnp.int32), axis=1)[:, 0].astype(
+            jnp.int32)
+    col = efb.feat_col[f]
+    s = jnp.take_along_axis(
+        binned, col[:, None].astype(jnp.int32), axis=1)[:, 0].astype(
+        jnp.int32)
+    sf = efb.slot_feat[col, s]
+    sb = efb.slot_bin[col, s]
+    return jnp.where(sf == f, sb, efb.feat_default[f]).astype(jnp.int32)
+
+
+def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams,
+                     efb=None):
     """Per-shard tree build (runs under shard_map; histograms psum'd).
 
     Returns (Tree, leaf_node): `leaf_node` is each row's final absolute
-    heap index — the grower already walks every row to its resting node,
+    heap index — the grower already walks each row to its resting node,
     so the boost loop reads `tree.value[leaf_node]` instead of paying a
     second full heap descent per tree (predict_tree).
+
+    ``efb``: optional bundle LUTs (models/tree/efb.py) — ``binned`` is
+    then the BUNDLED matrix, histograms/psums run at bundled width,
+    and splits/descents are decoded to original feature space.
     """
-    F = binned.shape[1]
+    F = col_mask.shape[0]       # ORIGINAL feature count (== binned
+    #                             width only when efb is None)
     N = 2 ** (p.max_depth + 1) - 1
     split_feat = jnp.full(N, -1, dtype=jnp.int32)
     split_bin = jnp.zeros(N, dtype=jnp.int32)
@@ -237,7 +362,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             hist_l = jnp.where(can_prev[:, None, None, None], hist_l, 0.0)
             hist_r = parent - hist_l
             hist = jnp.stack([hist_l, hist_r], axis=1).reshape(
-                n_nodes, F, p.n_bins, 3)
+                n_nodes, binned.shape[1], p.n_bins, 3)
         feat_ok = jnp.broadcast_to(col_mask[None, :], (n_nodes, F))
         if p.mtries > 0 and p.mtries < F:
             # DRF: exactly mtries features per node (reference: DTree
@@ -247,7 +372,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
             kth = jnp.sort(r, axis=1)[:, p.mtries - 1: p.mtries]
             feat_ok = feat_ok & (r <= kth)
         (feat, bin_, na_l, can, val, g_best, cov, left_ch,
-         right_ch) = _find_splits(hist, p, feat_ok)
+         right_ch) = _find_splits(hist, p, feat_ok, efb)
         idx = off + jnp.arange(n_nodes)
         split_feat = split_feat.at[idx].set(jnp.where(can, feat, -1))
         split_bin = split_bin.at[idx].set(bin_)
@@ -264,9 +389,7 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
         f = feat[safe_rel]
         b = bin_[safe_rel]
         nl = na_l[safe_rel]
-        rowbin = jnp.take_along_axis(
-            binned, f[:, None].astype(jnp.int32), axis=1)[:, 0].astype(
-            jnp.int32)
+        rowbin = row_orig_bins(binned, f, efb)
         is_na = rowbin == p.n_bins - 1
         go_right = jnp.where(is_na, ~nl, rowbin > b)
         child = 2 * rel + go_right.astype(jnp.int32)  # rel index at d+1
@@ -279,13 +402,15 @@ def _grow_tree_shard(binned, g, h, w, col_mask, key, p: TreeParams):
 
 
 def grow_tree(binned, g, h, w, p: TreeParams, col_mask=None, key=None,
-              mesh=None) -> Tree:
+              mesh=None, efb=None) -> Tree:
     """Build one tree over row-sharded inputs. Tree is replicated."""
     if col_mask is None:
-        col_mask = jnp.ones(binned.shape[1], dtype=bool)
+        n_feat = efb.feat_col.shape[0] if efb is not None \
+            else binned.shape[1]
+        col_mask = jnp.ones(n_feat, dtype=bool)
     if key is None:
         key = jax.random.key(0)
-    return _grow_tree_jit(binned, g, h, w, col_mask, key, p,
+    return _grow_tree_jit(binned, g, h, w, col_mask, key, efb, p,
                           mesh or global_mesh())
 
 
@@ -372,8 +497,8 @@ def _round_sampling(bp: BoostParams, w, F: int, k_row, k_col):
     return w_t, col_mask
 
 
-def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
-                 bp: BoostParams):
+def _boost_shard(binned, y, w, margin, keys, efb=None, *,
+                 p: TreeParams, bp: BoostParams):
     """Scan over trees INSIDE one shard_map: grad/hess → grow → local
     margin update, with histograms psum'd per level.
 
@@ -383,7 +508,7 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
     the host dispatches once per chunk of trees instead of ≥3 times per
     tree.
     """
-    F = binned.shape[1]
+    F = efb.feat_col.shape[0] if efb is not None else binned.shape[1]
 
     def body(margin, kt):
         k_row, k_col, k_tree = jax.random.split(kt, 3)
@@ -393,7 +518,7 @@ def _boost_shard(binned, y, w, margin, keys, p: TreeParams,
         else:
             g, h = _boost_grad_hess(bp, margin, y, w)
         tree, leaf = _grow_tree_shard(binned, g, h, w_t, col_mask,
-                                      k_tree, p)
+                                      k_tree, p, efb)
         tree = tree._replace(value=bp.learn_rate * tree.value)
         if not bp.drf_mode:
             # the grower already walked each row to its leaf: one gather
@@ -427,8 +552,8 @@ def multi_grow_vmapped(p: TreeParams, F: int, K: int) -> bool:
     return K * level_hist_bytes(p, F) <= _MULTI_HIST_BUDGET
 
 
-def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
-                       bp: BoostParams, K: int):
+def _boost_shard_multi(binned, y, w, margin, keys, efb=None, *,
+                       p: TreeParams, bp: BoostParams, K: int):
     """Multinomial analog of ``_boost_shard``: K class trees grow per
     boosting round via ``vmap`` over the class axis (per-level psums
     batch across classes), inside the same scan-over-rounds shard_map.
@@ -440,7 +565,7 @@ def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
     boosting rounds. Reference: hex/tree/gbm/GBM.java grows the K class
     trees of an iteration from shared softmax probs (SURVEY.md §3.4).
     """
-    F = binned.shape[1]
+    F = efb.feat_col.shape[0] if efb is not None else binned.shape[1]
 
     def body(margin, kt):
         k_row, k_col, k_tree = jax.random.split(kt, 3)
@@ -458,13 +583,18 @@ def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
             g = (probs - yk).T                           # [K, rows]
             h = (probs * (1.0 - probs)).T
         def grow_one(gk, hk, kk):
-            return _grow_tree_shard(binned, gk, hk, w_t, col_mask, kk, p)
+            return _grow_tree_shard(binned, gk, hk, w_t, col_mask, kk,
+                                    p, efb)
 
         keys_k = jax.random.split(k_tree, K)
         # vmap multiplies per-level histogram memory by K; past a VMEM/
         # HBM budget grow classes sequentially INSIDE the dispatch
-        # (lax.map: 1/K the live histogram footprint, still one compile)
-        if multi_grow_vmapped(p, F, K):
+        # (lax.map: 1/K the live histogram footprint, still one compile).
+        # The decision uses the HISTOGRAM width (binned.shape[1] — the
+        # bundled width under EFB), matching gbm.py's validator, which
+        # also means bundling buys back the K-vmapped growth on wide
+        # sparse frames
+        if multi_grow_vmapped(p, binned.shape[1], K):
             trees, leaf = jax.vmap(grow_one)(g, h, keys_k)
         else:
             trees, leaf = lax.map(lambda a: grow_one(*a), (g, h, keys_k))
@@ -478,8 +608,8 @@ def _boost_shard_multi(binned, y, w, margin, keys, p: TreeParams,
     return margin, trees
 
 
-def _boost_shard_drf(binned, y, w, margin, keys, p: TreeParams,
-                     bp: BoostParams, G: int):
+def _boost_shard_drf(binned, y, w, margin, keys, efb=None, *,
+                     p: TreeParams, bp: BoostParams, G: int):
     """DRF grouped growth: forest trees are INDEPENDENT (no margin
     coupling), so G trees grow per scan step via vmap — the
     class-flattening custom_vmap rule relabels tree g's rows to nodes
@@ -488,7 +618,7 @@ def _boost_shard_drf(binned, y, w, margin, keys, p: TreeParams,
     hi-slots) is G× fuller at shallow tree levels (PROFILE.md names
     sub-128 M as a main MFU lever), and the per-level sequencing
     overhead amortizes over G trees. keys: [rounds, G]."""
-    F = binned.shape[1]
+    F = efb.feat_col.shape[0] if efb is not None else binned.shape[1]
     g0 = -y
     h0 = jnp.ones_like(y)
 
@@ -497,7 +627,7 @@ def _boost_shard_drf(binned, y, w, margin, keys, p: TreeParams,
             k_row, k_col, k_tree = jax.random.split(kt, 3)
             w_t, col_mask = _round_sampling(bp, w, F, k_row, k_col)
             tree, _ = _grow_tree_shard(binned, g0, h0, w_t, col_mask,
-                                       k_tree, p)
+                                       k_tree, p, efb)
             return tree
 
         return carry, jax.vmap(grow_one)(kt_group)
@@ -508,16 +638,16 @@ def _boost_shard_drf(binned, y, w, margin, keys, p: TreeParams,
         lambda a: a.reshape((-1,) + a.shape[2:]), trees)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
-def _boost_drf_jit(binned, y, w, margin, keys, p: TreeParams,
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9))
+def _boost_drf_jit(binned, y, w, margin, keys, efb, p: TreeParams,
                    bp: BoostParams, G: int, mesh):
     fn = jax.shard_map(
         functools.partial(_boost_shard_drf, p=p, bp=bp, G=G),
         mesh=mesh,
-        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P()),
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
         out_specs=(P(ROWS), P()),
         check_vma=_resolve_impl(p.hist_impl) == "segment")
-    return fn(binned, y, w, margin, keys)
+    return fn(binned, y, w, margin, keys, efb)
 
 
 def drf_group_size(n_trees: int, p: TreeParams, F: int) -> tuple[int, int]:
@@ -553,81 +683,86 @@ def drf_group_size(n_trees: int, p: TreeParams, F: int) -> tuple[int, int]:
 
 
 def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
-                    p: TreeParams, bp: BoostParams, mesh=None):
+                    p: TreeParams, bp: BoostParams, mesh=None,
+                    efb=None):
     """Grouped DRF forest growth: n_trees independent trees in ONE
     dispatch, vmapped in groups sized to the histogram memory budget
-    (drf_group_size). Returns (margin unchanged, trees [n_trees, N])."""
+    (drf_group_size). Returns (margin unchanged, trees [n_trees, N]).
+    Group sizing uses the HISTOGRAM width — the bundled width under
+    EFB, which is the whole point: more trees fit a group."""
     assert bp.drf_mode
     F = binned.shape[1]
     G, rounds = drf_group_size(n_trees, p, F)
     keys = jax.random.split(key, rounds * G).reshape(rounds, G)
-    margin, trees = _boost_drf_jit(binned, y, w, margin, keys, p, bp,
-                                   G, mesh or global_mesh())
+    margin, trees = _boost_drf_jit(binned, y, w, margin, keys, efb,
+                                   p, bp, G, mesh or global_mesh())
     if rounds * G != n_trees:       # drop the last group's padding
         trees = jax.tree.map(lambda a: a[:n_trees], trees)
     return margin, trees
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
-def _boost_multi_jit(binned, y, w, margin, keys, p: TreeParams,
+@functools.partial(jax.jit, static_argnums=(6, 7, 8, 9))
+def _boost_multi_jit(binned, y, w, margin, keys, efb, p: TreeParams,
                      bp: BoostParams, K: int, mesh):
     fn = jax.shard_map(
         functools.partial(_boost_shard_multi, p=p, bp=bp, K=K),
         mesh=mesh,
-        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P()),
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
         out_specs=(P(ROWS), P()),
         check_vma=_resolve_impl(p.hist_impl) == "segment")
-    return fn(binned, y, w, margin, keys)
+    return fn(binned, y, w, margin, keys, efb)
 
 
 def boost_trees_multi(binned, y, w, margin, key, n_trees: int, K: int,
-                      p: TreeParams, bp: BoostParams, mesh=None):
+                      p: TreeParams, bp: BoostParams, mesh=None,
+                      efb=None):
     """Fused multinomial boosting: n_trees rounds × K class trees in ONE
     compiled dispatch. Returns (margin [rows, K], trees [T, K, N])."""
     keys = jax.random.split(key, n_trees)
-    return _boost_multi_jit(binned, y, w, margin, keys, p, bp, K,
+    return _boost_multi_jit(binned, y, w, margin, keys, efb, p, bp, K,
                             mesh or global_mesh())
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7))
-def _boost_jit(binned, y, w, margin, keys, p: TreeParams,
+@functools.partial(jax.jit, static_argnums=(6, 7, 8))
+def _boost_jit(binned, y, w, margin, keys, efb, p: TreeParams,
                bp: BoostParams, mesh):
     fn = jax.shard_map(
         functools.partial(_boost_shard, p=p, bp=bp),
         mesh=mesh,
-        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P()),
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
         out_specs=(P(ROWS), P()),
         check_vma=_resolve_impl(p.hist_impl) == "segment")
-    return fn(binned, y, w, margin, keys)
+    return fn(binned, y, w, margin, keys, efb)
 
 
 def boost_trees(binned, y, w, margin, key, n_trees: int, p: TreeParams,
-                bp: BoostParams, mesh=None):
+                bp: BoostParams, mesh=None, efb=None):
     """Fused boosting: n_trees rounds in ONE compiled dispatch.
 
     Returns (margin, trees) with trees a stacked Tree pytree [T, N].
     """
     keys = jax.random.split(key, n_trees)
-    return _boost_jit(binned, y, w, margin, keys, p, bp,
+    return _boost_jit(binned, y, w, margin, keys, efb, p, bp,
                       mesh or global_mesh())
 
 
-@functools.partial(jax.jit, static_argnums=(6, 7))
-def _grow_tree_jit(binned, g, h, w, col_mask, key, p: TreeParams,
+@functools.partial(jax.jit, static_argnums=(7, 8))
+def _grow_tree_jit(binned, g, h, w, col_mask, key, efb, p: TreeParams,
                    mesh) -> Tree:
-    def body(*args):
-        tree, _ = _grow_tree_shard(*args, p=p)
+    def body(binned, g, h, w, col_mask, key, efb=None):
+        tree, _ = _grow_tree_shard(binned, g, h, w, col_mask, key, p,
+                                   efb)
         return tree
 
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
+        in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P(), P()),
         out_specs=P(),
         # pallas_call's interpret mode can't thread vma through its
         # internal slices (jax 0.9 limitation) — disable the check here
         check_vma=_resolve_impl(p.hist_impl) == "segment")
-    return fn(binned, g, h, w, col_mask, key)
+    return fn(binned, g, h, w, col_mask, key, efb)
 
 
 def descend_tree(tree: Tree, binned, max_depth: int, n_bins: int):

@@ -1,0 +1,506 @@
+"""Exclusive Feature Bundling (EFB) for wide sparse frames.
+
+Wide CTR/NLP-featurized frames are dominated by one-hot / near-empty
+columns, yet the histogram hot loop (ops/histogram.py) pays the full
+``rows x F`` scatter-add per tree level and the multi-chip path psums
+a full-width histogram.  LightGBM's EFB (the technique benchmarked
+across GBDT implementations in arXiv:1809.04559) packs mutually
+exclusive sparse features — features whose non-default rows never
+overlap — into single columns, so the binned matrix, the per-level
+scatter-add, AND the cross-shard histogram psum all run at the bundled
+width ``Fb`` instead of ``F``.
+
+Design (docs/SCALING.md "Wide sparse frames"):
+
+- The bundled matrix is a TRAINING-ONLY representation.  Split finding
+  decodes every winning bundle slot back to the ORIGINAL
+  ``(feature, bin)`` pair before tree emission
+  (core._find_splits_efb), so grown ``Tree``s, ``flatten_trees`` raw-
+  feature thresholds, MOJO-v2 artifacts and the whole serving stack
+  are byte-identical in format to the unbundled path and never see a
+  bundle.
+- Each bundle column's bin space: slot 0 = "every member at its
+  default bin"; each member owns a contiguous run of slots — one slot
+  per non-default body bin seen in the data, one (row-empty) slot for
+  the member's default bin so the ``t = default`` threshold stays a
+  candidate, and one NA slot (original NA routing is learned per
+  member exactly as unbundled).  Bin ``B-1`` is left unused in bundle
+  columns so the node-total formula matches the unbundled one.
+- Dense features pass through untouched (their column in the bundled
+  matrix carries the ORIGINAL bin codes), which keeps their split
+  gains bitwise-identical to the unbundled path.
+- A member's default-bin mass is reconstructed as
+  ``node_total - member_mass`` (exact set identity under zero
+  conflicts).  The f32 reassociation this introduces is the same
+  caveat the out-of-core chunk streamer documents: sums that are
+  exact (integer counts, dyadic gradients — e.g. any DRF forest on a
+  0/1 response, or the first gaussian round) are BITWISE equal to the
+  unbundled path; general multi-round boosting agrees to float
+  tolerance with identical split structure (tests/test_efb.py pins
+  both).
+- Conflict budget ``H2O_TPU_EFB_CONFLICT`` (fraction of rows, default
+  0 = exact exclusivity): rows claimed by two members resolve
+  first-member-wins at apply time; the plan is verified against the
+  FULL data during apply and any member whose true conflicts exceed
+  the budget is demoted to a passthrough column, so a sample-built
+  plan can never silently drop rows.
+
+Kill switch: ``H2O_TPU_EFB=0``.  Default ``auto`` plans only when the
+frame is wide (>= H2O_TPU_EFB_MIN_F features, default 64) and keeps
+the bundling only when it meaningfully shrinks the matrix
+(Fb <= H2O_TPU_EFB_MIN_SHRINK * F, default 0.75).  ``H2O_TPU_EFB=1``
+forces planning at any width and keeps any shrink.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# sample rows the greedy planner sees (the full data re-verifies at
+# apply time, demoting any member the sample mis-judged)
+_PLAN_SAMPLE = 1 << 16
+# a feature is bundle-eligible only when its non-default rows are at
+# most this fraction of the sample (sparsity gate) ...
+_MAX_DENSITY = 0.3
+# ... and its slot need (non-default body bins + default slot + NA
+# slot) leaves room for >= 4 members per bundle
+_MAX_SLOT_FRAC = 4
+# open bundles the greedy pass probes per feature before opening a new
+# one (LightGBM caps its search the same way)
+_MAX_BUNDLE_TRIES = 64
+
+_POPCNT8 = np.unpackbits(
+    np.arange(256, dtype=np.uint8)[:, None], axis=1).sum(1)
+
+
+def efb_mode() -> str:
+    """H2O_TPU_EFB: '0' off, '1' force, anything else (default) auto."""
+    v = os.environ.get("H2O_TPU_EFB", "auto")
+    return v if v in ("0", "1") else "auto"
+
+
+def conflict_budget_frac() -> float:
+    """H2O_TPU_EFB_CONFLICT: allowed conflict-ROW fraction per bundle
+    (LightGBM's max_conflict_rate analog). Default 0 = exact
+    exclusivity, the parity-gated configuration."""
+    try:
+        return max(0.0, float(os.environ.get("H2O_TPU_EFB_CONFLICT", "0")))
+    except ValueError:
+        return 0.0
+
+
+def efb_eligible(n_features: int, checkpoint) -> bool:
+    """Whether train() should even attempt a bundling plan.
+
+    Checkpoint continuation is out (the continued trees descend the
+    checkpoint's original-space binned matrix); in auto mode narrow
+    frames skip the planning pass entirely so the fused no-host-sync
+    prologue keeps the narrow-frame train path exactly as before."""
+    mode = efb_mode()
+    if mode == "0" or checkpoint is not None:
+        return False
+    if mode == "1":
+        return n_features >= 2
+    min_f = int(os.environ.get("H2O_TPU_EFB_MIN_F", "64"))
+    return n_features >= max(min_f, 2)
+
+
+def _keep_plan(F: int, fb: int) -> bool:
+    if fb >= F:
+        return False
+    if efb_mode() == "1":
+        return True
+    try:
+        shrink = float(os.environ.get("H2O_TPU_EFB_MIN_SHRINK", "0.75"))
+    except ValueError:
+        shrink = 0.75
+    return fb <= shrink * F
+
+
+class EFBLuts(NamedTuple):
+    """Device LUTs the tree core descends/decodes bundles with.
+
+    All are dense arrays (a pytree operand, replicated P() under
+    shard_map).  ``B`` is the bin count, ``Fb`` the bundled width,
+    ``F`` the original width; S = B-1 candidate slots per column."""
+
+    slot_feat: jax.Array    # int32 [Fb, B]  original feature per slot, -1 none
+    slot_bin: jax.Array     # int32 [Fb, B]  original bin per slot (B-1 = NA)
+    na_slot: jax.Array      # int32 [Fb, B]  slot of the member's NA slot
+    mstart: jax.Array       # int32 [Fb, B]  member's first body slot
+    mend: jax.Array         # int32 [Fb, B]  member's last body slot
+    has_rem: jax.Array      # bool  [Fb, B]  default-remainder applies (bundled)
+    dbin: jax.Array         # int32 [Fb, B]  member's default original bin
+    perm: jax.Array         # int32 [Fb*(B-1)] candidate rank -> flat slot,
+    #                         ordered by (orig feature, orig bin) so argmax
+    #                         tie-breaking matches the unbundled flat order
+    feat_col: jax.Array     # int32 [F] bundled column of each original feature
+    feat_default: jax.Array  # int32 [F] default original bin (0 for dense)
+
+
+@dataclass
+class _Member:
+    feat: int
+    default_bin: int
+    slot_of_code: np.ndarray       # [B] uint8 code -> slot id (255 = unmapped)
+    body: list                     # [(slot, orig_bin)] ascending orig bin
+    na_slot: int
+
+
+@dataclass
+class EFBPlan:
+    """Host-side bundling plan + the bundled binned matrix."""
+
+    n_features: int
+    n_bins: int
+    cols: list                      # ("pass", feat) | ("bundle", [_Member])
+    binned_host: np.ndarray         # [padded, Fb] uint8
+    conflicts: int                  # total first-wins-resolved rows
+    demoted: list = field(default_factory=list)   # feats that failed verify
+    _luts: EFBLuts | None = None
+    _binned_dev: object = None
+
+    @property
+    def fb(self) -> int:
+        return len(self.cols)
+
+    def device_luts(self) -> EFBLuts:
+        if self._luts is None:
+            self._luts = _build_luts(self)
+        return self._luts
+
+    def binned_device(self):
+        """The row-sharded device bundled matrix (built lazily, cached
+        on the plan — AutoML/CV repeats on the same frame pay once).
+        The host copy is RELEASED on upload: the unbundled in-HBM path
+        has no host-side binned matrix either, and keeping both would
+        double residency for the frame-cache lifetime."""
+        if self._binned_dev is None:
+            from ...runtime.mrtask import shard_rows
+
+            self._binned_dev = shard_rows(self.binned_host)
+            self.binned_host = None
+        return self._binned_dev
+
+    def host_matrix(self) -> np.ndarray:
+        """[padded, Fb] uint8 on host — from the retained host copy,
+        or fetched back from the device copy (only possible after an
+        in-HBM train already placed it there)."""
+        if self.binned_host is not None:
+            return self.binned_host
+        return np.asarray(self._binned_dev)
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        if d["binned_host"] is None:    # rematerialize: device arrays
+            d["binned_host"] = self.host_matrix()    # never pickle
+        d["_binned_dev"] = None
+        return d
+
+
+def _build_luts(plan: EFBPlan) -> EFBLuts:
+    B = plan.n_bins
+    Fb = plan.fb
+    F = plan.n_features
+    slot_feat = np.full((Fb, B), -1, dtype=np.int32)
+    slot_bin = np.full((Fb, B), B - 1, dtype=np.int32)
+    na_slot = np.full((Fb, B), B - 1, dtype=np.int32)
+    mstart = np.zeros((Fb, B), dtype=np.int32)
+    mend = np.full((Fb, B), B - 2, dtype=np.int32)
+    has_rem = np.zeros((Fb, B), dtype=bool)
+    dbin = np.zeros((Fb, B), dtype=np.int32)
+    feat_col = np.zeros(F, dtype=np.int32)
+    feat_default = np.zeros(F, dtype=np.int32)
+    for c, col in enumerate(plan.cols):
+        kind, payload = col
+        if kind == "pass":
+            f = payload
+            feat_col[f] = c
+            slot_feat[c, :] = f
+            slot_bin[c, :] = np.arange(B)
+            # mstart 0 / mend B-2 / na B-1 / no remainder: the column
+            # IS the original feature, gains reduce to the unbundled
+            # cumsum bitwise
+            continue
+        for m in payload:
+            feat_col[m.feat] = c
+            feat_default[m.feat] = m.default_bin
+            slots = [s for s, _ in m.body] + [m.na_slot]
+            lo = m.body[0][0]
+            hi = m.body[-1][0]
+            for s in slots:
+                slot_feat[c, s] = m.feat
+                na_slot[c, s] = m.na_slot
+                mstart[c, s] = lo
+                mend[c, s] = hi
+                has_rem[c, s] = True
+                dbin[c, s] = m.default_bin
+            for s, ob in m.body:
+                slot_bin[c, s] = ob
+            slot_bin[c, m.na_slot] = B - 1
+    # candidate permutation: rank candidates (slots s < B-1) by
+    # (orig feature, orig bin, column, slot); invalid slots sort last.
+    # argmax over the permuted gains then picks the same winner — and
+    # the same TIE winner — as the unbundled feat-major/bin-minor flat
+    # argmax.
+    S = B - 1
+    sf = slot_feat[:, :S].ravel()
+    sb = slot_bin[:, :S].ravel()
+    valid = (sf >= 0) & (sb < B - 1)
+    key_feat = np.where(valid, sf, F)
+    key_bin = np.where(valid, sb, B)
+    perm = np.lexsort((np.arange(Fb * S), key_bin, key_feat))
+    return EFBLuts(
+        slot_feat=jnp.asarray(slot_feat), slot_bin=jnp.asarray(slot_bin),
+        na_slot=jnp.asarray(na_slot), mstart=jnp.asarray(mstart),
+        mend=jnp.asarray(mend), has_rem=jnp.asarray(has_rem),
+        dbin=jnp.asarray(dbin), perm=jnp.asarray(perm.astype(np.int32)),
+        feat_col=jnp.asarray(feat_col),
+        feat_default=jnp.asarray(feat_default))
+
+
+# ---------------------------------------------------------------------------
+# Planning + apply (host, column-at-a-time)
+# ---------------------------------------------------------------------------
+
+# columns binned per device dispatch in the planning/apply passes — a
+# per-COLUMN dispatch + host pull would cost F serial round trips on
+# exactly the wide frames EFB targets; a 128-column block of a 64k
+# sample is ~32 MB f32 transient
+_CODES_BLOCK = 128
+
+
+def _host_codes_block(frame, spec, js, rows: int | None = None
+                      ) -> np.ndarray:
+    """Original bin codes of features ``js`` as a host uint8
+    [rows, len(js)] block — bounded-width device transients (the
+    bin_frame_host_chunks discipline), so a 10k-wide frame never
+    materializes a dense [rows, F] float32 OR uint8 matrix."""
+    from .binning import _bin_block_jit
+
+    edges = jnp.asarray(spec.edges_matrix())
+    enum = np.array(spec.is_enum)
+    outs = []
+    for lo in range(0, len(js), _CODES_BLOCK):
+        blk = list(js[lo: lo + _CODES_BLOCK])
+        cols = []
+        for j in blk:
+            c = frame.vec(spec.names[j]).as_float()
+            cols.append(c[:rows] if rows is not None else c)
+        outs.append(np.asarray(_bin_block_jit(
+            tuple(cols), edges[np.asarray(blk)], spec.na_bin,
+            jnp.asarray(enum[np.asarray(blk)]))))
+    return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=1)
+
+
+def _pack(mask: np.ndarray) -> np.ndarray:
+    return np.packbits(mask)
+
+
+def _overlap(packed_a: np.ndarray, packed_b: np.ndarray) -> int:
+    return int(_POPCNT8[np.bitwise_and(packed_a, packed_b)].sum())
+
+
+def _feature_stats(stats, j: int, codes: np.ndarray, B: int, ns: int,
+                   cap_slots: int) -> None:
+    """Bundle-eligibility stats of one sampled column: dominant body
+    bin (the default), non-default row mask, used-bin slot need."""
+    counts = np.bincount(codes, minlength=B)
+    default = int(np.argmax(counts[: B - 1]))          # body bins only
+    if counts[default] <= 0:
+        return                                          # all-NA column
+    nnd = codes != default
+    n_nnd = int(nnd.sum())
+    if n_nnd > _MAX_DENSITY * ns:
+        return
+    used_body = np.nonzero(counts[: B - 1])[0]
+    used_body = used_body[used_body != default]
+    # default slot + NA slot + one per used non-default body bin
+    if len(used_body) + 2 > cap_slots:
+        return
+    stats[j] = (default, used_body, n_nnd, _pack(nnd))
+
+
+def plan_bundles(frame, spec, nrows: int | None = None):
+    """Greedy graph-coloring bundler + bundled bin apply.
+
+    Returns an ``EFBPlan`` or ``None`` when bundling would not pay
+    (no exclusive sets found, or the shrink gate rejects the plan).
+
+    Two passes over the columns, both one-column-at-a-time:
+    1. sample pass (<= _PLAN_SAMPLE real rows): per-feature bin usage,
+       default bin, non-default row masks; greedy packing of eligible
+       features into open bundles under the conflict budget.
+    2. full apply pass: bin each member over ALL rows, verify the
+       conflict budget and the slot map against the full data (demote
+       violators to passthrough), scatter slots into the bundled
+       matrix.
+    """
+    F = len(spec.names)
+    B = spec.n_bins
+    padded = frame.vec(spec.names[0]).padded_len
+    n_real = frame.nrows if nrows is None else nrows
+    ns = min(n_real, _PLAN_SAMPLE)
+    if ns < 1 or F < 2:
+        return None
+    cap_slots = max(2, (B - 2) // _MAX_SLOT_FRAC)
+
+    # -- pass 1: sample stats + greedy packing --------------------------
+    stats = {}           # feat -> (default_bin, used_body, nnd_count, packed)
+    for lo in range(0, F, _CODES_BLOCK):
+        js = list(range(lo, min(lo + _CODES_BLOCK, F)))
+        codes_blk = _host_codes_block(frame, spec, js, rows=ns)
+        for bi, j in enumerate(js):
+            _feature_stats(stats, j, codes_blk[:, bi], B, ns, cap_slots)
+    if len(stats) < 2:
+        return None
+    budget = int(conflict_budget_frac() * ns)
+    order = sorted(stats, key=lambda j: (-stats[j][2], j))
+    bundles = []     # dicts: members [feat], slots_used, claimed, conflicts
+    for j in order:
+        default, used_body, n_nnd, packed = stats[j]
+        need = len(used_body) + 2
+        placed = False
+        for b in bundles[:_MAX_BUNDLE_TRIES]:
+            if b["slots_used"] + need > B - 2:
+                continue
+            ov = _overlap(b["claimed"], packed)
+            if b["conflicts"] + ov > budget:
+                continue
+            b["members"].append(j)
+            b["slots_used"] += need
+            b["conflicts"] += ov
+            b["claimed"] = np.bitwise_or(b["claimed"], packed)
+            placed = True
+            break
+        if not placed:
+            bundles.append({"members": [j], "slots_used": 1 + need,
+                            "claimed": packed.copy(), "conflicts": 0})
+    bundles = [b for b in bundles if len(b["members"]) >= 2]
+    if not bundles:
+        return None
+
+    # -- pass 2: full-data apply + verification ------------------------
+    full_budget = int(conflict_budget_frac() * n_real)
+    built = []        # ("bundle", members, buf)
+    demoted: list[int] = []
+    bundled_feats: set[int] = set()
+    total_conflicts = 0
+    for b in bundles:
+        buf = np.zeros(padded, dtype=np.uint8)        # slot 0 = default
+        members: list[_Member] = []
+        next_slot = 1
+        conflicts = 0
+        codes_blk = _host_codes_block(frame, spec, b["members"])
+        for mi, j in enumerate(b["members"]):
+            default, used_body, _, _ = stats[j]
+            codes = codes_blk[:, mi]
+            # slot map: used non-default body bins ascending, the
+            # (row-empty) default-candidate slot in sorted position,
+            # then the NA slot at the end of the member's run
+            bins_sorted = np.sort(
+                np.concatenate([used_body, [default]])).astype(np.int64)
+            slot_of_code = np.full(B, 255, dtype=np.uint8)
+            body = []
+            for k, ob in enumerate(bins_sorted):
+                body.append((next_slot + k, int(ob)))
+                slot_of_code[ob] = next_slot + k
+            na_slot = next_slot + len(bins_sorted)
+            slot_of_code[B - 1] = na_slot
+            real = codes[:n_real]
+            nnd_full = real != default
+            unmapped = int((slot_of_code[real] == 255).sum())
+            ov = int((nnd_full & (buf[:n_real] != 0)).sum())
+            if unmapped > 0 or conflicts + ov > full_budget:
+                # the sample mis-judged this member (unseen bins or
+                # true conflicts past budget): demote to passthrough,
+                # never drop rows silently
+                demoted.append(j)
+                continue
+            write = nnd_full & (buf[:n_real] == 0)    # first member wins
+            buf[:n_real][write] = slot_of_code[real[write]]
+            conflicts += ov
+            members.append(_Member(feat=j, default_bin=default,
+                                   slot_of_code=slot_of_code, body=body,
+                                   na_slot=na_slot))
+            next_slot = na_slot + 1
+        if len(members) >= 2:
+            built.append((members, buf))
+            bundled_feats.update(m.feat for m in members)
+            total_conflicts += conflicts
+        else:
+            demoted.extend(m.feat for m in members)
+    if not built:
+        return None
+
+    fb = (F - len(bundled_feats)) + len(built)
+    if not _keep_plan(F, fb):
+        return None
+
+    # passthrough columns first in ORIGINAL feature order (so an
+    # all-dense prefix keeps node totals bitwise-identical to the
+    # unbundled path), bundles after, ordered by smallest member
+    cols: list = [("pass", j) for j in range(F) if j not in bundled_feats]
+    built.sort(key=lambda mb: min(m.feat for m in mb[0]))
+    out = np.zeros((padded, fb), dtype=np.uint8)
+    if cols:
+        out[:, : len(cols)] = _host_codes_block(
+            frame, spec, [j for _, j in cols])
+    plan_cols = list(cols)
+    for members, buf in built:
+        out[:, len(plan_cols)] = buf
+        plan_cols.append(("bundle", members))
+    return EFBPlan(n_features=F, n_bins=B, cols=plan_cols,
+                   binned_host=out, conflicts=total_conflicts,
+                   demoted=sorted(demoted))
+
+
+def fit_plan_cached(frame, feature_names, n_bins: int):
+    """(BinSpec, EFBPlan | None) with the frame-level cache the fused
+    prologue uses: keyed on (names, nbins, content version, conflict
+    budget) so every AutoML candidate / share-mode CV fold after the
+    first pays neither the quantile fit, the planning pass, nor the
+    bundled apply."""
+    from .binning import fit_bins
+
+    cache = frame.__dict__.setdefault("_binned_cache", {})
+    # every gate knob is in the key — changing H2O_TPU_EFB* mid-process
+    # applies on the next train like every other read-at-use knob
+    key = ("efb", tuple(feature_names), n_bins,
+           frame.__dict__.get("_version", 0), conflict_budget_frac(),
+           efb_mode(),
+           os.environ.get("H2O_TPU_EFB_MIN_SHRINK", "0.75"))
+    hit = cache.pop(key, None)
+    if hit is not None:
+        cache[key] = hit
+        return hit
+    spec = fit_bins(frame, list(feature_names), n_bins=n_bins)
+    plan = plan_bundles(frame, spec)
+    while len(cache) >= 2:
+        cache.pop(next(iter(cache)))
+    cache[key] = (spec, plan)
+    return spec, plan
+
+
+def chunk_plan_host(plan: EFBPlan, chunk_rows: int) -> list[np.ndarray]:
+    """Slice the bundled host matrix into the out-of-core chunk grid
+    (same row mapping as binning.bin_frame_host_chunks: chunk c =
+    rows [c*chunk_rows, (c+1)*chunk_rows), the last chunk padded with
+    dead rows)."""
+    host = plan.host_matrix()
+    padded, fb = host.shape
+    n_chunks = -(-padded // chunk_rows)
+    bufs = []
+    for c in range(n_chunks):
+        lo = c * chunk_rows
+        hi = min(lo + chunk_rows, padded)
+        buf = np.zeros((chunk_rows, fb), dtype=np.uint8)
+        buf[: hi - lo] = host[lo:hi]
+        bufs.append(buf)
+    return bufs

@@ -57,7 +57,7 @@ from ...ops.histogram import expand_unit_hess as _expand_unit_hess
 from ...ops.histogram import resolve_impl as _resolve_impl
 from ...runtime.mesh import ROWS, global_mesh
 from .core import (BoostParams, Tree, TreeParams, _boost_grad_hess,
-                   _find_splits, _leaf_value)
+                   _find_splits, _leaf_value, row_orig_bins)
 
 
 # ---------------------------------------------------------------------------
@@ -108,20 +108,28 @@ def chunk_rows_for(padded_rows: int, n_features: int, budget: float,
 
 
 def make_chunks(frame, bin_spec, y, w, margin, chunk_rows: int,
-                mesh=None) -> BinnedChunks:
+                mesh=None, plan=None) -> BinnedChunks:
     """Build the chunked training set from a Frame + resolved columns.
 
     ``y``/``w``/``margin`` are the full [padded] device columns from
     resolve_xy/_init_margin; they are fetched once and re-sharded per
     chunk. Binned chunks come from `binning.bin_frame_host_chunks`
     (one column on device at a time — the full f32 matrix never
-    exists). ``H2O_TPU_OOC_RESIDENT=1`` keeps the binned chunks
-    device-resident (the bitwise streamed-vs-resident test harness)."""
+    exists), or from the EFB ``plan``'s bundled host matrix when
+    bundling engaged (models/tree/efb.py — the chunks then carry
+    BUNDLED slot codes at width Fb). ``H2O_TPU_OOC_RESIDENT=1`` keeps
+    the binned chunks device-resident (the bitwise
+    streamed-vs-resident test harness)."""
     from .binning import bin_frame_host_chunks
 
     mesh = mesh or global_mesh()
     sharding = NamedSharding(mesh, P(ROWS))
-    bufs = bin_frame_host_chunks(frame, bin_spec, chunk_rows)
+    if plan is not None:
+        from .efb import chunk_plan_host
+
+        bufs = chunk_plan_host(plan, chunk_rows)
+    else:
+        bufs = bin_frame_host_chunks(frame, bin_spec, chunk_rows)
     n_chunks = len(bufs)
     total = n_chunks * chunk_rows
 
@@ -196,17 +204,16 @@ def _chunk_root_hist_jit(binned, g, h, w, rel0, n_bins_full: bool,
 
 
 def _descend(binned, rel, absn, feat, bin_, nal, can, d: int,
-             n_bins: int):
+             n_bins: int, efb=None):
     """Move every row from level ``d`` to ``d+1`` given level-``d``
-    splits — the exact row-walk of core._grow_tree_shard."""
+    splits — the exact row-walk of core._grow_tree_shard (bundle slots
+    decoded through the shared core.row_orig_bins LUT gather)."""
     live = rel >= 0
     safe_rel = jnp.where(live, rel, 0)
     f = feat[safe_rel]
     b = bin_[safe_rel]
     nl = nal[safe_rel]
-    rowbin = jnp.take_along_axis(
-        binned, f[:, None].astype(jnp.int32), axis=1)[:, 0].astype(
-        jnp.int32)
+    rowbin = row_orig_bins(binned, f, efb)
     is_na = rowbin == n_bins - 1
     go_right = jnp.where(is_na, ~nl, rowbin > b)
     child = 2 * rel + go_right.astype(jnp.int32)
@@ -218,14 +225,14 @@ def _descend(binned, rel, absn, feat, bin_, nal, can, d: int,
 
 @functools.partial(jax.jit, static_argnums=(10, 11, 12))
 def _chunk_desc_hist_jit(binned, rel, absn, g, h, w, feat, bin_, nal,
-                         can, d: int, p: TreeParams, mesh):
+                         can, d: int, p: TreeParams, mesh, efb=None):
     """ONE streamed pass of a chunk for level d+1: descend the rows
     from level d's splits, then build the LEFT-child histogram (sibling
     subtraction happens after cross-chunk accumulation). Fusing the
     descent into the histogram pass is what keeps the stream at one
     read of the binned chunk per level."""
     rel, absn = _descend(binned, rel, absn, feat, bin_, nal, can, d,
-                         p.n_bins)
+                         p.n_bins, efb)
     left_rel = jnp.where((rel >= 0) & (rel % 2 == 0), rel // 2, -1)
     hist_l = _shard_hist(binned, left_rel, g, h, w, 2 ** d, p, mesh)
     return rel, absn, hist_l
@@ -237,7 +244,7 @@ _expand_unit_hess_jit = jax.jit(_expand_unit_hess)
 
 @functools.partial(jax.jit, static_argnums=(4, 5))
 def _level_logic_jit(hist_l2, hist_prev, can_prev, col_key,
-                     p: TreeParams, d: int):
+                     p: TreeParams, d: int, efb=None):
     """Sibling subtraction + split finding for level d >= 1 — the same
     math core._grow_tree_shard runs inside the fused scan."""
     if p.unit_hess:
@@ -249,19 +256,20 @@ def _level_logic_jit(hist_l2, hist_prev, can_prev, col_key,
     F = hist_l.shape[1]
     hist = jnp.stack([hist_l, hist_r], axis=1).reshape(
         n_nodes, F, p.n_bins, 3)
-    return hist, _splits_with_mask(hist, col_key, p, d)
+    return hist, _splits_with_mask(hist, col_key, p, d, efb)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
-def _root_logic_jit(hist, col_key, p: TreeParams, d: int):
+def _root_logic_jit(hist, col_key, p: TreeParams, d: int, efb=None):
     if p.unit_hess:
         hist = _expand_unit_hess(hist)
-    return hist, _splits_with_mask(hist, col_key, p, d)
+    return hist, _splits_with_mask(hist, col_key, p, d, efb)
 
 
-def _splits_with_mask(hist, col_key, p: TreeParams, d: int):
-    n_nodes, F = hist.shape[0], hist.shape[1]
+def _splits_with_mask(hist, col_key, p: TreeParams, d: int, efb=None):
+    n_nodes = hist.shape[0]
     col_mask, key = col_key
+    F = col_mask.shape[0]        # ORIGINAL feature count under EFB
     feat_ok = jnp.broadcast_to(col_mask[None, :], (n_nodes, F))
     if p.mtries > 0 and p.mtries < F:
         # same per-node draw as core (key folded with the level)
@@ -269,7 +277,7 @@ def _splits_with_mask(hist, col_key, p: TreeParams, d: int):
         r = jnp.where(feat_ok, r, jnp.inf)
         kth = jnp.sort(r, axis=1)[:, p.mtries - 1: p.mtries]
         feat_ok = feat_ok & (r <= kth)
-    return _find_splits(hist, p, feat_ok)
+    return _find_splits(hist, p, feat_ok, efb)
 
 
 @functools.partial(jax.jit, static_argnums=(3,))
@@ -285,11 +293,11 @@ def _final_leaves_jit(can_prev, left_prev, right_prev, p: TreeParams):
 
 @functools.partial(jax.jit, static_argnums=(9, 10))
 def _chunk_finish_jit(binned, rel, absn, margin, feat, bin_, nal, can,
-                      value_scaled, d: int, p: TreeParams):
+                      value_scaled, d: int, p: TreeParams, efb=None):
     """Last streamed pass of a tree: descend the final level's rows and
     fold the (already learn-rate-scaled) leaf values into the margin."""
     rel, absn = _descend(binned, rel, absn, feat, bin_, nal, can, d,
-                         p.n_bins)
+                         p.n_bins, efb)
     margin = margin + value_scaled[absn]
     return rel, absn, margin
 
@@ -299,7 +307,7 @@ def _chunk_finish_jit(binned, rel, absn, margin, feat, bin_, nal, can,
 # ---------------------------------------------------------------------------
 
 def _grow_tree_chunked(chunks: BinnedChunks, gs, hs, wts, col_key,
-                       p: TreeParams, mesh):
+                       p: TreeParams, mesh, efb=None):
     """Grow one tree over the chunk stream. Returns (Tree of host
     arrays, per-chunk final abs leaf nodes) — margin update is the
     caller's (it owns the learn-rate scaling)."""
@@ -354,17 +362,18 @@ def _grow_tree_chunked(chunks: BinnedChunks, gs, hs, wts, col_key,
                 hc = _chunk_root_hist_jit(bc, gs[ci], hs[ci], wts[ci],
                                           rel[ci], True, p, mesh)
                 hist2 = hc if hist2 is None else _add_jit(hist2, hc)
-            hist, found = _root_logic_jit(hist2, col_key, p, d)
+            hist, found = _root_logic_jit(hist2, col_key, p, d, efb)
         else:
             hist_l2 = None
             for ci, bc in enumerate(_stream(chunks, mesh)):
                 rel[ci], absn[ci], hc = _chunk_desc_hist_jit(
                     bc, rel[ci], absn[ci], gs[ci], hs[ci], wts[ci],
-                    feat_d, bin_d, nal_d, can_d, d - 1, p, mesh)
+                    feat_d, bin_d, nal_d, can_d, d - 1, p, mesh, efb)
                 hist_l2 = hc if hist_l2 is None else _add_jit(hist_l2,
                                                              hc)
             hist, found = _level_logic_jit(hist_l2, hist_prev,
-                                           can_prev, col_key, p, d)
+                                           can_prev, col_key, p, d,
+                                           efb)
         (feat_d, bin_d, nal_d, can_d, val_d, gain_d, cov_d,
          left_prev, right_prev) = found
         idx = off + np.arange(n_nodes)
@@ -383,7 +392,8 @@ def _grow_tree_chunked(chunks: BinnedChunks, gs, hs, wts, col_key,
 
 
 def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
-                        p: TreeParams, bp: BoostParams, mesh=None):
+                        p: TreeParams, bp: BoostParams, mesh=None,
+                        efb=None):
     """n_trees boosting rounds over the chunk stream.
 
     Returns (margin [padded_rows] numpy, [Tree] with host arrays) —
@@ -397,7 +407,9 @@ def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
         "mtries (gated in models/gbm — streamed keys differ from " \
         "the fused core's)"
     mesh = mesh or global_mesh()
-    F = chunks.n_features
+    # col_mask lives in ORIGINAL feature space (chunks.n_features is
+    # the BUNDLED width when EFB engaged)
+    F = efb.feat_col.shape[0] if efb is not None else chunks.n_features
     trees: list[Tree] = []
     # every stochastic option (sample_rate, col_sample_rate_per_tree,
     # mtries) is gated OFF this path in models/gbm._ooc_chunk_rows —
@@ -413,7 +425,7 @@ def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
             hs.append(h)
             wts.append(chunks.w[ci])
         tree, last_split, rel, absn = _grow_tree_chunked(
-            chunks, gs, hs, wts, (col_mask, k_tree), p, mesh)
+            chunks, gs, hs, wts, (col_mask, k_tree), p, mesh, efb)
         # scale leaves once (f32, same IEEE multiply as the fused
         # core's tree._replace(value=lr*value)) and fold into margins
         scaled = (tree.value
@@ -426,7 +438,7 @@ def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
                 _, _, chunks.margin[ci] = _chunk_finish_jit(
                     bc, rel[ci], absn[ci], chunks.margin[ci], feat_d,
                     bin_d, nal_d, can_d, value_dev,
-                    p.max_depth - 1, p)
+                    p.max_depth - 1, p, efb)
         else:
             for ci in range(chunks.n_chunks):
                 chunks.margin[ci] = _add_root_jit(chunks.margin[ci],

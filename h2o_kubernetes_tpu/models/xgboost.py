@@ -174,7 +174,11 @@ def _rank_round(binned, margin, y_dense, maxdcg, idx, pos, mask, w, key,
     col_mask = jnp.ones(F, dtype=bool)
     if col_rate < 1.0:
         col_mask = jax.random.uniform(k_col, (F,)) < col_rate
-    tree = _grow_tree_jit(binned, g, h, w_t, col_mask, k_tree, tp, mesh)
+    # lambdarank stays on the ORIGINAL-space binned matrix (efb=None):
+    # its margin update re-descends `binned` via predict_tree, which
+    # reads original (feature, bin) splits
+    tree = _grow_tree_jit(binned, g, h, w_t, col_mask, k_tree, None,
+                          tp, mesh)
     tree = tree._replace(value=lr * tree.value)
     margin = margin + predict_tree(tree, binned, tp.max_depth, tp.n_bins)
     return margin, tree

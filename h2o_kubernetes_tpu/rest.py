@@ -80,13 +80,15 @@ REGISTRY_MODELS: dict[str, dict] = {}
 REQUIRED_MODEL_IDS: set[str] = set()
 
 # REST-level counters scraped by the operator's autoscale signal
-# (GET /3/Stats): 504s from expired X-H2O-Deadline-Ms budgets, and
-# scoring requests admitted while the node could not serve readiness
+# (GET /3/Stats): 504s from expired X-H2O-Deadline-Ms budgets, scoring
+# requests admitted while the node could not serve readiness
 # (cordon excluded — a cordoned replica serving routed stragglers is
-# the rolling-update contract, not a violation). Incremented under
-# _STATS_LOCK: handler threads race, and a lost increment would
-# suppress an autoscale scale-up for a scrape window.
-STATS = {"deadline_504": 0, "scored_while_unready": 0}
+# the rolling-update contract, not a violation), and per-tenant
+# rate-limit rejections. Incremented under _STATS_LOCK: handler
+# threads race, and a lost increment would suppress an autoscale
+# scale-up for a scrape window.
+STATS = {"deadline_504": 0, "scored_while_unready": 0,
+         "rate_limited": 0}
 _STATS_LOCK = threading.Lock()
 
 
@@ -156,7 +158,7 @@ def _model_stats(key: str, slo: str | None = None) -> dict:
     if rec is None:
         rec = {"slo": slo or _default_slo(), "requests": 0, "shed": 0,
                "deadline_504": 0, "breaker_rejects": 0, "batches": 0,
-               "rows": 0}
+               "rows": 0, "rate_limited": 0}
         MODEL_STATS[key] = rec
     elif slo:
         rec["slo"] = slo
@@ -169,6 +171,66 @@ def _bump_model_stat(key: str | None, stat: str, n: int = 1,
         return
     with _STATS_LOCK:
         _model_stats(key, slo)[stat] += n
+
+
+# -- per-tenant rate limits (PR 7 "Remaining") ------------------------------
+#
+# A token bucket per model key, applied at ScoreBatcher admission —
+# BEFORE the queue and the fairness share, so a tenant past its quota
+# never occupies a queue slot at all. H2O_TPU_MODEL_RATE_LIMIT is the
+# sustained requests/second any ONE model key may submit (0/unset =
+# off, the default: the chaos drills and every existing deployment see
+# no behavior change); burst capacity is one second of traffic.
+# Exhaustion is a 429 + Retry-After sized to the bucket's refill time,
+# counted in STATS["rate_limited"] and per model in MODEL_STATS —
+# both scraped off GET /3/Stats.
+
+_RATE_BUCKETS: dict[str, list] = {}     # model_key -> [tokens, last]
+_RATE_LOCK = threading.Lock()
+# indirection so tests can freeze the bucket clock (exact burst-count
+# assertions would otherwise flake against real refill on a slow box)
+_bucket_now = time.monotonic
+
+
+def _model_rate_limit() -> float:
+    return max(0.0, _env_float("H2O_TPU_MODEL_RATE_LIMIT", 0.0))
+
+
+def _rate_limit_admit(model_key: str | None,
+                      slo: str | None) -> None:
+    """Take one token from ``model_key``'s bucket or raise the 429.
+
+    Read-at-use (like every serving knob): changing the env mid-process
+    applies to the next request. Buckets refill continuously at the
+    limit rate and cap at one second of burst."""
+    rate = _model_rate_limit()
+    if rate <= 0 or model_key is None:
+        return
+    burst = max(1.0, rate)
+    now = _bucket_now()
+    with _RATE_LOCK:
+        b = _RATE_BUCKETS.get(model_key)
+        if b is None:
+            b = _RATE_BUCKETS[model_key] = [burst, now]
+        tokens = min(burst, b[0] + (now - b[1]) * rate)
+        if tokens < 1.0:
+            b[0], b[1] = tokens, now
+            retry = (1.0 - tokens) / rate
+        else:
+            b[0], b[1] = tokens - 1.0, now
+            return
+    _bump_stat("rate_limited")
+    _bump_model_stat(model_key, "rate_limited", slo=slo)
+    raise QueueFullError(
+        f"model '{model_key}' is over its rate limit "
+        f"(H2O_TPU_MODEL_RATE_LIMIT={rate:g}/s); retry after the "
+        "bucket refills", retry_after=retry)
+
+
+def reset_rate_buckets() -> None:
+    """Tests / in-process restart hook."""
+    with _RATE_LOCK:
+        _RATE_BUCKETS.clear()
 
 
 def _request_slo(headers) -> str | None:
@@ -369,6 +431,10 @@ class ScoreBatcher:
         except CircuitOpenError:
             _bump_model_stat(model_key, "breaker_rejects", slo=slo)
             raise
+        # per-tenant rate limit: over-quota tenants 429 BEFORE taking
+        # a queue slot (fairness caps bound queue OCCUPANCY; this
+        # bounds admission RATE)
+        _rate_limit_admit(model_key, slo)
         cls = _slo_class(slo)
         if deadline is None and cls["deadline_ms"]:
             # latency-class traffic without an explicit budget still

@@ -517,3 +517,76 @@ def test_zipf_probs_shape():
     assert (np.diff(p) < 0).all()          # rank 1 hottest, monotone
     with pytest.raises(ValueError):
         zipf_probs(0)
+
+
+# -- per-tenant rate limits (ISSUE 8 satellite; PR 7 "Remaining") -----------
+
+def test_rate_limit_token_bucket(monkeypatch):
+    """H2O_TPU_MODEL_RATE_LIMIT: a tenant past its sustained rate gets
+    429 (QueueFullError with a refill-sized Retry-After) at admission
+    — before taking a queue slot — while other tenants are untouched;
+    the bucket refills over time."""
+    monkeypatch.setenv("H2O_TPU_MODEL_RATE_LIMIT", "5")
+    monkeypatch.setenv("H2O_TPU_SCORE_BATCH_US", "0")
+    # freeze the bucket clock: on a loaded CI box 8 blocking submits
+    # can take longer than one token's refill (200 ms at 5/s), which
+    # would make exact burst-count assertions flaky
+    frozen = [1000.0]
+    monkeypatch.setattr(rest, "_bucket_now", lambda: frozen[0])
+    rest.reset_rate_buckets()
+    base_total = rest.STATS["rate_limited"]
+    batcher = rest.ScoreBatcher()
+    m = _SlowModel(delay=0.0)
+    X = np.zeros((2, 4), dtype=np.float32)
+    try:
+        # burst capacity = max(1, rate) = 5 tokens: the 6th submit for
+        # the same key must shed (clock frozen — zero refill)
+        ok, limited = 0, 0
+        retry_after = None
+        for _ in range(8):
+            try:
+                batcher.submit(m, X, model_key="hot", slo="standard",
+                               timeout=5.0)
+                ok += 1
+            except rest.QueueFullError as e:
+                limited += 1
+                retry_after = e.retry_after
+        assert ok == 5 and limited == 3
+        assert retry_after is not None and 0 < retry_after <= 0.25
+        # another tenant's bucket is independent
+        batcher.submit(m, X, model_key="cold", slo="standard",
+                       timeout=5.0)
+        # counters surfaced for /3/Stats
+        assert rest.STATS["rate_limited"] - base_total == 3
+        with rest._STATS_LOCK:
+            assert rest.MODEL_STATS["hot"]["rate_limited"] == 3
+            assert rest.MODEL_STATS["cold"].get("rate_limited", 0) == 0
+        # refill: one token's worth of clock readmits the tenant
+        frozen[0] += 0.25
+        batcher.submit(m, X, model_key="hot", slo="standard",
+                       timeout=5.0)
+    finally:
+        batcher.stop(timeout=10)
+        rest.reset_rate_buckets()
+        with rest._STATS_LOCK:
+            rest.MODEL_STATS.pop("hot", None)
+            rest.MODEL_STATS.pop("cold", None)
+
+
+def test_rate_limit_off_by_default(monkeypatch):
+    """Unset (or 0) = no limiting at all — the existing serving
+    surface, chaos drills, and fairness tests see zero change."""
+    monkeypatch.delenv("H2O_TPU_MODEL_RATE_LIMIT", raising=False)
+    rest.reset_rate_buckets()
+    batcher = rest.ScoreBatcher()
+    m = _SlowModel(delay=0.0)
+    X = np.zeros((1, 4), dtype=np.float32)
+    try:
+        for _ in range(30):
+            batcher.submit(m, X, model_key="k", slo="standard",
+                           timeout=5.0)
+        assert not rest._RATE_BUCKETS       # bucket never materialized
+    finally:
+        batcher.stop(timeout=10)
+        with rest._STATS_LOCK:
+            rest.MODEL_STATS.pop("k", None)

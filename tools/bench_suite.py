@@ -46,12 +46,18 @@ to a subset — e.g. ``BENCH_SUITE_CONFIGS=gbm_score_rows_per_sec`` for
 a quick serving capture; partial runs write to a ``_partial`` file so
 they never clobber a full-suite artifact.
 
+- config #8  ``gbm_wide_sparse`` — Exclusive Feature Bundling on a
+  ≥1k-column one-hot CTR-style frame (docs/SCALING.md "Wide sparse
+  frames"): unbundled (H2O_TPU_EFB=0) vs bundled train wall,
+  histogram width F→Fb, binned-matrix bytes both ways.
+  ``BENCH_WS_ROWS`` / ``BENCH_WS_GROUPS`` / ``BENCH_WS_CARD`` size it;
+
 Every config reports BOTH timings: ``compile_seconds`` (the first
 call — what a cold user pays, XLA compile included) and ``seconds``
 (steady state, compile cached; repeated until ≥1 s of measured work
 or 3 calls on the CPU mesh, single repeat on TPU where trains are
 long and chip windows are ~20 min). One JSON line per config + a
-trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r05.json`` at the
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r09.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -434,6 +440,80 @@ def main() -> int:
                unfair_tail_slo_met=mt["storm_unfair"]["tail_slo_met"],
                scorer_cache_final=mt["scorer_cache_final"])
 
+    if _want("gbm_wide_sparse"):
+        # config #8 (ISSUE 8): Exclusive Feature Bundling on a >= 1k-
+        # column one-hot-dominated CTR-style frame (docs/SCALING.md
+        # "Wide sparse frames"). Two in-process legs on the SAME
+        # frame: unbundled (H2O_TPU_EFB=0) then bundled
+        # (H2O_TPU_EFB=1); recorded: the train-wall ratio, the
+        # histogram width F -> Fb (also the per-level psum payload
+        # factor), and the binned-matrix bytes both ways. The
+        # env-keyed plan cache keeps the legs honest (EFB=0 never
+        # builds a plan; the bundled leg's cold wall INCLUDES the
+        # planning + bundled-apply passes).
+        from h2o_kubernetes_tpu.models.tree import efb as E
+
+        ws_rows = int(os.environ.get("BENCH_WS_ROWS",
+                                     min(max(rows, 20_000), 100_000)))
+        ws_groups = int(os.environ.get("BENCH_WS_GROUPS", 40))
+        ws_card = int(os.environ.get("BENCH_WS_CARD", 25))
+        fr_ws = D.wide_sparse_frame(ws_rows, n_groups=ws_groups,
+                                    group_card=ws_card, seed=9)
+        F_ws = fr_ws.ncols - 1
+        padded_ws = fr_ws.vec("d0").padded_len
+        ws_trees, ws_depth = 5, 5
+
+        _efb_prior = os.environ.get("H2O_TPU_EFB")
+
+        def _restore_efb():
+            if _efb_prior is None:
+                os.environ.pop("H2O_TPU_EFB", None)
+            else:
+                os.environ["H2O_TPU_EFB"] = _efb_prior
+
+        def _ws_leg(efb_env):
+            os.environ["H2O_TPU_EFB"] = efb_env
+            try:
+                return _timed(lambda: GBM(
+                    ntrees=ws_trees, max_depth=ws_depth, learn_rate=0.2,
+                    seed=1).train(y="y", training_frame=fr_ws), on_tpu)
+            finally:
+                _restore_efb()
+
+        m_u, dt_u, calls_u, cdt_u = _ws_leg("0")
+        m_b, dt_b, calls_b, cdt_b = _ws_leg("1")
+        os.environ["H2O_TPU_EFB"] = "1"
+        try:
+            names_ws = [n for n in fr_ws.names if n != "y"]
+            _, plan_ws = E.fit_plan_cached(fr_ws, names_ws,
+                                           m_b.params.nbins)
+        finally:
+            _restore_efb()
+        fb = plan_ws.fb if plan_ws is not None else F_ws
+        # both legs must train the same model family; the structural
+        # check rides the varimp ranking head (full bitwise parity is
+        # tier-1 tested — tests/test_efb.py)
+        top_u = sorted(m_u.varimp(), key=m_u.varimp().get)[-3:]
+        top_b = sorted(m_b.varimp(), key=m_b.varimp().get)[-3:]
+        record("gbm_wide_sparse", dt_u / max(dt_b, 1e-9),
+               "x_speedup_vs_unbundled", dt_b, calls_b, cdt_b,
+               rows_ws=ws_rows, features=F_ws, ntrees=ws_trees,
+               max_depth=ws_depth,
+               unbundled_wall_s=round(dt_u, 3),
+               bundled_wall_s=round(dt_b, 3),
+               unbundled_cold_s=round(cdt_u, 3),
+               bundled_cold_s=round(cdt_b, 3),
+               hist_width_unbundled=F_ws, hist_width_bundled=fb,
+               hist_width_reduction=round(F_ws / max(fb, 1), 1),
+               binned_mb_unbundled=round(padded_ws * F_ws / 2**20, 1),
+               binned_mb_bundled=round(padded_ws * fb / 2**20, 1),
+               bundles=sum(1 for c in (plan_ws.cols if plan_ws else [])
+                           if c[0] == "bundle"),
+               efb_conflicts=plan_ws.conflicts if plan_ws else None,
+               efb_demoted=len(plan_ws.demoted) if plan_ws else None,
+               varimp_top3_agree=top_u == top_b)
+        del fr_ws, m_u, m_b
+
     # -- config #6: the 10M-row chunked-path proofs --------------------
     rows_10m = int(os.environ.get("BENCH_ROWS_10M", 10_000_000))
 
@@ -492,7 +572,7 @@ def main() -> int:
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r08{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r09{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

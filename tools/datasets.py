@@ -213,6 +213,58 @@ def mslr_frame(rows: int, seed: int = 0, n_features: int = 136,
         mslr_arrays(rows, seed, n_features, mean_group))
 
 
+def wide_sparse_arrays(rows: int, n_groups: int = 40,
+                       group_card: int = 25, n_dense: int = 5,
+                       na_frac: float = 0.005, seed: int = 0,
+                       zipf_s: float = 1.0):
+    """Wide sparse CTR-style shape: ``n_groups`` one-hot groups of
+    ``group_card`` 0/1 columns each (mutually exclusive WITHIN a group
+    — the Exclusive Feature Bundling regime, docs/SCALING.md "Wide
+    sparse frames") plus ``n_dense`` dense numerics.  Category
+    popularity inside each group is Zipf-skewed via ``zipf_probs`` (the
+    one popularity shape this repo uses), like real CTR hash features:
+    a few hot categories, a long near-empty tail.  ``na_frac`` of the
+    rows of a few one-hot columns carry NAs so bundling has NA routing
+    to preserve.  F = n_groups * group_card + n_dense; binary response
+    from a sparse linear model over a handful of active categories.
+
+    Returns (cols, domains) ready for ``Frame.from_arrays``.
+    """
+    rng = np.random.default_rng(seed)
+    f32 = np.float32
+    cols: dict[str, np.ndarray] = {}
+    pop = zipf_probs(group_card, s=zipf_s)
+    logit = rng.normal(scale=0.4, size=rows).astype(f32)
+    for g in range(n_groups):
+        cat = rng.choice(group_card, size=rows, p=pop)
+        w_hot = rng.normal(scale=0.8, size=min(4, group_card))
+        for k in range(group_card):
+            v = (cat == k).astype(f32)
+            if na_frac > 0 and (g + k) % 17 == 0:
+                v[rng.random(rows) < na_frac] = np.nan
+            cols[f"c{g}_{k}"] = v
+        for k, wk in enumerate(w_hot):
+            logit += f32(wk) * np.nan_to_num(cols[f"c{g}_{k}"])
+    for j in range(n_dense):
+        d = rng.normal(size=rows).astype(f32)
+        cols[f"d{j}"] = d
+        logit += 0.3 * d
+    cols["y"] = (logit > np.median(logit)).astype(f32)
+    return cols, {"y": ["no", "yes"]}
+
+
+def wide_sparse_frame(rows: int, n_groups: int = 40,
+                      group_card: int = 25, n_dense: int = 5,
+                      na_frac: float = 0.005, seed: int = 0,
+                      zipf_s: float = 1.0):
+    import h2o_kubernetes_tpu as h2o
+
+    cols, domains = wide_sparse_arrays(
+        rows, n_groups=n_groups, group_card=group_card,
+        n_dense=n_dense, na_frac=na_frac, seed=seed, zipf_s=zipf_s)
+    return h2o.Frame.from_arrays(cols, domains=domains)
+
+
 def text8_like_tokens(n_tokens: int, vocab_size: int = 10_000,
                       seed: int = 0, sentence_len: int = 18):
     """Word2Vec corpus shape: Zipf-distributed token stream with

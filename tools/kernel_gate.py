@@ -169,6 +169,47 @@ def main() -> int:
     checks.append({"check": "flat_scorer_parity_multinomial",
                    "ok": flat3_ok})
 
+    # 5. EFB parity on chip: bundled vs unbundled training must pick
+    # identical splits and produce bitwise-identical predictions on an
+    # exact-sum wide one-hot fixture (models/tree/efb.py — the bundled
+    # histogram runs the SAME pallas kernel at bundled width, and the
+    # decode/remainder math must survive real Mosaic, not just
+    # interpret mode). Single gaussian round on a dyadic response =
+    # every sum exact, so any deviation is a bug, not float noise.
+    ne = 4096
+    ecols = {}
+    cat_e = rng.integers(0, 16, size=(4, ne))
+    for gi in range(4):
+        for k in range(16):
+            ecols[f"c{gi}_{k}"] = (cat_e[gi] == k).astype(np.float32)
+    ecols["c0_0"][::31] = np.nan
+    ecols["dx"] = rng.normal(size=ne).astype(np.float32)
+    ecols["ye"] = ((cat_e[0] == 1).astype(np.float32)
+                   - (cat_e[1] == 2) + (ecols["dx"] > 0)).astype(
+        np.float32)
+    fr_e = h2o.Frame.from_arrays(ecols)
+
+    def _efb_leg(env):
+        os.environ["H2O_TPU_EFB"] = env
+        try:
+            return GBM(ntrees=1, max_depth=5, seed=0).train(
+                y="ye", training_frame=fr_e)
+        finally:
+            os.environ.pop("H2O_TPU_EFB", None)
+
+    m_b = _efb_leg("1")
+    m_u = _efb_leg("0")
+    isp = np.asarray(m_u.trees.is_split)
+    efb_ok = bool(np.array_equal(isp, np.asarray(m_b.trees.is_split)))
+    for fld in ("split_feat", "split_bin", "na_left"):
+        a = np.where(isp, np.asarray(getattr(m_u.trees, fld)), -9)
+        b = np.where(isp, np.asarray(getattr(m_b.trees, fld)), -9)
+        efb_ok &= bool(np.array_equal(a, b))
+    efb_ok &= bool(np.array_equal(
+        np.asarray(m_u.predict_raw(fr_e)),
+        np.asarray(m_b.predict_raw(fr_e))))
+    checks.append({"check": "efb_parity", "ok": efb_ok})
+
     ok = all(c["ok"] for c in checks)
     print(json.dumps({"gate": "pass" if ok else "fail",
                       "platform": platform, "checks": checks}))
