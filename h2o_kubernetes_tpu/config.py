@@ -59,6 +59,13 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_POOL_STARTUP_DEADLINE | 180 | seconds a provisioned replica may take to reach READY before the reconciler replaces it |
 | H2O_TPU_POOL_DEREGISTER_GRACE | 0.75 | cordon→SIGTERM gap of a rolling update, so routers drop the endpoint before the drain begins (zero-5xx contract) |
 | H2O_TPU_POOL_QUEUE_HIGH | 8 | mean admission-queue depth per replica that scales the pool up (operator/autoscale.py) |
+| H2O_TPU_POOL_PROBE_TIMEOUT | 2 | per-probe cap on every reconciler health/readyz//3/Stats scrape — one hung replica cannot stall the whole reconcile pass (operator/reconcile.py) |
+| H2O_TPU_POOL_BACKOFF_BASE | 0.5 | crash-loop backoff: first respawn delay after a replica failure; doubles per recent failure (operator/reconcile.py, docs/OPERATOR.md) |
+| H2O_TPU_POOL_BACKOFF_MAX | 30 | crash-loop backoff delay cap, seconds |
+| H2O_TPU_POOL_BACKOFF_WINDOW | 120 | seconds a failure stays in the backoff history; a version clean this long respawns immediately again |
+| H2O_TPU_POOL_ROLLOUT_RETRIES | 3 | new-version readiness failures before a surge-one rollout auto-rolls-back to the pinned last-good version (`rollout_rolled_back` event) |
+| H2O_TPU_POOL_LOG_MAX_BYTES | 8 MiB | per-replica log size that triggers rotate-on-respawn (operator/reconcile.py) |
+| H2O_TPU_POOL_LOG_KEEP | 16 | replica log files kept per pool; older ones are pruned at spawn so a crash loop cannot fill the disk the durable store lives on |
 | JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection

@@ -27,6 +27,15 @@ pods into a FLEET:
   (``python -m h2o_kubernetes_tpu.operator.pod --port N``): mesh +
   persistent XLA cache + the model-registry readiness gate + the
   SIGTERM drain path.
+- ``store``     — ``DurablePoolStore``: the persist.py-backed
+  PoolStore (atomic JSON per pool, generation-fenced writes) that
+  makes the control plane RESTARTABLE — specs, status, rollout state
+  and events survive operator death.
+- ``run``       — the operator process entry
+  (``python -m h2o_kubernetes_tpu.operator.run``): durable store +
+  reconciler + pod ADOPTION on restart (live pods found via workdir
+  manifests are identity-probed over /3/Stats and inherited, never
+  duplicated).
 
 docs/OPERATOR.md documents the spec schema, reconcile semantics, the
 rolling-update contract, and the autoscale signal; tools/chaos.py's
@@ -35,9 +44,11 @@ stack end to end.
 """
 
 from .registry import FlatTreeScorer, ModelRegistry, load_artifact
-from .reconcile import Reconciler, ScorerReplica
-from .spec import PoolStore, ScorerPoolSpec
+from .reconcile import AdoptedReplica, Reconciler, ScorerReplica
+from .spec import PoolStore, ScorerPoolSpec, StaleGenerationError
+from .store import DurablePoolStore
 
-__all__ = ["ScorerPoolSpec", "PoolStore", "ModelRegistry",
-           "FlatTreeScorer", "load_artifact", "Reconciler",
-           "ScorerReplica"]
+__all__ = ["ScorerPoolSpec", "PoolStore", "DurablePoolStore",
+           "StaleGenerationError", "ModelRegistry", "FlatTreeScorer",
+           "load_artifact", "Reconciler", "ScorerReplica",
+           "AdoptedReplica"]

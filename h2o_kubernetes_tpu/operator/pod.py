@@ -31,6 +31,15 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--pool", default=None,
+                    help="owning pool name (identity on /3/Stats)")
+    ap.add_argument("--rid", default=None,
+                    help="replica id assigned by the reconciler")
+    ap.add_argument("--manifest", default=None,
+                    help="pid/port manifest path — rewritten with "
+                    "this process's authoritative pid so a restarted "
+                    "operator can adopt the pod (it also marks this "
+                    "pod ADOPTABLE to the run_tests preflight reaper)")
     args = ap.parse_args(argv)
 
     # replica identity BEFORE any jax/package import reads env
@@ -54,6 +63,34 @@ def main(argv: list[str] | None = None) -> int:
     from .. import rest
 
     rest.install_pool_replica_gate()
+    # identity fields on /3/Stats: the adoption probe of a restarted
+    # operator verifies pool/rid/pid before trusting a manifest —
+    # a recycled port cannot masquerade as this replica
+    rest.IDENTITY.update({
+        "pool": args.pool, "replica": args.rid,
+        "pid": os.getpid(), "port": args.port,
+        "started_at": time.time()})
+    if args.manifest:
+        # rewrite the controller-dropped manifest with the pid this
+        # process actually has (authoritative), atomically
+        import json
+
+        doc = {"rid": args.rid, "pool": args.pool,
+               "pid": os.getpid(), "port": args.port,
+               "created_at": time.time()}
+        try:
+            with open(args.manifest) as f:
+                old = json.load(f)
+            for k in ("version", "model_key"):
+                if k in old:
+                    doc[k] = old[k]
+        except (OSError, ValueError):
+            pass
+        os.makedirs(os.path.dirname(args.manifest), exist_ok=True)
+        tmp = args.manifest + f".pod{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, args.manifest)
     rest.start_server(args.port, host=args.host, background=True,
                       install_signals=True)
     print(f"POD_UP port={args.port} pid={os.getpid()}", flush=True)

@@ -16,7 +16,16 @@ converges on:
   after the pow2 buckets are pre-traced), and only once it is READY
   cordon one old-version replica, wait the deregister grace (routers
   drop the endpoint; stragglers still get served — that is how the
-  drill holds zero 5xx), then SIGTERM it into the PR-4 drain path.
+  drill holds zero 5xx), then SIGTERM it into the PR-4 drain path;
+- **operator restart** — replicas drop pid/port manifests under the
+  pool workdir; a fresh Reconciler ADOPTS the live pods it finds
+  there (identity-probed via /3/Stats) instead of spawning
+  duplicates, before its first reconcile pass;
+- **crash loops** — respawns of a failing version are exponentially
+  backoff-spaced (``H2O_TPU_POOL_BACKOFF_*``), and a rollout whose
+  new version keeps failing readiness auto-rolls-back to the pinned
+  last-good version (``H2O_TPU_POOL_ROLLOUT_RETRIES``) — old
+  replicas are never disturbed.
 
 Pods are REAL subprocesses running the rest.py serving entry via
 ``python -m h2o_kubernetes_tpu.operator.pod``: own lifecycle state
@@ -39,8 +48,9 @@ from ..runtime.retry import _env_float
 from .registry import ModelRegistry
 from .spec import PoolStore, ScorerPoolSpec
 
-__all__ = ["Reconciler", "ScorerReplica", "PENDING", "STARTING",
-           "LOADING", "READY", "CORDONED", "DRAINING", "DEAD"]
+__all__ = ["Reconciler", "ScorerReplica", "AdoptedReplica", "PENDING",
+           "STARTING", "LOADING", "READY", "CORDONED", "DRAINING",
+           "DEAD"]
 
 PENDING = "PENDING"        # created, not yet spawned
 STARTING = "STARTING"      # process up, waiting for /healthz
@@ -67,6 +77,39 @@ def _deregister_grace() -> float:
     return max(0.0, _env_float("H2O_TPU_POOL_DEREGISTER_GRACE", 0.75))
 
 
+def _probe_timeout() -> float:
+    """Per-probe cap on every reconciler health/readyz//3/Stats
+    scrape: one hung replica must not stall the whole pass (and with
+    it death-detection for its siblings)."""
+    return max(0.1, _env_float("H2O_TPU_POOL_PROBE_TIMEOUT", 2.0))
+
+
+def _backoff_base() -> float:
+    return max(0.0, _env_float("H2O_TPU_POOL_BACKOFF_BASE", 0.5))
+
+
+def _backoff_cap() -> float:
+    return max(0.1, _env_float("H2O_TPU_POOL_BACKOFF_MAX", 30.0))
+
+
+def _backoff_window() -> float:
+    """Seconds a failure stays in the backoff history; a version that
+    has run clean this long respawns immediately again."""
+    return max(1.0, _env_float("H2O_TPU_POOL_BACKOFF_WINDOW", 120.0))
+
+
+def _rollout_retries() -> int:
+    return max(1, int(_env_float("H2O_TPU_POOL_ROLLOUT_RETRIES", 3)))
+
+
+def _log_max_bytes() -> int:
+    return int(_env_float("H2O_TPU_POOL_LOG_MAX_BYTES", 8 << 20))
+
+
+def _log_keep() -> int:
+    return max(2, int(_env_float("H2O_TPU_POOL_LOG_KEEP", 16)))
+
+
 def _free_port() -> int:
     import socket
 
@@ -83,11 +126,15 @@ class ScorerReplica:
     this surface."""
 
     def __init__(self, rid: str, version: int, spec: ScorerPoolSpec,
-                 log_dir: str | None = None):
+                 log_dir: str | None = None,
+                 manifest_dir: str | None = None,
+                 pool: str | None = None, port: int | None = None):
         self.rid = rid
         self.version = int(version)
         self.model_key = spec.model_key
         self.artifact = spec.artifact
+        self.pool = pool or spec.name
+        self.manifest_dir = manifest_dir
         # the FULL tenant set this replica must serve (primary pinned
         # to the rollout version + every extra artifact): pushed as
         # one required-set so /readyz can't flip mid-push
@@ -100,7 +147,7 @@ class ScorerReplica:
             else tuple(spec.warm_buckets)
         self.env_overrides = dict(spec.env)
         self.log_dir = log_dir
-        self.port = _free_port()
+        self.port = _free_port() if port is None else int(port)
         self.proc: subprocess.Popen | None = None
         self.state = PENDING
         self.created_at = time.monotonic()
@@ -117,6 +164,51 @@ class ScorerReplica:
     def url(self) -> str:
         return f"http://127.0.0.1:{self.port}"
 
+    def manifest_path(self) -> str | None:
+        if not self.manifest_dir:
+            return None
+        return os.path.join(self.manifest_dir, f"{self.rid}.json")
+
+    def _write_manifest(self) -> None:
+        """Drop the pidfile/port manifest a restarted operator adopts
+        from (docs/OPERATOR.md "Control-plane recovery"). Written by
+        the controller at spawn (it knows rid/version) and rewritten
+        by the pod itself once up (authoritative pid)."""
+        path = self.manifest_path()
+        if path is None:
+            return
+        os.makedirs(self.manifest_dir, exist_ok=True)
+        doc = {"rid": self.rid, "pool": self.pool,
+               "pid": self.proc.pid, "port": self.port,
+               "version": self.version, "model_key": self.model_key,
+               "created_at": time.time()}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    def _remove_manifest(self) -> None:
+        path = self.manifest_path()
+        if path:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _rotate_logs(self) -> None:
+        """Size cap + rotate-on-respawn: an oversized log from a
+        previous life of this rid rolls to `.1` before the fresh
+        process reopens it. Dir-wide pruning is the RECONCILER's job
+        (it knows which rids are live — see `_prune_logs`)."""
+        if not self.log_dir:
+            return
+        mine = os.path.join(self.log_dir, f"{self.rid}.log")
+        try:
+            if os.path.getsize(mine) > _log_max_bytes():
+                os.replace(mine, mine + ".1")
+        except OSError:
+            pass
+
     def spawn(self) -> None:
         repo = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -128,14 +220,26 @@ class ScorerReplica:
         out = subprocess.DEVNULL
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
+            self._rotate_logs()
             self._log_f = open(os.path.join(
                 self.log_dir, f"{self.rid}.log"), "ab")
             out = self._log_f
+        argv = [sys.executable, "-m",
+                "h2o_kubernetes_tpu.operator.pod",
+                "--port", str(self.port),
+                "--pool", self.pool, "--rid", self.rid]
+        man = self.manifest_path()
+        if man is not None:
+            # on the pod's OWN cmdline so (a) it can rewrite the
+            # manifest with its authoritative pid, and (b) the
+            # run_tests preflight can tell an ADOPTABLE orphan (live
+            # manifest) from a leaked one (reap)
+            argv += ["--manifest", man]
         self.proc = subprocess.Popen(
-            [sys.executable, "-m", "h2o_kubernetes_tpu.operator.pod",
-             "--port", str(self.port)],
-            env=env, cwd=repo, stdout=out, stderr=out,
+            argv, env=env, cwd=repo, stdout=out, stderr=out,
             start_new_session=True)
+        if man is not None:
+            self._write_manifest()
         self.state = STARTING
         self.created_at = time.monotonic()
 
@@ -147,6 +251,7 @@ class ScorerReplica:
 
     def mark_dead(self) -> None:
         self.state = DEAD
+        self._remove_manifest()
         if self._log_f is not None:
             try:
                 self._log_f.close()
@@ -156,10 +261,12 @@ class ScorerReplica:
 
     # -- HTTP -----------------------------------------------------------------
 
-    def _get_json(self, path: str, timeout: float = 2.0):
+    def _get_json(self, path: str, timeout: float | None = None):
         try:
-            with urllib.request.urlopen(self.url + path,
-                                        timeout=timeout) as r:
+            with urllib.request.urlopen(
+                    self.url + path,
+                    timeout=_probe_timeout() if timeout is None
+                    else timeout) as r:
                 return json.loads(r.read())
         except Exception:  # noqa: BLE001 — down/unready both read None
             return None
@@ -246,25 +353,110 @@ class ScorerReplica:
                 pass
 
 
+class AdoptedReplica(ScorerReplica):
+    """A live pod inherited from a DEAD operator: same HTTP surface,
+    but there is no Popen handle — liveness is pid-probed and signals
+    go through os.kill. Everything else (push, cordon, the state
+    machine) behaves exactly like a spawned replica, so adoptees ride
+    the normal convergence path (a stale-version adoptee is cordoned +
+    replaced by the standard surge-one rollout)."""
+
+    def __init__(self, manifest: dict, version: int,
+                 spec: ScorerPoolSpec, log_dir: str | None = None,
+                 manifest_dir: str | None = None):
+        super().__init__(manifest["rid"], version, spec,
+                         log_dir=log_dir, manifest_dir=manifest_dir,
+                         pool=manifest.get("pool"),
+                         port=manifest["port"])
+        self._pid = int(manifest["pid"])
+
+    def spawn(self) -> None:   # pragma: no cover — adoptees exist
+        raise RuntimeError("an adopted replica is already running")
+
+    def alive(self) -> bool:
+        try:
+            os.kill(self._pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:   # pragma: no cover — exists, not ours
+            return True
+
+    def pid(self) -> int | None:
+        return self._pid
+
+    def terminate(self) -> None:
+        import signal
+
+        try:
+            os.kill(self._pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        self.state = DRAINING
+        self.drain_at = time.monotonic()
+
+    def kill(self) -> None:
+        import signal
+
+        try:
+            os.kill(self._pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+
 class Reconciler:
     """Converge a pool of ScorerReplicas to its ScorerPoolSpec."""
 
     def __init__(self, store: PoolStore, registry: ModelRegistry,
                  pool: str, log_dir: str | None = None,
-                 replica_factory=None):
+                 replica_factory=None, workdir: str | None = None,
+                 adopted_factory=None):
         self.store = store
         self.registry = registry
         self.pool = pool
-        self.log_dir = log_dir
+        # workdir: the pool's on-disk anchor — pod manifests (and, by
+        # default, logs) live under it so a RESTARTED operator can
+        # find its predecessor's pods. No workdir = no adoption
+        # (exactly the PR-6 behavior).
+        self.workdir = workdir
+        self.manifest_dir = os.path.join(workdir, "pods") \
+            if workdir else None
+        self.log_dir = log_dir if log_dir is not None else (
+            os.path.join(workdir, "logs") if workdir else None)
         # injectable for tests: factory(rid, version, spec) -> replica
         self.replica_factory = replica_factory or (
             lambda rid, version, spec: ScorerReplica(
-                rid, version, spec, log_dir=self.log_dir))
+                rid, version, spec, log_dir=self.log_dir,
+                manifest_dir=self.manifest_dir, pool=self.pool))
+        self.adopted_factory = adopted_factory or (
+            lambda manifest, version, spec: AdoptedReplica(
+                manifest, version, spec, log_dir=self.log_dir,
+                manifest_dir=self.manifest_dir))
         self.replicas: list = []
         self._seq = 0
         self._last_totals: dict | None = None   # autoscale deltas
         self._lock = threading.Lock()           # replicas list mutation
         self._stopped = False                   # shutdown() flips it
+        self._adopted = False                   # adopt_existing ran
+        # crash-loop backoff: version -> recent failure monotonics
+        # (windowed — spacing) and cumulative counts (rollback trigger)
+        self._failures: dict[int, list[float]] = {}
+        self._fail_counts: dict[int, int] = {}
+        self._backoff_announced: float = 0.0
+        # rollout rollback: failed spec version -> pinned last-good
+        self._rollback: dict[int, int] = {}
+        self._last_good: int | None = None
+        # a restarted operator resumes rollback/last-good state from
+        # the durable store's status instead of re-trying a version
+        # that already rolled back
+        st = store.get_status(pool)
+        if st.get("last_good_version") is not None:
+            self._last_good = int(st["last_good_version"])
+        ro = st.get("rollout") or {}
+        if ro.get("failed_version") is not None and \
+                ro.get("pinned_version") is not None:
+            self._rollback[int(ro["failed_version"])] = \
+                int(ro["pinned_version"])
 
     # -- events / status ------------------------------------------------------
 
@@ -294,9 +486,16 @@ class Reconciler:
             "ready": sum(1 for r in reps if r.state == READY),
         }
 
+    def _want_version(self, spec: ScorerPoolSpec) -> int:
+        """The version this pool should actually converge on: the
+        spec's, unless that version auto-rolled-back — then the pinned
+        last-good version until the spec moves to a NEW version."""
+        return self._rollback.get(spec.version, spec.version)
+
     def converged(self, spec: ScorerPoolSpec | None = None) -> bool:
         if spec is None:
             spec, _ = self.store.get(self.pool)
+        want = self._want_version(spec)
         with self._lock:
             reps = list(self.replicas)
         # alive() is checked HERE, not just at reconcile time: a
@@ -304,16 +503,285 @@ class Reconciler:
         # state until the next pass observes it, and a wait_converged
         # racing that pass must not declare victory over a dead pod
         current_ready = [r for r in reps if r.state == READY
-                         and r.version == spec.version and r.alive()]
+                         and r.version == want and r.alive()]
         leftovers = [r for r in reps if r.state != DEAD
                      and not (r.state == READY
-                              and r.version == spec.version
+                              and r.version == want
                               and r.alive())]
         return len(current_ready) == spec.replicas and not leftovers
 
+    # -- adoption (operator restart) ------------------------------------------
+
+    def _probe_stats(self, url: str) -> dict | None:
+        """GET /3/Stats off a candidate adoptee — identity fields
+        (pool/replica/pid), lifecycle state, and loaded model versions
+        in one device-free scrape. Injectable for tests."""
+        try:
+            with urllib.request.urlopen(url + "/3/Stats",
+                                        timeout=_probe_timeout()) as r:
+                return json.loads(r.read())
+        except Exception:  # noqa: BLE001 — unreachable reads None
+            return None
+
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        try:
+            os.kill(int(pid), 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:   # pragma: no cover
+            return True
+
+    def scan_manifests(self) -> list[dict]:
+        """Valid pod manifests under the pool workdir (pidfile/port
+        records dropped at spawn). Unparseable files are removed —
+        only the atomic writer produces them, so garbage is foreign."""
+        if not self.manifest_dir:
+            return []
+        out = []
+        try:
+            names = sorted(os.listdir(self.manifest_dir))
+        except OSError:
+            return []
+        for n in names:
+            if not n.endswith(".json"):
+                continue
+            path = os.path.join(self.manifest_dir, n)
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                if not all(k in doc for k in
+                           ("rid", "pool", "pid", "port")):
+                    raise ValueError("missing keys")
+            except (OSError, ValueError):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if doc.get("pool") == self.pool:
+                out.append(doc)
+        return out
+
+    def adopt_existing(self) -> int:
+        """Adopt this pool's still-live pods after an operator restart
+        instead of spawning duplicates (ISSUE 9 tentpole). For every
+        manifest: dead pid → stale, cleaned up; live + identity match
+        (pool/rid/pid off /3/Stats) → adopted in its OBSERVED state —
+        READY at its loaded version (a stale version is then rolled
+        through normal convergence), cordoned stays CORDONED (drains
+        after the grace), mid-load orphans restart the push as
+        STARTING; identity mismatch → the process is left alone but
+        the manifest is dropped (port reuse by a stranger); live but
+        unresponsive → killed (it cannot serve and nothing else will
+        ever reap it). Returns the number of pods adopted. Runs once,
+        BEFORE the first reconcile pass (run() enforces the order)."""
+        self._adopted = True
+        if not self.manifest_dir:
+            return 0
+        spec, _ = self.store.get(self.pool)
+        want = self._want_version(spec)
+        adopted = 0
+        with self._lock:
+            known = {r.rid for r in self.replicas}
+        for man in self.scan_manifests():
+            rid, pid, port = man["rid"], man["pid"], man["port"]
+            if rid in known:
+                continue
+            path = os.path.join(self.manifest_dir, f"{rid}.json")
+            if not self._pid_alive(pid):
+                self._event("adoption_stale",
+                            f"{rid} manifest pid {pid} is gone — "
+                            "cleaned up")
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            # retried: killing a live pod on ONE timed-out scrape
+            # (GIL-bound scoring burst, transient reset) would break
+            # the 'data plane never notices' contract adoption exists
+            # for
+            st = None
+            for attempt in range(3):
+                st = self._probe_stats(f"http://127.0.0.1:{port}")
+                if st is not None:
+                    break
+                if attempt < 2:
+                    time.sleep(0.2)
+            ident = (st or {}).get("identity") or {}
+            if st is not None and (
+                    ident.get("pool") != self.pool
+                    or ident.get("replica") != rid
+                    or (ident.get("pid") is not None
+                        and int(ident["pid"]) != int(pid))):
+                self._event("adoption_foreign",
+                            f"{rid}: port {port} answers as "
+                            f"{ident.get('pool')}/{ident.get('replica')}"
+                            " — not ours, manifest dropped")
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if st is None:
+                age = time.time() - float(man.get("created_at") or 0)
+                if 0 <= age <= _startup_deadline():
+                    # live pid, HTTP not up YET: spawned moments
+                    # before the old operator died — adopt as
+                    # STARTING; the normal startup deadline replaces
+                    # it if it never comes up
+                    r = self.adopted_factory(man, want, spec)
+                    r.created_at = time.monotonic()
+                    r.state = STARTING
+                    with self._lock:
+                        self.replicas.append(r)
+                    adopted += 1
+                    self._event("replica_adopted",
+                                f"{rid} pid {pid} port {port} adopted "
+                                "(still booting)")
+                    continue
+                # live pid, dead HTTP, well past any boot window: it
+                # can never serve and no other process will ever reap
+                # it — kill, then replace via the normal spawn path
+                self._event("adoption_unresponsive",
+                            f"{rid} pid {pid} alive but /3/Stats "
+                            f"unreachable after {age:.0f}s — killing")
+                try:
+                    import signal
+
+                    os.kill(int(pid), signal.SIGKILL)
+                except OSError:
+                    pass
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            loaded = ((st.get("registry") or {})
+                      .get(spec.model_key) or {}).get("version")
+            cordoned = (st.get("cordoned") or
+                        any("cordon" in str(rs)
+                            for rs in st.get("reasons") or ()))
+            if st.get("ready") and loaded is not None:
+                r = self.adopted_factory(man, int(loaded), spec)
+                r.state = READY
+                note = f"READY v{loaded}"
+            elif cordoned:
+                r = self.adopted_factory(
+                    man, int(loaded or man.get("version") or want),
+                    spec)
+                r.cordoned_at = time.monotonic()
+                r.state = CORDONED
+                note = "cordoned — resuming drain"
+            else:
+                # mid-load orphan: its pusher died with the old
+                # operator; adopt at the TARGET version and re-drive
+                # the push through the normal STARTING path (the load
+                # route is idempotent)
+                r = self.adopted_factory(man, want, spec)
+                r.created_at = time.monotonic()
+                r.state = STARTING
+                note = "mid-load — re-pushing"
+            with self._lock:
+                self.replicas.append(r)
+            adopted += 1
+            self._event("replica_adopted",
+                        f"{rid} pid {pid} port {port} adopted "
+                        f"({note})")
+        # rid sequence must clear every adopted rid or a fresh spawn
+        # would collide with a live pod's identity
+        with self._lock:
+            for r in self.replicas:
+                tail = r.rid.rsplit("-", 1)[-1]
+                if tail.isdigit():
+                    self._seq = max(self._seq, int(tail))
+        return adopted
+
+    # -- crash-loop backoff + rollout rollback --------------------------------
+
+    def _record_failure(self, version: int) -> None:
+        """One non-graceful replica failure (unexpected exit, load
+        failure, startup timeout) of `version`: feeds BOTH the
+        windowed backoff history (respawn spacing) and the cumulative
+        per-version count (the rollback trigger)."""
+        now = time.monotonic()
+        window = _backoff_window()
+        hist = self._failures.setdefault(int(version), [])
+        hist[:] = [t for t in hist if now - t <= window]
+        hist.append(now)
+        self._fail_counts[int(version)] = \
+            self._fail_counts.get(int(version), 0) + 1
+
+    def _backoff_remaining(self, version: int, now: float) -> float:
+        """Seconds until a replacement of `version` may spawn. The
+        FIRST failure in the window replaces immediately (a one-off
+        OOM-kill must not slow recovery — the replica-kill drill's
+        contract); from the second on, base·2^(n-2) capped at
+        H2O_TPU_POOL_BACKOFF_MAX — a crash loop becomes spaced
+        respawns instead of a hot loop."""
+        hist = self._failures.get(int(version))
+        if not hist:
+            return 0.0
+        window = _backoff_window()
+        hist[:] = [t for t in hist if now - t <= window]
+        n = len(hist)
+        if n < 2:
+            return 0.0
+        delay = min(_backoff_cap(), _backoff_base() * (2 ** (n - 2)))
+        return max(0.0, hist[-1] + delay - now)
+
+    def _maybe_rollback(self, spec: ScorerPoolSpec) -> None:
+        """Auto-rollback: when the rollout's new version has failed
+        its warm-up/readiness H2O_TPU_POOL_ROLLOUT_RETRIES times and a
+        last-good version exists, pin the pool to last-good. Old
+        replicas are never disturbed; the spec stays at the failed
+        version (the operator's declared intent is preserved and a
+        NEW version bump supersedes the pin)."""
+        want = spec.version
+        if want in self._rollback or self._last_good is None \
+                or self._last_good == want:
+            return
+        if self._fail_counts.get(want, 0) < _rollout_retries():
+            return
+        self._rollback = {want: self._last_good}
+        self._event("rollout_rolled_back",
+                    f"v{want} failed readiness "
+                    f"{self._fail_counts[want]} times — pool pinned "
+                    f"to last-good v{self._last_good}; push a new "
+                    "version to retry")
+
     # -- the loop -------------------------------------------------------------
 
+    def _prune_logs(self) -> None:
+        """Cap the pool log dir so a crash-looping pod cannot fill the
+        disk the durable store lives on: keep the newest
+        H2O_TPU_POOL_LOG_KEEP files, but NEVER delete a live
+        replica's open log (its fd would keep writing to an unlinked
+        inode and the crash-diagnosis artifact would be silently
+        lost)."""
+        if not self.log_dir:
+            return
+        with self._lock:
+            live = {r.rid for r in self.replicas if r.state != DEAD}
+        try:
+            logs = sorted(
+                (os.path.join(self.log_dir, n)
+                 for n in os.listdir(self.log_dir)
+                 if ".log" in n
+                 and n.split(".log", 1)[0] not in live),
+                key=lambda p: os.path.getmtime(p))
+        except OSError:
+            return
+        for stale in logs[:max(0, len(logs) - _log_keep())]:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
+
     def _spawn(self, version: int, spec: ScorerPoolSpec):
+        self._prune_logs()
         with self._lock:
             if self._stopped:
                 return None
@@ -360,6 +828,7 @@ class Reconciler:
                     self._event("replica_died",
                                 f"{r.rid} v{r.version} "
                                 f"(port {r.port}) exited unexpectedly")
+                    self._record_failure(r.version)
                 r.mark_dead()
         with self._lock:
             self.replicas = [r for r in self.replicas
@@ -379,6 +848,7 @@ class Reconciler:
                     self._event("replica_startup_timeout",
                                 f"{r.rid} no /healthz after "
                                 f"{deadline:.0f}s — replacing")
+                    self._record_failure(r.version)
                     r.kill()
                     r.mark_dead()
             elif r.state == LOADING:
@@ -386,10 +856,16 @@ class Reconciler:
                 if err is not None:
                     self._event("replica_load_failed",
                                 f"{r.rid}: {err}")
+                    self._record_failure(r.version)
                     r.kill()
                     r.mark_dead()
                 elif r.load_finished() and r.readyz_ok():
                     r.state = READY
+                    # the version provably serves: clear its failure
+                    # history so one old flake can't feed a later
+                    # rollback, and remember it as rollback target
+                    self._failures.pop(r.version, None)
+                    self._fail_counts.pop(r.version, None)
                     self._event("replica_ready",
                                 f"{r.rid} v{r.version} warmed — "
                                 "readyz green")
@@ -397,6 +873,7 @@ class Reconciler:
                     self._event("replica_startup_timeout",
                                 f"{r.rid} not READY after "
                                 f"{deadline:.0f}s — replacing")
+                    self._record_failure(r.version)
                     r.kill()
                     r.mark_dead()
         with self._lock:
@@ -420,8 +897,12 @@ class Reconciler:
                             "— SIGKILL")
                 r.kill()
 
-        # 4. converge version + count (surge-one rolling update)
-        want = spec.version
+        # 4. converge version + count (surge-one rolling update).
+        # A rollout whose new version keeps failing rolls back to the
+        # pinned last-good version; respawns of a crash-looping
+        # version are backoff-spaced instead of hot-looped.
+        self._maybe_rollback(spec)
+        want = self._want_version(spec)
         # stale replicas that never went READY are superseded work —
         # kill outright, nothing routes to them
         for r in list(self.replicas):
@@ -441,11 +922,21 @@ class Reconciler:
                        if r.version != want and r.state == READY]
         ready = [r for r in capacity if r.state == READY]
 
+        backoff_left = 0.0
         if len(current) < spec.replicas and \
                 len(capacity) < spec.replicas + 1:
-            # scale up / replace dead / surge the rollout — one spawn
-            # per pass keeps the surge at one
-            self._spawn(want, spec)
+            backoff_left = self._backoff_remaining(want, now)
+            if backoff_left <= 0.0:
+                # scale up / replace dead / surge the rollout — one
+                # spawn per pass keeps the surge at one
+                self._spawn(want, spec)
+            elif now >= self._backoff_announced:
+                n = len(self._failures.get(want, ()))
+                self._event("crash_loop_backoff",
+                            f"v{want} failed {n}x recently — next "
+                            f"respawn in {backoff_left:.2f}s")
+                # announce once per wait, not every 0.5s pass
+                self._backoff_announced = now + backoff_left
         elif stale_ready and len(ready) > spec.replicas:
             # a new-version replica is READY beyond the desired count:
             # retire ONE old-version replica — cordon first (routers
@@ -475,26 +966,60 @@ class Reconciler:
             self.replicas = [r for r in self.replicas
                              if r.state != DEAD]
 
-        # 5. publish observed status
+        # 5. publish observed status (generation-fenced: if another
+        # controller bumped the spec since this pass read it, OUR view
+        # is stale — drop the write, the next pass re-reads)
+        conv = self.converged(spec)
+        if conv:
+            # every desired replica READY on the effective version:
+            # this version provably serves — the rollback target
+            self._last_good = want
         st = self.status()
         by_version: dict[str, int] = {}
         for r in st["replicas"]:
             if r["state"] == READY:
                 by_version[str(r["version"])] = \
                     by_version.get(str(r["version"]), 0) + 1
-        self.store.set_status(self.pool, {
+        status = {
             "generation_observed": gen,
             "desired_replicas": spec.replicas,
             "desired_version": spec.version,
+            "effective_version": want,
+            "last_good_version": self._last_good,
             "ready_by_version": by_version,
-            "converged": self.converged(spec),
+            "converged": conv,
             **st,
-        })
+        }
+        if spec.version in self._rollback:
+            status["rollout"] = {
+                "failed_version": spec.version,
+                "pinned_version": self._rollback[spec.version],
+                "state": "rolled_back",
+            }
+        if backoff_left > 0.0:
+            status["crash_loop"] = {
+                "version": want,
+                "recent_failures": len(self._failures.get(want, ())),
+                "next_spawn_in": round(backoff_left, 3),
+            }
+        from .spec import StaleGenerationError
+
+        try:
+            self.store.set_status(self.pool, status, fence=gen)
+        except StaleGenerationError:
+            pass
 
     def run(self, stop: threading.Event,
             interval: float | None = None) -> None:
         """Blocking loop (callers thread it); autoscale piggybacks on
-        the same cadence when the spec opts in."""
+        the same cadence when the spec opts in. Adoption runs FIRST:
+        reconciling before the predecessor's pods are adopted would
+        spawn duplicates of every live pod."""
+        if not self._adopted:
+            try:
+                self.adopt_existing()
+            except Exception as e:  # noqa: BLE001 — loop must start
+                self._event("adoption_error", repr(e)[:300])
         while not stop.is_set():
             try:
                 self.reconcile_once()

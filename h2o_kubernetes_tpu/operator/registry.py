@@ -235,8 +235,12 @@ class ModelRegistry:
             return {}       # fresh registry root
 
     def _save_index(self, idx: dict) -> None:
-        persist.write_bytes(self._index_path(),
-                            json.dumps(idx, indent=1).encode())
+        # atomic + read-back-verified: the index is the registry's
+        # single point of failure — a publish crashed mid-write must
+        # leave the PREVIOUS intact index, never a torn one that
+        # breaks every subsequent fetch's digest check
+        persist.write_bytes_atomic(self._index_path(),
+                                   json.dumps(idx, indent=1).encode())
 
     # -- publish / fetch ------------------------------------------------------
 
@@ -266,7 +270,9 @@ class ModelRegistry:
         ent = idx.setdefault(name, {"latest": 0, "versions": {}})
         version = int(ent["latest"]) + 1
         path = self.artifact_path(name, version)
-        persist.write_bytes(path, blob)
+        # blob first, index second (a crash between the two leaves an
+        # unreferenced blob, never an index entry without bytes)
+        persist.write_bytes_atomic(path, blob)
         ent["versions"][str(version)] = {
             "path": path,
             "bytes": len(blob),

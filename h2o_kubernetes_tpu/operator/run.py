@@ -1,0 +1,91 @@
+"""Operator process entry: ``python -m h2o_kubernetes_tpu.operator.run``.
+
+The control plane as its own process (what a Deployment would run):
+one durable-store-backed Reconciler per pool, reconciling until
+SIGTERM. Because the store is durable and replicas drop pid/port
+manifests under the workdir, this process is RESTARTABLE: SIGKILL it
+mid-rollout, start a fresh one against the same ``--store``/
+``--workdir``, and it adopts the live pods, then finishes (or rolls
+back) the rollout — the data plane never notices. The
+``operator-restart`` chaos drill rehearses exactly that.
+
+Usage::
+
+    python -m h2o_kubernetes_tpu.operator.run \
+        --store /var/h2o/poolstore --registry /var/h2o/registry \
+        --pool churn-pool --workdir /var/h2o/pools/churn-pool
+
+SIGTERM = graceful: stop reconciling, drain every replica (the PR-4
+pod drain path), exit 0. SIGKILL = crash: pods keep serving (own
+sessions), manifests stay, the next operator adopts them.
+``--leave-pods`` makes SIGTERM leave the data plane running too
+(operator handoff: retire THIS controller, keep the fleet).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--store", required=True,
+                    help="DurablePoolStore root (dir or mem://)")
+    ap.add_argument("--registry", required=True,
+                    help="ModelRegistry root")
+    ap.add_argument("--pool", required=True)
+    ap.add_argument("--workdir", required=True,
+                    help="pool workdir: pod manifests + logs")
+    ap.add_argument("--interval", type=float, default=None,
+                    help="reconcile interval override (else "
+                    "H2O_TPU_POOL_RECONCILE_INTERVAL)")
+    ap.add_argument("--leave-pods", action="store_true",
+                    help="on SIGTERM, exit WITHOUT draining replicas "
+                    "(handoff to a successor operator)")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from .reconcile import Reconciler
+    from .registry import ModelRegistry
+    from .store import DurablePoolStore
+
+    store = DurablePoolStore(args.store)
+    rec = Reconciler(store, ModelRegistry(args.registry), args.pool,
+                     workdir=args.workdir)
+    stop = threading.Event()
+
+    def _sigterm(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+
+    # the store file is the API wire: starting the operator BEFORE a
+    # client applies the pool spec is a supported ordering — wait for
+    # the spec instead of crashing on a missing pool
+    while not stop.is_set():
+        try:
+            store.get(args.pool)
+            break
+        except KeyError:
+            print(f"OPERATOR_WAITING pool={args.pool} (no spec yet)",
+                  flush=True)
+            stop.wait(1.0)
+    if stop.is_set():
+        return 0
+    adopted = rec.adopt_existing()
+    print(f"OPERATOR_UP pool={args.pool} pid={os.getpid()} "
+          f"adopted={adopted}", flush=True)
+    rec.run(stop, interval=args.interval)
+    if not args.leave_pods:
+        rec.shutdown()
+    print("OPERATOR_DOWN", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

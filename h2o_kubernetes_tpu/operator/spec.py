@@ -16,7 +16,13 @@ import threading
 import time
 from dataclasses import dataclass, field, replace
 
-__all__ = ["ScorerPoolSpec", "PoolStore"]
+__all__ = ["ScorerPoolSpec", "PoolStore", "StaleGenerationError"]
+
+
+class StaleGenerationError(RuntimeError):
+    """A fenced write carried a generation that is no longer current —
+    the writer is a stale controller (or raced another apply) and its
+    view of the spec must not overwrite the newer one."""
 
 
 @dataclass(frozen=True)
@@ -119,39 +125,86 @@ _EVENT_CAP = 256        # bounded: a flapping pool must not grow memory
 
 
 class PoolStore:
-    """Thread-safe dict-backed spec/status/event store (etcd analog)."""
+    """Thread-safe dict-backed spec/status/event store (etcd analog).
+
+    Writes accept an optional ``fence`` — the generation the writer
+    last observed. A fenced write whose generation is no longer
+    current raises :class:`StaleGenerationError` instead of landing
+    (optimistic concurrency, the resourceVersion-precondition analog):
+    a controller that kept running against an old store snapshot, or
+    a second operator racing the first, cannot clobber newer state.
+    Subclasses persist by overriding ``_persist``/``_forget`` (called
+    under the store lock, so snapshots are never torn)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock: _persist hooks run inside mutators while the lock is
+        # held, and a durable subclass may re-read state to snapshot
+        self._lock = threading.RLock()
         self._specs: dict[str, ScorerPoolSpec] = {}
         self._gens: dict[str, int] = {}
         self._status: dict[str, dict] = {}
         self._events: dict[str, collections.deque] = {}
 
+    # -- durability hooks (no-ops on the in-memory store) ---------------------
+    #
+    # Split by WRITER, the spec/status-subresource discipline: specs
+    # are written by whoever applies them (client, autoscaler), status
+    # + events only by the owning controller — so a durable subclass
+    # can keep the two in separate files and a controller status write
+    # can never clobber a concurrent client spec update.
+
+    def _persist_spec(self, name: str) -> None:
+        """Called under the lock after a spec mutation of `name`."""
+
+    def _persist_state(self, name: str) -> None:
+        """Called under the lock after a status/event mutation."""
+
+    def _refresh(self, name: str) -> None:
+        """Called under the lock before a read — a durable subclass
+        re-reads disk so one process observes another's writes."""
+
+    def _forget(self, name: str) -> None:
+        """Called under the lock after `name` is deleted."""
+
+    def _check_fence(self, name: str, fence: int | None) -> None:
+        if fence is not None and fence != self._gens.get(name, 0):
+            raise StaleGenerationError(
+                f"pool '{name}': write fenced at generation {fence} "
+                f"but the store is at {self._gens.get(name, 0)} — "
+                "stale controller write rejected")
+
     # -- spec (the declarative side) ------------------------------------------
 
-    def apply(self, spec: ScorerPoolSpec, **updates) -> int:
+    def apply(self, spec: ScorerPoolSpec, fence: int | None = None,
+              **updates) -> int:
         """Create or update a pool spec; field updates may be passed as
         kwargs against the stored spec (``store.apply(spec)`` or
         ``store.apply_update(name, replicas=3)`` style). Returns the
         new generation. No-op updates still bump the generation — the
-        reconciler is level-triggered, so that is harmless."""
+        reconciler is level-triggered, so that is harmless. ``fence``
+        makes the write conditional on the observed generation."""
         spec = replace(spec, **updates).validate() if updates \
             else spec.validate()
         with self._lock:
+            self._refresh(spec.name)
+            self._check_fence(spec.name, fence)
             self._specs[spec.name] = spec
             self._gens[spec.name] = self._gens.get(spec.name, 0) + 1
+            self._persist_spec(spec.name)
             return self._gens[spec.name]
 
-    def apply_update(self, name: str, **updates) -> int:
+    def apply_update(self, name: str, fence: int | None = None,
+                     **updates) -> int:
         with self._lock:
+            self._refresh(name)
             cur = self._specs.get(name)
-        if cur is None:
-            raise KeyError(f"no pool '{name}'")
-        return self.apply(replace(cur, **updates))
+            if cur is None:
+                raise KeyError(f"no pool '{name}'")
+            return self.apply(replace(cur, **updates), fence=fence)
 
     def get(self, name: str) -> tuple[ScorerPoolSpec, int]:
         with self._lock:
+            self._refresh(name)
             if name not in self._specs:
                 raise KeyError(f"no pool '{name}'")
             return self._specs[name], self._gens[name]
@@ -166,15 +219,21 @@ class PoolStore:
             self._gens.pop(name, None)
             self._status.pop(name, None)
             self._events.pop(name, None)
+            self._forget(name)
 
     # -- status + events (the observed side) ----------------------------------
 
-    def set_status(self, name: str, status: dict) -> None:
+    def set_status(self, name: str, status: dict,
+                   fence: int | None = None) -> None:
         with self._lock:
+            self._refresh(name)
+            self._check_fence(name, fence)
             self._status[name] = dict(status)
+            self._persist_state(name)
 
     def get_status(self, name: str) -> dict:
         with self._lock:
+            self._refresh(name)
             return dict(self._status.get(name, {}))
 
     def record_event(self, name: str, kind: str, msg: str = "") -> None:
@@ -183,10 +242,18 @@ class PoolStore:
         replica_ready sequence out of this)."""
         ev = {"t": time.time(), "kind": kind, "msg": msg}
         with self._lock:
+            # refresh-then-append: the durable write below persists
+            # the WHOLE state doc, so appending onto a stale cache
+            # would clobber status/events another process wrote since
+            # our last read (single state-writer is the design, but a
+            # handoff window must merge, not overwrite)
+            self._refresh(name)
             dq = self._events.setdefault(
                 name, collections.deque(maxlen=_EVENT_CAP))
             dq.append(ev)
+            self._persist_state(name)
 
     def events(self, name: str) -> list[dict]:
         with self._lock:
+            self._refresh(name)
             return list(self._events.get(name, ()))

@@ -25,7 +25,7 @@ import numpy as np
 
 __all__ = ["save_model", "load_model", "export_file", "save_frame",
            "load_frame", "PERSIST_SCHEMES", "read_bytes", "write_bytes",
-           "is_remote", "join_path"]
+           "write_bytes_atomic", "list_names", "is_remote", "join_path"]
 
 _MAGIC = b"H2OTPU1\n"
 
@@ -103,6 +103,73 @@ def _read_bytes(path: str) -> bytes:
 # REST export) stay backend-agnostic without reaching into privates
 read_bytes = _read_bytes
 write_bytes = _write_bytes
+
+
+def write_bytes_atomic(path: str, data: bytes,
+                       verify: bool = True) -> None:
+    """Crash-safe write: readers see the OLD bytes or the NEW bytes,
+    never a torn prefix.
+
+    Local FS: write-temp in the same directory + fsync + os.replace
+    (the rename is atomic on POSIX), so a process killed mid-write can
+    never leave a half-written file at `path` — the durable PoolStore
+    and the registry index both depend on this (a corrupted index
+    would break every subsequent fetch). Scheme backends (mem://,
+    s3://...) already replace whole objects, so they take the plain
+    write. ``verify`` reads the bytes back and compares digests — a
+    cheap end-to-end check that the backend stored what it was given.
+    """
+    import hashlib
+
+    if "://" in path:
+        _write_bytes(path, data)
+    else:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{os.path.basename(path)}."
+                              f"{os.getpid()}.tmp")
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+    if verify:
+        got = _read_bytes(path)
+        if hashlib.sha256(got).digest() != \
+                hashlib.sha256(data).digest():
+            raise IOError(
+                f"atomic write to {path} did not read back intact "
+                f"({len(got)} bytes back vs {len(data)} written)")
+
+
+def list_names(base: str) -> list[str]:
+    """Child object names directly under a local dir or a mem://
+    prefix (the two backends the durable PoolStore supports); other
+    schemes have no cheap listing and return []. Missing dir = []."""
+    if not is_remote(base):
+        try:
+            return sorted(
+                n for n in os.listdir(base)
+                if os.path.isfile(os.path.join(base, n)))
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+    if base.startswith("mem://"):
+        prefix = base.rstrip("/") + "/"
+        out = set()
+        for key in list(_MEM_STORE):
+            if key.startswith(prefix):
+                rest = key[len(prefix):]
+                if rest and "/" not in rest:
+                    out.add(rest)
+        return sorted(out)
+    return []
 
 
 def is_remote(path: str) -> bool:

@@ -79,6 +79,12 @@ REGISTRY_MODELS: dict[str, dict] = {}
 # legacy ">= 1 artifact loaded" gate.
 REQUIRED_MODEL_IDS: set[str] = set()
 
+# who this replica IS (pool, replica id, pid, port, started_at) —
+# set by the operator pod entry, reported on GET /3/Stats. A
+# restarting reconciler identity-probes adoption candidates against
+# it, so a recycled port can never masquerade as a pool's pod.
+IDENTITY: dict = {}
+
 # REST-level counters scraped by the operator's autoscale signal
 # (GET /3/Stats): 504s from expired X-H2O-Deadline-Ms budgets, scoring
 # requests admitted while the node could not serve readiness
@@ -1049,6 +1055,7 @@ class _Handler(BaseHTTPRequestHandler):
                                  for k, v in MODEL_STATS.items()}
                 return self._json({
                     "ready": ready, "reasons": reasons, **st,
+                    "identity": dict(IDENTITY),
                     "scorer_cache": sc,
                     "batcher": {**BATCHER.stats,
                                 "queue_depth": BATCHER.queue_depth()},

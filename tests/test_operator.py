@@ -23,9 +23,12 @@ from h2o_kubernetes_tpu import rest
 from h2o_kubernetes_tpu.models import GBM, GLM
 from h2o_kubernetes_tpu.models.base import Model, scorer_cache_stats
 from h2o_kubernetes_tpu.mojo import read_mojo_parts
-from h2o_kubernetes_tpu.operator import (FlatTreeScorer, ModelRegistry,
+from h2o_kubernetes_tpu.operator import (DurablePoolStore,
+                                         FlatTreeScorer, ModelRegistry,
                                          PoolStore, Reconciler,
-                                         ScorerPoolSpec, load_artifact)
+                                         ScorerPoolSpec,
+                                         StaleGenerationError,
+                                         load_artifact)
 from h2o_kubernetes_tpu.operator.autoscale import desired_replicas
 from h2o_kubernetes_tpu.operator.reconcile import (CORDONED, DEAD,
                                                    DRAINING, LOADING,
@@ -613,3 +616,381 @@ def test_cordon_flips_readyz_not_serving(pool_server):
     assert st["counters"]["scored_while_unready"] == 0
     assert _post(base, "/3/Uncordon", {})[0] == 200
     assert _get(base, "/readyz")[0] == 200
+
+
+# ---------------------------------------------------------------------------
+# Durable store (ISSUE 9 tentpole): restart round-trip + fencing
+# ---------------------------------------------------------------------------
+
+
+def test_durable_store_restart_roundtrip(tmp_path, mesh8):
+    """Specs, status, and events written by one operator process are
+    read back intact by a fresh process (fresh store object over the
+    same root) — the control-plane-survives-death acceptance."""
+    root = str(tmp_path / "store")
+    a = DurablePoolStore(root)
+    spec = ScorerPoolSpec(name="p", artifact="a", version=3,
+                          model_key="m", replicas=2,
+                          warm_buckets=(128,),
+                          extra_artifacts=(("a2", 1, "m2"),),
+                          env={"K": "V"})
+    gen = a.apply(spec)
+    gen = a.apply_update("p", replicas=3)
+    a.set_status("p", {"converged": False, "ready": 1}, fence=gen)
+    a.record_event("p", "replica_start", "p-1 v3")
+    a.record_event("p", "replica_ready", "p-1 v3")
+
+    b = DurablePoolStore(root)          # the restarted operator
+    spec_b, gen_b = b.get("p")
+    assert gen_b == gen == 2
+    assert spec_b == ScorerPoolSpec(name="p", artifact="a", version=3,
+                                    model_key="m", replicas=3,
+                                    warm_buckets=(128,),
+                                    extra_artifacts=(("a2", 1, "m2"),),
+                                    env={"K": "V"})
+    assert b.get_status("p") == {"converged": False, "ready": 1}
+    assert [e["kind"] for e in b.events("p")] == \
+        ["replica_start", "replica_ready"]
+    # deletes persist too
+    b.delete("p")
+    assert DurablePoolStore(root).pools() == []
+
+
+def test_durable_store_stale_generation_rejected(tmp_path, mesh8):
+    """The fencing acceptance: a controller still holding an old
+    generation cannot clobber newer spec or status."""
+    store = DurablePoolStore(str(tmp_path / "store"))
+    spec = ScorerPoolSpec(name="p", artifact="a", version=1,
+                          model_key="m")
+    g1 = store.apply(spec)
+    g2 = store.apply_update("p", replicas=2)
+    assert g2 == g1 + 1
+    with pytest.raises(StaleGenerationError):
+        store.apply(spec, fence=g1)
+    with pytest.raises(StaleGenerationError):
+        store.apply_update("p", fence=g1, replicas=9)
+    with pytest.raises(StaleGenerationError):
+        store.set_status("p", {"x": 1}, fence=g1)
+    # the stale writes did NOT land
+    assert store.get("p")[0].replicas == 2
+    assert store.get_status("p") == {}
+    # unfenced + correctly-fenced writes still work
+    store.set_status("p", {"x": 2}, fence=g2)
+    assert store.get_status("p") == {"x": 2}
+    assert store.apply_update("p", replicas=1) == g2 + 1
+
+
+def test_durable_store_cross_instance_visibility(tmp_path, mesh8):
+    """Two store instances over one root (the drill parent + the
+    operator child): a spec applied through one is observed by the
+    other on its next read, and status flows the other way — the
+    store file is the API-server wire."""
+    root = str(tmp_path / "store")
+    client = DurablePoolStore(root)
+    client.apply(ScorerPoolSpec(name="p", artifact="a", version=1,
+                                model_key="m"))
+    operator = DurablePoolStore(root)
+    assert operator.get("p")[0].version == 1
+    client.apply_update("p", version=2)          # client bumps
+    spec, gen = operator.get("p")                # operator observes
+    assert spec.version == 2 and gen == 2
+    operator.set_status("p", {"ready": 1}, fence=gen)
+    operator.record_event("p", "replica_ready", "p-1")
+    assert client.get_status("p") == {"ready": 1}  # client observes
+    assert [e["kind"] for e in client.events("p")] == ["replica_ready"]
+
+
+def test_durable_store_event_ring_bounded(tmp_path, mesh8):
+    store = DurablePoolStore(str(tmp_path / "store"))
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=1,
+                               model_key="m"))
+    for i in range(300):
+        store.record_event("p", "k", str(i))
+    reloaded = DurablePoolStore(str(tmp_path / "store"))
+    evs = reloaded.events("p")
+    assert len(evs) == 256 and evs[-1]["msg"] == "299"
+
+
+def test_atomic_write_and_listing(tmp_path, mesh8):
+    """persist.write_bytes_atomic: replace-in-place, read-back
+    verified, and no temp droppings; list_names sees only files."""
+    from h2o_kubernetes_tpu import persist
+
+    p = str(tmp_path / "d" / "f.json")
+    persist.write_bytes_atomic(p, b"v1")
+    persist.write_bytes_atomic(p, b"v2")
+    assert persist.read_bytes(p) == b"v2"
+    import os
+
+    assert sorted(os.listdir(tmp_path / "d")) == ["f.json"]
+    (tmp_path / "d" / "sub").mkdir()
+    assert persist.list_names(str(tmp_path / "d")) == ["f.json"]
+    assert persist.list_names(str(tmp_path / "nope")) == []
+
+
+# ---------------------------------------------------------------------------
+# Pod adoption on operator restart (fake replicas; real-subprocess leg
+# in tools/chaos.py operator-restart)
+# ---------------------------------------------------------------------------
+
+
+class FakeAdopted(FakeReplica):
+    """Already-running stand-in the adopted_factory hands back."""
+
+    def __init__(self, manifest, version, spec):
+        super().__init__(manifest["rid"], version, spec)
+        self.port = manifest["port"]
+        self._alive = True
+        self._loaded = True
+
+    def spawn(self):
+        raise AssertionError("adopted replicas are never spawned")
+
+
+def _manifest(dirpath, rid, pid=1000, port=7001, version=1):
+    import os
+
+    os.makedirs(dirpath, exist_ok=True)
+    doc = {"rid": rid, "pool": "p", "pid": pid, "port": port,
+           "version": version}
+    with open(os.path.join(dirpath, f"{rid}.json"), "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def _ready_stats(rid, version, pid=1000, cordoned=None):
+    return {"ready": True, "reasons": [], "cordoned": cordoned,
+            "identity": {"pool": "p", "replica": rid, "pid": pid},
+            "registry": {"m": {"version": version}}}
+
+
+def _adoption_pool(tmp_path, replicas=2, version=1, probe=None,
+                   pid_alive=None, **spec_kw):
+    store = PoolStore()
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=version,
+                               model_key="m", replicas=replicas,
+                               **spec_kw))
+    rec = Reconciler(store, registry=None, pool="p",
+                     replica_factory=FakeReplica,
+                     workdir=str(tmp_path),
+                     adopted_factory=FakeAdopted)
+    if probe is not None:
+        rec._probe_stats = probe
+    rec._pid_alive = pid_alive or (lambda pid: True)
+    return store, rec
+
+
+def test_adopt_matching_never_duplicates(tmp_path, monkeypatch, mesh8):
+    """A restarted operator ADOPTS its predecessor's live READY pods
+    instead of spawning duplicates — zero replica_start events."""
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    mdir = str(tmp_path / "pods")
+    _manifest(mdir, "p-1", port=7001)
+    _manifest(mdir, "p-2", port=7002)
+    # probe keyed off the port so each manifest matches its own rid
+    store, rec = _adoption_pool(
+        tmp_path, probe=lambda url: _ready_stats(
+            "p-1" if url.endswith(":7001") else "p-2", 1))
+    assert rec.adopt_existing() == 2
+    assert _settle(rec)
+    kinds = [e["kind"] for e in store.events("p")]
+    assert kinds.count("replica_adopted") == 2
+    assert "replica_start" not in kinds, \
+        "adoption must not spawn duplicates"
+    assert sorted(r.rid for r in rec.replicas) == ["p-1", "p-2"]
+    assert all(r.state == READY for r in rec.replicas)
+    # the rid sequence cleared the adopted ids: a later spawn cannot
+    # collide with a live pod's identity
+    assert rec._seq == 2
+
+
+def test_adopt_stale_version_replaced_via_rollout(tmp_path,
+                                                  monkeypatch, mesh8):
+    """Adoptees on an old artifact version are adopted READY, then
+    cordoned + replaced through the NORMAL surge-one convergence —
+    an operator restart mid-rollout finishes the rollout."""
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    mdir = str(tmp_path / "pods")
+    _manifest(mdir, "p-1", port=7001)
+    _manifest(mdir, "p-2", port=7002)
+    store, rec = _adoption_pool(tmp_path, version=2)   # spec wants v2
+    rec._probe_stats = lambda url: _ready_stats(
+        "p-1" if url.endswith(":7001") else "p-2", 1)  # pods run v1
+    assert rec.adopt_existing() == 2
+    assert not rec.converged()
+    assert _settle(rec, passes=60)
+    assert all(r.version == 2 and r.state == READY
+               for r in rec.replicas)
+    kinds = [e["kind"] for e in store.events("p")]
+    # old replicas retired via cordon (never hard-killed) only after
+    # a new-version READY existed
+    assert kinds.index("replica_cordon") > kinds.index("replica_ready")
+
+
+def test_adopt_stale_manifest_and_foreign_pod(tmp_path, mesh8):
+    """Dead-pid manifests are cleaned up; a live port answering as
+    someone else is left alone but its manifest is dropped. Both then
+    converge through fresh spawns."""
+    import os
+
+    mdir = str(tmp_path / "pods")
+    _manifest(mdir, "p-1", pid=111, port=7001)   # dead pid
+    _manifest(mdir, "p-2", pid=222, port=7002)   # foreign identity
+    store, rec = _adoption_pool(
+        tmp_path,
+        probe=lambda url: _ready_stats("OTHER", 1, pid=999),
+        pid_alive=lambda pid: pid != 111)
+    assert rec.adopt_existing() == 0
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "adoption_stale" in kinds
+    assert "adoption_foreign" in kinds
+    assert os.listdir(mdir) == []        # both manifests dropped
+    assert _settle(rec)
+    assert sum(1 for e in store.events("p")
+               if e["kind"] == "replica_start") == 2
+
+
+def test_adopt_runs_before_reconcile_in_run(tmp_path, monkeypatch,
+                                            mesh8):
+    """run() adopts FIRST — a reconcile pass before adoption would
+    spawn duplicates of every live pod."""
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    _manifest(str(tmp_path / "pods"), "p-1", port=7001)
+    store, rec = _adoption_pool(tmp_path, replicas=1)
+    rec._probe_stats = lambda url: _ready_stats("p-1", 1)
+    stop = threading.Event()
+    t = threading.Thread(target=rec.run, args=(stop,),
+                         kwargs={"interval": 0.02}, daemon=True)
+    t.start()
+    assert rec.wait_converged(timeout=10)
+    stop.set()
+    t.join(timeout=5)
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "replica_adopted" in kinds and "replica_start" not in kinds
+
+
+# ---------------------------------------------------------------------------
+# Crash-loop backoff + automatic rollout rollback
+# ---------------------------------------------------------------------------
+
+
+class CrashingReplica(FakeReplica):
+    """Dies the instant it is observed (process exits right away)."""
+
+    def spawn(self):
+        super().spawn()
+        self._alive = False
+
+
+def test_crash_loop_backoff_spacing(tmp_path, monkeypatch, mesh8):
+    """Respawns of a crash-looping replica are exponentially spaced:
+    first replacement immediate, then >= base, >= 2*base... with the
+    crash_loop_backoff event instead of a hot respawn loop."""
+    import time
+
+    monkeypatch.setenv("H2O_TPU_POOL_BACKOFF_BASE", "0.15")
+    monkeypatch.setenv("H2O_TPU_POOL_BACKOFF_MAX", "5")
+    store = PoolStore()
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=1,
+                               model_key="m", replicas=1))
+    rec = Reconciler(store, registry=None, pool="p",
+                     replica_factory=CrashingReplica)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 2.0:
+        rec.reconcile_once()
+        time.sleep(0.01)
+    starts = [e["t"] for e in store.events("p")
+              if e["kind"] == "replica_start"]
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "crash_loop_backoff" in kinds
+    assert len(starts) >= 4, f"crash loop never respawned: {kinds}"
+    gaps = [b - a for a, b in zip(starts, starts[1:])]
+    # gap 0 (first replacement) is free; then the exponential floor
+    assert gaps[1] >= 0.15 - 0.02, gaps
+    assert gaps[2] >= 0.30 - 0.02, gaps
+    # a hot loop would fit dozens of spawns into 2s; backoff caps it
+    assert len(starts) <= 8, f"{len(starts)} spawns in 2s: not spaced"
+    # status surfaces the wait
+    st = store.get_status("p")
+    assert "crash_loop" in st and st["crash_loop"]["version"] == 1
+
+
+class V2FailsReplica(FakeReplica):
+    """v2 fails its push (the poison artifact shape); other versions
+    behave."""
+
+    def start_load(self, registry):
+        if self.version == 2:
+            self.state = LOADING
+            self._load_done = True        # finished, with an error
+        else:
+            super().start_load(registry)
+
+    def load_error(self):
+        return "boom: poison artifact" if self.version == 2 else None
+
+
+def test_rollout_rollback_pins_last_good(tmp_path, monkeypatch, mesh8):
+    """A rollout whose new version fails readiness ROLLOUT_RETRIES
+    times auto-rolls-back: rollout_rolled_back fires, status pins the
+    last-good version, old replicas are never disturbed, and the pool
+    re-converges on last-good."""
+    monkeypatch.setenv("H2O_TPU_POOL_DEREGISTER_GRACE", "0")
+    monkeypatch.setenv("H2O_TPU_POOL_BACKOFF_BASE", "0")
+    monkeypatch.setenv("H2O_TPU_POOL_ROLLOUT_RETRIES", "3")
+    store = PoolStore()
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=1,
+                               model_key="m", replicas=2))
+    rec = Reconciler(store, registry=None, pool="p",
+                     replica_factory=V2FailsReplica)
+    assert _settle(rec)
+    old_rids = sorted(r.rid for r in rec.replicas)
+    store.apply_update("p", version=2)
+    assert _settle(rec, passes=80), store.get_status("p")
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "rollout_rolled_back" in kinds
+    assert kinds.count("replica_load_failed") == 3
+    st = store.get_status("p")
+    assert st["rollout"] == {"failed_version": 2, "pinned_version": 1,
+                             "state": "rolled_back"}
+    assert st["effective_version"] == 1 and st["desired_version"] == 2
+    # the old replicas were NEVER disturbed: same rids, still READY v1
+    assert sorted(r.rid for r in rec.replicas) == old_rids
+    assert all(r.state == READY and r.version == 1
+               for r in rec.replicas)
+    assert "replica_cordon" not in kinds
+    # a NEW version supersedes the pin and rolls normally
+    store.apply_update("p", version=3)
+    assert _settle(rec, passes=80)
+    assert all(r.version == 3 for r in rec.replicas)
+
+
+def test_rollback_state_survives_restart(tmp_path, mesh8):
+    """A restarted operator resumes the rollback pin from the durable
+    store's status instead of re-trying the failed version."""
+    store = DurablePoolStore(str(tmp_path / "store"))
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=2,
+                               model_key="m", replicas=1))
+    store.set_status("p", {"last_good_version": 1,
+                           "rollout": {"failed_version": 2,
+                                       "pinned_version": 1,
+                                       "state": "rolled_back"}})
+    rec = Reconciler(store, registry=None, pool="p",
+                     replica_factory=FakeReplica)
+    spec, _ = store.get("p")
+    assert rec._want_version(spec) == 1      # pinned, not re-tried
+    assert rec._last_good == 1
+    # a fresh version bump clears the pin
+    store.apply_update("p", version=3)
+    spec, _ = store.get("p")
+    assert rec._want_version(spec) == 3
+
+
+def test_probe_timeout_knob(monkeypatch, mesh8):
+    from h2o_kubernetes_tpu.operator.reconcile import _probe_timeout
+
+    assert _probe_timeout() == 2.0
+    monkeypatch.setenv("H2O_TPU_POOL_PROBE_TIMEOUT", "0.7")
+    assert _probe_timeout() == 0.7
+    monkeypatch.setenv("H2O_TPU_POOL_PROBE_TIMEOUT", "0")
+    assert _probe_timeout() == 0.1           # floored, never hangs

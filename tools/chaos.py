@@ -59,6 +59,14 @@ Scenarios:
   and the pool returns to spec count with aggregate readiness inside
   the drill deadline — replica_died → replica_start → replica_ready
   visible in the operator event log.
+- ``operator-restart``  SIGKILL the OPERATOR process mid-rollout
+  under closed-loop load, restart it against the durable store: the
+  successor adopts the live pods (zero duplicate spawns, zero leaked
+  pods), finishes the rollout, zero 5xx end to end.
+- ``poison-rollback``  push an artifact whose replica can never come
+  up: respawns are backoff-spaced (provably >= the configured
+  backoff), the rollout auto-rolls-back to last-good, old replicas
+  stay READY throughout, zero 5xx.
 """
 
 from __future__ import annotations
@@ -1016,6 +1024,301 @@ def scenario_tenant_storm() -> None:
         fx.close()
 
 
+def _live_pods_for(workdir: str) -> list[tuple[int, str]]:
+    """operator.pod processes whose cmdline references this pool's
+    workdir — the leak check of the control-plane drills."""
+    out = []
+    try:
+        pids = [int(d) for d in os.listdir("/proc") if d.isdigit()]
+    except OSError:
+        return out
+    for pid in pids:
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace")
+        except OSError:
+            continue
+        if "operator.pod" in cmd and workdir in cmd:
+            out.append((pid, cmd[:160]))
+    return out
+
+
+def scenario_operator_restart() -> None:
+    """SIGKILL the operator process mid-rollout under closed-loop
+    load, restart it against the durable store: the successor ADOPTS
+    the live pods (zero duplicate spawns, zero leaked pods), finishes
+    the rollout, and the load generator records zero 5xx end to end —
+    the control plane died, the data plane never noticed."""
+    import shutil
+    import signal
+    import subprocess
+
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.operator import (DurablePoolStore,
+                                             ModelRegistry,
+                                             ScorerPoolSpec)
+    from tools.score_load import run_load_multi
+
+    td = tempfile.mkdtemp(prefix="chaos_oprestart_")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    storedir = os.path.join(td, "store")
+    workdir = os.path.join(td, "work")
+    regdir = os.path.join(td, "registry")
+    procs: list = []
+    try:
+        rng = np.random.default_rng(0)
+        n = 500
+        cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+                for i in range(4)}
+        cols["y"] = np.where(cols["x0"] - cols["x1"] > 0, "late",
+                             "ontime")
+        feature_cols = [f"x{i}" for i in range(4)]
+        fr = h2o.Frame.from_arrays(cols)
+        registry = ModelRegistry(regdir)
+        v1 = registry.publish(GBM(ntrees=4, max_depth=3, seed=1).train(
+            y="y", training_frame=fr), "scorer")
+        v2 = registry.publish(GBM(ntrees=6, max_depth=3, seed=2).train(
+            y="y", training_frame=fr), "scorer")
+        store = DurablePoolStore(storedir)
+        store.apply(ScorerPoolSpec(
+            name="pool", artifact="scorer", version=v1,
+            model_key="pm", replicas=2, warm_buckets=(128,)))
+
+        def spawn_operator(tag: str) -> subprocess.Popen:
+            log = open(os.path.join(td, f"operator_{tag}.log"), "ab")
+            p = subprocess.Popen(
+                [sys.executable, "-m",
+                 "h2o_kubernetes_tpu.operator.run",
+                 "--store", storedir, "--registry", regdir,
+                 "--pool", "pool", "--workdir", workdir,
+                 "--interval", "0.25"],
+                cwd=repo, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                stdout=log, stderr=log, start_new_session=True)
+            procs.append(p)
+            return p
+
+        def status() -> dict:
+            return store.get_status("pool")
+
+        def wait_status(pred, timeout: float, what: str) -> dict:
+            deadline = time.monotonic() + timeout
+            st = status()
+            while time.monotonic() < deadline:
+                st = status()
+                if pred(st):
+                    return st
+                time.sleep(0.05)
+            raise ChaosFailure(f"timed out waiting for {what}: {st} "
+                               f"(operator logs under {td})")
+
+        def endpoints() -> list[str]:
+            return [f"http://127.0.0.1:{r['port']}"
+                    for r in status().get("replicas", ())
+                    if r["state"] in ("STARTING", "LOADING", "READY")]
+
+        op1 = spawn_operator("1")
+        wait_status(lambda st: st.get("converged")
+                    and st.get("desired_version") == v1,
+                    240, "v1 convergence")
+
+        load_stop = threading.Event()
+        result: dict = {}
+
+        def drive():
+            result.update(run_load_multi(
+                endpoints, "pm", feature_cols, concurrency=3,
+                rows_per_request=8, stop_event=load_stop))
+
+        lt = threading.Thread(target=drive, daemon=True)
+        lt.start()
+        time.sleep(1.5)                     # load in flight on v1
+        store.apply_update("pool", version=v2)
+        # the moment the surge-one v2 replica exists, the rollout is
+        # mid-flight — SIGKILL the control plane RIGHT THERE
+        wait_status(lambda st: any(r["version"] == v2
+                                   for r in st.get("replicas", ())),
+                    120, "the surge v2 replica to spawn")
+        op1.kill()
+        op1.wait(timeout=30)
+        pods_at_kill = _live_pods_for(workdir)
+        _check(len(pods_at_kill) >= 2,
+               f"expected >=2 live pods surviving the operator kill, "
+               f"found {pods_at_kill}")
+
+        op2 = spawn_operator("2")
+        wait_status(lambda st: st.get("converged")
+                    and st.get("desired_version") == v2
+                    and st.get("effective_version") == v2,
+                    300, "the restarted operator to finish the "
+                    "rollout")
+        time.sleep(0.5)                     # post-roll traffic on v2
+        load_stop.set()
+        lt.join(timeout=60)
+
+        _check(result.get("requests", 0) > 50,
+               f"load generator barely ran: {result}")
+        _check(result["fivexx"] == 0,
+               f"{result['fivexx']} 5xx across the operator restart: "
+               f"{result['fivexx_sample']}")
+        _check(result["errors"] == 0,
+               f"client errors across the restart: "
+               f"{result['error_sample']}")
+        # the durable event ring spans BOTH operator lives: the
+        # successor must have adopted, not re-spawned — exactly two
+        # v1 starts ever, and at least two adoptions
+        events = store.events("pool")
+        kinds = [e["kind"] for e in events]
+        _check(kinds.count("replica_adopted") >= 2,
+               f"successor did not adopt the live pods: {kinds}")
+        v1_starts = [e for e in events if e["kind"] == "replica_start"
+                     and f"v{v1} " in e["msg"] + " "]
+        _check(len(v1_starts) == 2,
+               f"v1 replicas were re-spawned (duplicates): "
+               f"{[e['msg'] for e in v1_starts]}")
+        # graceful teardown: SIGTERM drains the fleet, zero leaks
+        op2.send_signal(signal.SIGTERM)
+        rc = op2.wait(timeout=120)
+        _check(rc == 0, f"operator exited rc={rc} on SIGTERM")
+        leaked = _live_pods_for(workdir)
+        _check(not leaked, f"leaked pods after teardown: {leaked}")
+    finally:
+        import signal as _sig
+
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for pid, _ in _live_pods_for(workdir):
+            try:
+                os.kill(pid, _sig.SIGKILL)
+            except OSError:
+                pass
+        shutil.rmtree(td, ignore_errors=True)
+
+
+def scenario_poison_rollback() -> None:
+    """Push an artifact whose replica can never come up: respawns are
+    backoff-spaced (provably >= the configured base), the rollout
+    auto-rolls-back to last-good after H2O_TPU_POOL_ROLLOUT_RETRIES
+    failures, the old replicas stay READY throughout, and the load
+    generator records zero 5xx — a bad push degrades to 'nothing
+    happened' instead of a wedged pool."""
+    from h2o_kubernetes_tpu import persist
+    from tools.score_load import run_load_multi
+
+    base_backoff = 0.4
+    retries = 4
+    saved = {k: os.environ.get(k) for k in
+             ("H2O_TPU_POOL_BACKOFF_BASE", "H2O_TPU_POOL_BACKOFF_MAX",
+              "H2O_TPU_POOL_ROLLOUT_RETRIES")}
+    os.environ["H2O_TPU_POOL_BACKOFF_BASE"] = str(base_backoff)
+    os.environ["H2O_TPU_POOL_BACKOFF_MAX"] = "5"
+    os.environ["H2O_TPU_POOL_ROLLOUT_RETRIES"] = str(retries)
+    fx = _PoolFixture("poison")
+    try:
+        # poison v2 IN the registry: the blob no longer matches its
+        # indexed digest, so every push of it fails verification and
+        # the surge replica can never reach READY
+        path = fx.registry.artifact_path("scorer", fx.v2)
+        persist.write_bytes(path, b"POISON" + persist.read_bytes(path))
+
+        load_stop = threading.Event()
+        result: dict = {}
+
+        def drive():
+            result.update(run_load_multi(
+                fx.rec.endpoints, "pm", fx.feature_cols,
+                concurrency=3, rows_per_request=8,
+                stop_event=load_stop))
+
+        lt = threading.Thread(target=drive, daemon=True)
+        lt.start()
+        time.sleep(1.0)                 # load in flight on v1
+        fx.store.apply_update("pool", version=fx.v2)
+
+        # wait for the auto-rollback, sampling old-replica readiness
+        # the whole way: the bad push must never disturb them
+        ready_samples: list[int] = []
+        deadline = time.monotonic() + 120
+        rolled = False
+        while time.monotonic() < deadline:
+            st = fx.store.get_status("pool")
+            ready_samples.append(st.get("ready", 0))
+            if any(e["kind"] == "rollout_rolled_back"
+                   for e in fx.store.events("pool")):
+                rolled = True
+                break
+            time.sleep(0.1)
+        _check(rolled, "rollout never rolled back: "
+               f"{fx.event_kinds()} {fx.store.get_status('pool')}")
+        _check(fx.rec.wait_converged(timeout=60),
+               "pool did not re-converge on last-good after the "
+               f"rollback: {fx.store.get_status('pool')}")
+        time.sleep(1.0)                 # post-rollback traffic window
+        load_stop.set()
+        lt.join(timeout=60)
+
+        _check(result.get("requests", 0) > 50,
+               f"load generator barely ran: {result}")
+        _check(result["fivexx"] == 0,
+               f"{result['fivexx']} 5xx during the poisoned rollout: "
+               f"{result['fivexx_sample']}")
+        _check(result["errors"] == 0,
+               f"client errors during the poisoned rollout: "
+               f"{result['error_sample']}")
+        _check(ready_samples and min(ready_samples) >= 2,
+               f"old replicas dipped below spec count during the bad "
+               f"push: min ready {min(ready_samples or [0])}")
+
+        events = fx.store.events("pool")
+        kinds = [e["kind"] for e in events]
+        st = fx.store.get_status("pool")
+        _check(st.get("rollout", {}).get("pinned_version") == fx.v1
+               and st.get("effective_version") == fx.v1
+               and st.get("desired_version") == fx.v2,
+               f"status does not pin last-good v{fx.v1}: {st}")
+        _check("replica_cordon" not in kinds,
+               "a READY old replica was cordoned during the failed "
+               f"rollout: {kinds}")
+        # respawns provably backoff-spaced: starts 3+ of the poisoned
+        # version must be >= base (then >= 2*base) apart — a hot
+        # respawn loop fails here
+        v2_starts = [e["t"] for e in events
+                     if e["kind"] == "replica_start"
+                     and f"v{fx.v2} " in e["msg"] + " "]
+        _check(len(v2_starts) == retries,
+               f"expected {retries} poisoned spawns before rollback, "
+               f"got {len(v2_starts)}: {kinds}")
+        gaps = [b - a for a, b in zip(v2_starts, v2_starts[1:])]
+        _check(all(g >= base_backoff - 0.02 for g in gaps[1:]),
+               f"respawns not backoff-spaced (base {base_backoff}s): "
+               f"gaps {[round(g, 3) for g in gaps]}")
+        _check("crash_loop_backoff" in kinds,
+               f"no crash_loop_backoff event surfaced: {kinds}")
+        # the pool is parked, not wedged: no further poisoned spawns
+        n_before = len(v2_starts)
+        time.sleep(2.0)
+        v2_starts_after = [
+            e for e in fx.store.events("pool")
+            if e["kind"] == "replica_start"
+            and f"v{fx.v2} " in e["msg"] + " "]
+        _check(len(v2_starts_after) == n_before,
+               "pool kept re-trying the rolled-back version")
+        # replicas still serve the last-good artifact
+        versions = sorted(r.loaded_version() for r in fx.rec.replicas)
+        _check(versions == [fx.v1, fx.v1],
+               f"replicas not on last-good v{fx.v1}: {versions}")
+    finally:
+        fx.close()
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -1029,6 +1332,8 @@ SCENARIOS = {
     "rolling-update": scenario_rolling_update,
     "replica-kill": scenario_replica_kill,
     "tenant-storm": scenario_tenant_storm,
+    "operator-restart": scenario_operator_restart,
+    "poison-rollback": scenario_poison_rollback,
 }
 
 

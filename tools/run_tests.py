@@ -106,13 +106,35 @@ def find_orphan_processes() -> list[tuple[int, str]]:
     return out
 
 
+def _adoptable_manifest(pid: int, cmd: str) -> str | None:
+    """Path of a VALID adoption manifest on this pod's cmdline, else
+    None. A parentless pod whose `--manifest` file exists and names
+    this pid is ADOPTABLE — a restartable operator's data plane
+    surviving its controller (docs/OPERATOR.md "Control-plane
+    recovery"), not a leak. The reaper must report it, never kill it.
+    A pod whose manifest is gone (drill workdir deleted) or lies
+    about the pid is an ordinary leak and still gets reaped."""
+    parts = cmd.split()
+    try:
+        path = parts[parts.index("--manifest") + 1]
+    except (ValueError, IndexError):
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return path if int(doc.get("pid", -1)) == pid else None
+    except (OSError, ValueError):
+        return None
+
+
 def reap_orphan_pods(orphans: list[tuple[int, str]]
                      ) -> list[tuple[int, str]]:
     """SIGKILL orphaned scorer-pool pods — pods whose reconciler
     parent is gone (ppid reparented to init); see _REAP_PATTERNS.
     Returns the orphans still left to report: pods with a live parent
-    (a concurrent drill/operator owns them) and anything that refuses
-    to die, so a strict preflight still fails on them."""
+    (a concurrent drill/operator owns them), ADOPTABLE pods (live
+    manifest — a restarted operator will inherit them) and anything
+    that refuses to die, so a strict preflight still fails on them."""
     import signal
 
     remaining = []
@@ -120,6 +142,13 @@ def reap_orphan_pods(orphans: list[tuple[int, str]]
         ppid = _ppid(pid)
         if not any(pat in cmd for pat in _REAP_PATTERNS) \
                 or ppid is None or ppid > 1:
+            remaining.append((pid, cmd))
+            continue
+        man = _adoptable_manifest(pid, cmd)
+        if man is not None:
+            print(f"[preflight] pod {pid} is parentless but "
+                  f"ADOPTABLE (manifest {man}) — reporting, not "
+                  f"killing: {cmd}", flush=True)
             remaining.append((pid, cmd))
             continue
         try:
