@@ -363,6 +363,10 @@ class Model:
         d.pop("_serving_luts", None)    # rest.py enum-code LUT cache
         d.pop("_scorer_counters", None)  # process-local accounting
         d.pop("_evicted_shapes", None)
+        d.pop("_shap_tables", None)      # device TreeSHAP path tables
+        d.pop("_shap_tables_np", None)   # (host caches; rebuildable)
+        d.pop("_shap_ctab", None)
+        d.pop("_shap_ctab_np", None)
         return d
 
     def _serving_prepare(self) -> None:
@@ -382,6 +386,11 @@ class Model:
                 "_evicted_shapes", set()).update(ent["shapes"])
         self.__dict__.pop("_flat_trees", None)
         self.__dict__.pop("_serving_luts", None)
+        # device TreeSHAP tables go too (host _shap_*_np stays, like
+        # the heap trees: the re-promote rebuilds the SAME device
+        # constants -> same HLO -> a persistent-cache hit)
+        self.__dict__.pop("_shap_tables", None)
+        self.__dict__.pop("_shap_ctab", None)
 
     def _serving_resident_bytes(self) -> int:
         """Estimated bytes this model's live serving state pins:
@@ -396,6 +405,13 @@ class Model:
         if ft is not None:
             for leaf in jax.tree_util.tree_leaves(ft):
                 flat += int(getattr(leaf, "nbytes", 0) or 0)
+        for name in ("_shap_tables", "_shap_ctab"):
+            st = self.__dict__.get(name)
+            if st is not None:
+                # contributions executables embed the path/pattern
+                # tables as closed-over constants, like the flat arrays
+                for leaf in jax.tree_util.tree_leaves(st):
+                    flat += int(getattr(leaf, "nbytes", 0) or 0)
         total = flat
         for lut in (self.__dict__.get("_serving_luts") or {}).values():
             total += _LUT_BYTES_PER_ENTRY * len(lut)
@@ -408,11 +424,21 @@ class Model:
 
     def _cached_score(self, X: jax.Array,
                       offset: jax.Array | None = None) -> jax.Array:
-        """Score through this model's jitted scorer, tracking warm
-        shapes per (model, schema, padded batch, offset?) key and
-        charging this model's resident bytes against the cache
-        budget."""
+        return self._cached_apply(X, offset, "score")
+
+    def _cached_apply(self, X: jax.Array, offset: jax.Array | None,
+                      kind: str) -> jax.Array:
+        """Dispatch through this model's jitted serving executables,
+        tracking warm shapes per (model, schema, padded batch,
+        offset?/kind) key and charging this model's resident bytes
+        against the cache budget. ``kind`` selects the program:
+        "score" -> _score_matrix, "contrib" -> _contrib_matrix (the
+        TreeSHAP serving kernel) — both live in the ONE per-model
+        cache entry, so eviction/promotion/byte accounting treat a
+        model's whole serving footprint as a unit."""
         self._serving_prepare()
+        if kind == "contrib":
+            self._contrib_prepare()
         with _SCORER_LOCK:
             ent = self.__dict__.get("_scorer_cache")
             if ent is None:
@@ -426,7 +452,9 @@ class Model:
             mid = id(self)
             _SCORER_LRU[mid] = weakref.ref(self)
             _SCORER_LRU.move_to_end(mid)
-            skey = (X.shape[1], X.shape[0], offset is not None)
+            skey = (X.shape[1], X.shape[0],
+                    "contrib" if kind == "contrib"
+                    else offset is not None)
             if skey in ent["shapes"]:
                 _SCORER_STATS["hits"] += 1
                 ctr["hits"] += 1
@@ -476,18 +504,24 @@ class Model:
                         continue  # model already GC'd: just reclaim
                     victim._serving_evict()
                     _SCORER_STATS["evictions"] += 1
-            key = "fn_off" if offset is not None else "fn"
+            key = "fn_contrib" if kind == "contrib" else \
+                ("fn_off" if offset is not None else "fn")
             fn = ent.get(key)
             if fn is None:
-                fn = jax.jit(
-                    lambda X, off: self._score_matrix(X, offset=off)) \
-                    if offset is not None else \
-                    jax.jit(lambda X: self._score_matrix(X))
+                if kind == "contrib":
+                    fn = jax.jit(lambda X: self._contrib_matrix(X))
+                elif offset is not None:
+                    fn = jax.jit(
+                        lambda X, off: self._score_matrix(X, offset=off))
+                else:
+                    fn = jax.jit(lambda X: self._score_matrix(X))
                 ent[key] = fn
         # the (possibly multi-second) trace/compile happens OUTSIDE the
         # lock — jax's own caches are thread-safe; only our bookkeeping
         # needs mutual exclusion
-        return fn(X, offset) if offset is not None else fn(X)
+        if kind != "contrib" and offset is not None:
+            return fn(X, offset)
+        return fn(X)
 
     def _score(self, X: jax.Array,
                offset: jax.Array | None = None) -> jax.Array:
@@ -507,7 +541,174 @@ class Model:
             return self._score_matrix(X, offset=offset)
         return self._score_matrix(X)
 
-    def warm_up(self, buckets=None) -> list[int]:
+    # -- compiled TreeSHAP serving (predict_contributions fast path) --------
+
+    def contrib_support(self) -> "str | None":
+        """None when this model can serve per-row TreeSHAP
+        contributions, else the actionable precondition message — THE
+        shared gate for ``predict_contributions``, the serving entry
+        ``contrib_numpy``, and the REST route's clean 400 (tree models
+        override with the real precondition list)."""
+        return (f"model '{self.algo}' does not support "
+                "predict_contributions (tree ensembles only)")
+
+    def _shap_sources(self):
+        """Hook: (FlatTrees numpy, flat cover numpy) for the TreeSHAP
+        path tables — GBMModel flattens its heap trees, a registry
+        FlatTreeScorer reads its kept artifact parts."""
+        raise NotImplementedError
+
+    def _contrib_enum_mask(self):
+        """Hook: the device enum mask the contributions kernel
+        canonicalizes NAs with."""
+        raise NotImplementedError
+
+    def _contrib_scale_init(self) -> tuple[float, float]:
+        """Hook: (scale, init) applied to the raw kernel output."""
+        raise NotImplementedError
+
+    def _contrib_prepare(self):
+        """Materialize the device TreeSHAP state OUTSIDE the jit
+        trace: per-leaf path tables (models/tree/shap.py) plus — when
+        it fits the byte gate — the per-pattern contribution table
+        that turns the kernel into bit-tests + one gather. Host numpy
+        copies are cached separately so a byte-budget eviction (which
+        drops only the device arrays) re-promotes with identical
+        constants: same HLO, a persistent-cache hit, bitwise-identical
+        output."""
+        st = self.__dict__.get("_shap_tables")
+        ct = self.__dict__.get("_shap_ctab")
+        if st is not None and ct is not None:
+            return st, ct
+        stn = self.__dict__.get("_shap_tables_np")
+        if stn is None:
+            from .tree.shap import (_PATTERN_TABLE_MAX_BYTES,
+                                    build_shap_table_groups,
+                                    pattern_table)
+
+            flat, cover = self._shap_sources()
+            stn = build_shap_table_groups(flat, cover)
+            self._shap_tables_np = stn
+            # per-group pattern tables against ONE shared per-model
+            # byte budget (a group past the remainder runs the DP
+            # kernel) — the tables become per-executable jit constants,
+            # so an unbounded total would pin arbitrary device bytes
+            # the scorer cache cannot partially evict
+            remaining = _PATTERN_TABLE_MAX_BYTES
+            ctabs = []
+            for g in stn:
+                c = pattern_table(g, budget=remaining)
+                if c is not None:
+                    remaining -= c.nbytes
+                ctabs.append(c)
+            self._shap_ctab_np = ctabs
+        from .tree.shap import ShapTables
+
+        st = [ShapTables(*(jnp.asarray(a) for a in g)) for g in stn]
+        ct = [None if c is None else jnp.asarray(c)
+              for c in self.__dict__["_shap_ctab_np"]]
+        self._shap_tables = st
+        self._shap_ctab = ct
+        # RETURN the locals (FlatTreeScorer._serving_prepare contract):
+        # a concurrent byte-budget eviction may pop the attributes
+        # between this return and the caller's read mid-trace
+        return st, ct
+
+    def _contrib_matrix(self, X: jax.Array) -> jax.Array:
+        """[rows, F+1] contributions on raw features via the jitted
+        path-enumeration TreeSHAP kernel (the pattern-table fast path
+        when the ensemble is shallow enough for it) — the serving twin
+        of ``predict_contributions``, which keeps the f64 host
+        recursion as the parity oracle the way predict() stays
+        eager."""
+        from .tree.shap import flat_shap, flat_shap_tab
+
+        groups, ctabs = self._contrib_prepare()
+        em = self._contrib_enum_mask()
+        phi = None
+        for g, ct in zip(groups, ctabs):
+            p = flat_shap_tab(g, ct, X, em) if ct is not None \
+                else flat_shap(g, X, em)
+            phi = p if phi is None else phi + p
+        scale, init = self._contrib_scale_init()
+        phi = phi * jnp.float32(scale)
+        return phi.at[:, -1].add(jnp.float32(init))
+
+    def _contrib_chunk(self) -> int:
+        """Rows per TreeSHAP device dispatch. The kernel's working set
+        is O(rows · leaves · depth), so deep/wide ensembles shrink the
+        chunk to keep transients bounded; H2O_TPU_CONTRIB_CHUNK caps
+        it (default 16384, floored to a power of two so every full
+        chunk shares ONE trace key)."""
+        try:
+            cap = int(float(os.environ.get("H2O_TPU_CONTRIB_CHUNK",
+                                           "16384")))
+        except ValueError:
+            cap = 16384
+        cap = max(_SCORE_MIN_BATCH, cap)
+        c = _SCORE_MIN_BATCH
+        while c * 2 <= cap:
+            c *= 2
+        cap = c
+        stn = self.__dict__.get("_shap_tables_np")
+        if stn:
+            ld = max(g.feat.shape[1] * g.feat.shape[2] for g in stn)
+            fit = max((1 << 24) // max(ld, 1), _SCORE_MIN_BATCH)
+            while cap > _SCORE_MIN_BATCH and cap > fit:
+                cap //= 2
+        return cap
+
+    def contrib_numpy(self, X) -> np.ndarray:
+        """Serving entry for per-row TreeSHAP contributions: raw
+        [n, F] ndarray (training value space, enum codes / NaN NAs)
+        -> [n, F+1] float32 contributions, last column the bias term
+        (per-tree expectations + init) — additive to the raw margin.
+
+        Same serving discipline as ``score_numpy``: pow2 batch
+        padding into the per-model jitted cache (warm traffic is
+        zero-retrace), the circuit breaker + device guard around the
+        dispatch, and the ``score.dispatch`` fault point. Large
+        batches are chunked to ``_contrib_chunk()`` rows so the
+        kernel's [rows × leaves × depth] transients stay bounded —
+        every full chunk reuses one executable."""
+        from ..runtime.health import device_dispatch, require_healthy
+        from ..runtime.lifecycle import breaker_guard
+
+        reason = self.contrib_support()
+        if reason:
+            raise ValueError(reason)
+        require_healthy(fault_site=None)
+        X = np.asarray(X, dtype=np.float32)
+        if X.ndim != 2 or X.shape[1] != len(self.feature_names):
+            raise ValueError(
+                f"contrib_numpy expects [n, {len(self.feature_names)}] "
+                f"(features {self.feature_names}), got {X.shape}")
+        n = X.shape[0]
+        if n == 0:
+            raise ValueError("contrib_numpy: empty batch")
+        from ..runtime import faults
+
+        with breaker_guard("contributions scoring"), \
+                device_dispatch("contributions scoring", locking=False):
+            faults.fire("score.dispatch")
+            self._contrib_prepare()
+            chunk = self._contrib_chunk()
+            outs = []
+            for s in range(0, n, chunk):
+                xs = X[s:s + chunk]
+                b = _batch_bucket(xs.shape[0])
+                if b != xs.shape[0]:
+                    Xp = np.zeros((b, X.shape[1]), dtype=np.float32)
+                    Xp[: xs.shape[0]] = xs
+                else:
+                    Xp = xs
+                out = self._cached_apply(jnp.asarray(Xp), None,
+                                         "contrib")
+                outs.append(np.asarray(out)[: xs.shape[0]])
+        return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+    def warm_up(self, buckets=None, contributions: bool = False
+                ) -> list[int]:
         """Pre-trace the jitted serving scorer at the given batch
         buckets (padded to the pow2 buckets score_numpy actually
         dispatches), so the FIRST real request after a replica goes
@@ -564,6 +765,21 @@ class Model:
             X = np.zeros((b, F), dtype=np.float32)
             off = np.zeros(b, dtype=np.float32) if need_off else None
             self.score_numpy(X, offset=off)
+        if contributions:
+            # pre-trace the contributions executables too — the ladder
+            # is capped at the model's chunk size (contrib_numpy never
+            # dispatches a bigger bucket: larger batches split into
+            # full chunks + one tail bucket, all <= chunk)
+            reason = self.contrib_support()
+            if reason:
+                raise ValueError(reason)
+            done: set[int] = set()
+            for b in padded:
+                be = min(b, self._contrib_chunk())
+                if be in done:
+                    continue
+                done.add(be)
+                self.contrib_numpy(np.zeros((be, F), dtype=np.float32))
         return padded
 
     def score_numpy(self, X, offset=None) -> np.ndarray:

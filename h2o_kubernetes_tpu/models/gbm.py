@@ -38,7 +38,8 @@ from .tree.binning import (BinSpec, apply_bins, apply_bins_jit, fit_bins,
 from .tree.core import (BoostParams, FlatTrees, Tree, TreeParams,
                         _grad_hess, boost_trees, boost_trees_drf,
                         boost_trees_multi, descend_tree, drf_group_size,
-                        flat_margin, flatten_trees, predict_tree)
+                        flat_margin, flatten_cover, flatten_trees,
+                        predict_tree)
 
 
 @dataclass
@@ -371,26 +372,62 @@ class GBMModel(Model):
             out[name] = Vec.from_numpy(remap[inv], name, domain=dom)
         return out
 
+    def contrib_support(self) -> str | None:
+        """TreeSHAP preconditions — THE one gate shared by the host
+        ``predict_contributions``, the serving entry ``contrib_numpy``,
+        and the REST contributions route (which turns a non-None
+        reason into a clean 400, never a 500 traceback)."""
+        if self.nclasses > 2:
+            return ("predict_contributions supports binomial "
+                    "and regression models only")
+        if getattr(self, "offset_column", None):
+            # a per-row offset is not attributable to any feature, so
+            # SHAP columns could not sum to the margin
+            return ("predict_contributions is not supported "
+                    "for models trained with an offset")
+        cov = getattr(self.trees, "cover", None)
+        if cov is None or np.isnan(np.asarray(cov)).any():
+            # .any(), not .all(): checkpoint continuation from a
+            # pre-cover model mixes NaN-backfilled trees with real ones
+            return (
+                "this model contains trees saved by a build without "
+                "per-node cover (pre-0.2); TreeSHAP needs it — retrain "
+                "with this build")
+        return None
+
+    def _contrib_scale_init(self) -> tuple[float, float]:
+        """(scale, init) applied to the raw kernel/recursion output —
+        one formula for the host and device paths."""
+        scale = float(getattr(self, "margin_scale", 1.0))
+        if self.params._drf_mode:
+            scale /= self.ntrees
+        init = self.init_score if np.ndim(self.init_score) == 0 \
+            else float(np.asarray(self.init_score).ravel()[0])
+        return scale, float(init)
+
+    def _shap_sources(self):
+        """(flat arrays, slot-aligned cover) for the TreeSHAP path
+        tables — the SAME flattening the serving scorer descends."""
+        flat = self._flat()
+        return (FlatTrees(*(np.asarray(a) for a in flat)),
+                flatten_cover(self.trees, self.params.max_depth))
+
+    def _contrib_enum_mask(self):
+        return self._enum_mask
+
     def predict_contributions(self, frame: Frame) -> Frame:
         """Per-row TreeSHAP feature contributions (h2o
         predict_contributions, h2o-genmodel TreeSHAP [U3]): one column
         per feature plus BiasTerm, additive to the raw margin
-        prediction. Binomial and regression only, like the reference."""
-        if self.nclasses > 2:
-            raise ValueError("predict_contributions supports binomial "
-                             "and regression models only")
-        if getattr(self, "offset_column", None):
-            # a per-row offset is not attributable to any feature, so
-            # SHAP columns could not sum to the margin
-            raise ValueError("predict_contributions is not supported "
-                             "for models trained with an offset")
-        if np.isnan(np.asarray(self.trees.cover)).any():
-            # .any(), not .all(): checkpoint continuation from a
-            # pre-cover model mixes NaN-backfilled trees with real ones
-            raise ValueError(
-                "this model contains trees saved by a build without "
-                "per-node cover (pre-0.2); TreeSHAP needs it — retrain "
-                "with this build")
+        prediction. Binomial and regression only, like the reference.
+
+        This is the in-process HOST path (float64 recursion over the
+        heap trees) — the parity reference; serving traffic rides the
+        compiled device kernel via ``contrib_numpy`` / the REST
+        contributions route (docs/SERVING.md "Explainable serving")."""
+        reason = self.contrib_support()
+        if reason:
+            raise ValueError(reason)
         from .tree.shap import ensemble_shap
 
         X = self._design_matrix(frame)
@@ -400,15 +437,11 @@ class GBMModel(Model):
         trees_np = {f: np.asarray(getattr(self.trees, f))
                     for f in ("split_feat", "split_bin", "na_left",
                               "is_split", "value", "cover")}
-        scale = getattr(self, "margin_scale", 1.0)
-        if self.params._drf_mode:
-            scale /= self.ntrees
+        scale, init = self._contrib_scale_init()
         phi = ensemble_shap(trees_np, binned,
                             len(self.feature_names),
                             self.bin_spec.na_bin, scale=scale)
-        init = self.init_score if np.ndim(self.init_score) == 0 \
-            else float(np.asarray(self.init_score).ravel()[0])
-        phi[:, -1] += float(init)
+        phi[:, -1] += init
         cols = {name: phi[:, i].astype(np.float32)
                 for i, name in enumerate(self.feature_names)}
         cols["BiasTerm"] = phi[:, -1].astype(np.float32)

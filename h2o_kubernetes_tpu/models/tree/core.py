@@ -815,6 +815,45 @@ class FlatTrees(NamedTuple):
     value: jax.Array        # f32   [T, M]; leaf value (0 on splits)
 
 
+def _reach_slots(isp: np.ndarray, max_depth: int
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
+    """(reach [T, N] bool, slot [T, N] int, M) — the reachable-node set
+    and its BFS slot assignment, shared by ``flatten_trees`` and
+    ``flatten_cover`` so every per-node companion array (cover, for the
+    TreeSHAP path tables) lands on exactly the slots the serving
+    descent reads."""
+    T, N = isp.shape
+    reach = np.zeros((T, N), dtype=bool)
+    reach[:, 0] = True
+    for d in range(max_depth):
+        lo, hi = 2 ** d - 1, 2 ** (d + 1) - 1
+        if hi > N:
+            break
+        par = reach[:, lo:hi] & isp[:, lo:hi]
+        idx = np.arange(lo, hi)
+        reach[:, 2 * idx + 1] |= par
+        reach[:, 2 * idx + 2] |= par
+    # BFS slot order == heap-index order among reachable nodes (FIFO
+    # BFS emits each level in parent order, i.e. ascending heap index)
+    slot = reach.cumsum(axis=1) - 1                       # [T, N]
+    M = int(reach.sum(axis=1).max())
+    return reach, slot, M
+
+
+def flatten_cover(trees: Tree, max_depth: int) -> np.ndarray:
+    """[T, M] per-FLAT-NODE training weight mass (TreeSHAP's r_j),
+    slot-aligned with ``flatten_trees``' arrays — the optional MOJO-v2
+    ``flat_cover`` part and the input to the per-leaf path tables
+    (models/tree/shap.py::build_shap_tables)."""
+    isp = np.asarray(trees.is_split).astype(bool)
+    cov = np.asarray(trees.cover).astype(np.float32)
+    reach, slot, M = _reach_slots(isp, max_depth)
+    out = np.zeros((isp.shape[0], M), dtype=np.float32)
+    tt, hh = np.nonzero(reach)
+    out[tt, slot[tt, hh]] = cov[tt, hh]
+    return out
+
+
 def flatten_trees(trees: Tree, edges_matrix: np.ndarray,
                   enum_mask: np.ndarray, max_depth: int) -> FlatTrees:
     """Host-side flattening of a stacked [T, N] heap Tree pytree.
@@ -840,21 +879,8 @@ def flatten_trees(trees: Tree, edges_matrix: np.ndarray,
     edges_matrix = np.asarray(edges_matrix)
     enum_mask = np.asarray(enum_mask).astype(bool)
     T, N = sf.shape
-    # reachable set, level by level: children of reachable split nodes
-    reach = np.zeros((T, N), dtype=bool)
-    reach[:, 0] = True
-    for d in range(max_depth):
-        lo, hi = 2 ** d - 1, 2 ** (d + 1) - 1
-        if hi > N:
-            break
-        par = reach[:, lo:hi] & isp[:, lo:hi]
-        idx = np.arange(lo, hi)
-        reach[:, 2 * idx + 1] |= par
-        reach[:, 2 * idx + 2] |= par
-    # BFS slot order == heap-index order among reachable nodes (FIFO
-    # BFS emits each level in parent order, i.e. ascending heap index)
-    slot = reach.cumsum(axis=1) - 1                       # [T, N]
-    M = int(reach.sum(axis=1).max())
+    # reachable set + BFS slots (shared with flatten_cover)
+    reach, slot, M = _reach_slots(isp, max_depth)
     out_feat = np.full((T, M), -1, dtype=np.int32)
     out_thresh = np.zeros((T, M), dtype=np.float32)
     out_left = np.zeros((T, M), dtype=np.int32)

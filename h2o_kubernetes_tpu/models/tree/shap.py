@@ -7,23 +7,60 @@ Shapley values under the tree's own cover-weighted conditional
 expectations, computed by carrying a path of
 (feature, zero_fraction, one_fraction, weight) down the recursion.
 
-Design: host-side numpy, vectorized over ROWS. The recursion walks the
-tree ONCE (not per row); one_fractions and path weights are [rows]
-vectors (hot/cold branching differs per row) while zero_fractions stay
-scalars (cover ratios are row-independent). Work is
-O(leaves · depth² · rows) per tree with numpy inner ops — contributions
-are a scoring-time feature on modest frames, not a training hot loop,
-so the device kernel budget stays on training (ops/histogram).
+Two implementations share this module:
 
-Additivity invariant (tested): sum_f phi[:, f] + phi[:, bias] equals
-the raw margin prediction of the ensemble.
+1. ``ensemble_shap`` — the HOST reference: numpy recursion over the
+   dense heap, vectorized over rows, float64. The recursion walks the
+   tree ONCE; one_fractions and path weights are [rows] vectors while
+   zero_fractions stay scalars. O(leaves · depth² · rows) per tree.
+   In-process ``predict_contributions`` stays here (f64, the parity
+   oracle) exactly as ``predict()`` stays eager while serving jits.
+
+2. ``flat_shap`` / ``flat_shap_tab`` — the COMPILED serving kernels
+   (ISSUE 10 tentpole): the path-enumeration form of the same
+   algorithm over per-leaf path tables precomputed from the flattened
+   serving arrays (``build_shap_tables`` /
+   ``build_shap_table_groups``). Per (row, leaf) the DP kernel runs
+   the EXTEND dynamic program once and an UNWIND-sum per path slot —
+   a dense ``[rows × leaves × depth]`` computation with no recursion,
+   no host sync, and a fixed f32 accumulation order (scan over
+   trees), the per-tree-parallel dispatch shape of arXiv:1706.08359.
+   Duplicate features on a root→leaf path are MERGED host-side
+   (cover-fraction products, conjunction of hot conditions — exactly
+   what the recursion's unwind/re-extend computes), and every path is
+   padded to its group depth with (one=1, zero=1) entries, which are
+   provably neutral to the Shapley subset weights: appending such an
+   element to the feature set U maps each subset S ⊆ U\\{i} to the
+   pair {S, S∪{e}} whose factorial weights sum to S's original
+   weight. That makes the whole kernel static-shaped — no per-leaf
+   lengths. Three throughput levers on top (docs/SERVING.md
+   "Explainable serving"): one_fractions are BINARY, so each leaf's
+   whole weight computation collapses to a D-bit hot pattern indexing
+   a precomputed f64-built table (``pattern_table`` →
+   ``flat_shap_tab``, the default for shallow ensembles); everything
+   runs rows-minor (transposed), so feature gathers are contiguous
+   column slices and the scatter is per-slot vector adds; and leaves
+   pool ACROSS trees into virtual trees bucketed by their own merged
+   depth (TreeSHAP is additive over leaves — bias included, as each
+   leaf carries its v·P share), so total work is exactly
+   Σ_leaf depth_leaf rather than leaves × max-depth.
+
+Additivity invariant (tested, both paths): sum_f phi[:, f] +
+phi[:, bias] equals the raw margin prediction of the ensemble.
 """
 
 from __future__ import annotations
 
-import numpy as np
+from typing import NamedTuple
 
-__all__ = ["ensemble_shap"]
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = ["ensemble_shap", "ShapTables", "build_shap_tables",
+           "build_shap_table_groups", "flat_shap", "flat_shap_tab",
+           "pattern_table"]
 
 
 def _tree_shap_one(sf, sb, nl, sp, val, cov, binned, na_bin, phi):
@@ -154,3 +191,374 @@ def ensemble_shap(trees_np: dict, binned: np.ndarray, n_features: int,
                        trees_np["cover"][t],
                        binned, na_bin, phi)
     return phi * scale
+
+
+# ---------------------------------------------------------------------------
+# Compiled TreeSHAP serving: per-leaf path tables + the device kernel
+# ---------------------------------------------------------------------------
+
+class ShapTables(NamedTuple):
+    """Per-leaf root→leaf path tables over the flattened serving
+    ensemble — the dense operand of ``flat_shap``. All arrays are
+    [T, L, D] (trees × max leaves × max unique path features) except
+    ``leaf_val``/``bias``; hot conditions live in RAW feature space
+    (the same thresholds ``flat_margin`` descends), so a registry
+    ``FlatTreeScorer`` can build them from artifact bytes alone.
+
+    Padding is self-neutralizing: dummy slots carry (feat=-1,
+    lo=-inf, hi=NaN, na_ok=True, z=1) — their one_fraction is 1 for
+    every row, so (o - z) = 0 and the Shapley weights are provably
+    unchanged (see the module docstring); padded leaves carry
+    leaf_val=0."""
+
+    feat: jax.Array      # int32 [T, L, D]; -1 = padding slot
+    lo: jax.Array        # f32: hot needs x >= lo (-inf = no lower bound)
+    hi: jax.Array        # f32: hot needs NOT x >= hi (NaN = no upper
+    #                      bound — x >= NaN is False for EVERY x, so
+    #                      the negation is True without a sentinel
+    #                      check; -inf = branch unreachable for non-NA
+    #                      rows, since x >= -inf holds for every x)
+    na_ok: jax.Array     # bool: NA rows of `feat` follow this path
+    zfrac: jax.Array     # f32: merged cover-fraction product (TreeSHAP
+    #                      zero_fraction; 1.0 on padding)
+    leaf_val: jax.Array  # f32 [T, L]; 0 on padded leaves
+    bias: jax.Array      # f32 [T]: per-tree expectation Σ v_l · P(l)
+
+
+def _enumerate_paths(flat, cover: np.ndarray) -> list[list]:
+    """Per tree, the merged per-leaf path entries: a list of
+    (merged {feat -> {lo, hi, na, z}}, leaf_value, P_leaf) triples.
+
+    Per leaf, the root→leaf path is walked once; splits on the SAME
+    feature merge into one slot — zero_fractions multiply (the
+    recursion's unwind/re-extend computes exactly this product) and
+    the hot condition becomes the interval conjunction of the split
+    decisions: `x >= thresh` for every right turn (=> lo = max), the
+    negation for every left turn (=> hi = min over finite thresholds;
+    a NaN threshold is the always-left cut, so a left turn there binds
+    nothing and a right turn marks the branch dead for non-NA rows,
+    encoded hi = -inf). NA routing stays per-feature via ``na``
+    (conjunction of the learned na_left directions)."""
+    sf = np.asarray(flat.split_feat)
+    th = np.asarray(flat.thresh).astype(np.float64)
+    lf = np.asarray(flat.left)
+    nl = np.asarray(flat.na_left).astype(bool)
+    val = np.asarray(flat.value).astype(np.float64)
+    cov = np.asarray(cover).astype(np.float64)
+    T = sf.shape[0]
+    per_tree: list[list] = []
+    for t in range(T):
+        leaves = []
+        stack: list[tuple[int, list]] = [(0, [])]
+        while stack:
+            node, path = stack.pop()
+            if len(path) > 64:
+                raise ValueError(
+                    "malformed flat tree: root→leaf path exceeds 64 "
+                    "nodes (cyclic left pointers?)")
+            f = int(sf[t, node])
+            if f < 0:
+                merged: dict[int, dict] = {}
+                P = 1.0
+                for (d, thr, right, naleft, ratio) in path:
+                    P *= ratio
+                    e = merged.get(d)
+                    if e is None:
+                        e = merged[d] = {"lo": -np.inf, "hi": np.nan,
+                                         "na": True, "z": 1.0}
+                    e["z"] *= ratio
+                    e["na"] = e["na"] and \
+                        ((not naleft) if right else naleft)
+                    if right:
+                        if np.isnan(thr):
+                            # right past the always-left cut: no non-NA
+                            # row can take this branch
+                            e["hi"] = -np.inf
+                        else:
+                            e["lo"] = max(e["lo"], thr)
+                    elif not np.isnan(thr):
+                        e["hi"] = thr if np.isnan(e["hi"]) \
+                            else min(e["hi"], thr)
+                leaves.append((merged, float(val[t, node]), P))
+                continue
+            left = int(lf[t, node])
+            cj = max(cov[t, node], 1e-12)
+            thr = float(th[t, node])
+            naleft = bool(nl[t, node])
+            stack.append((left, path + [(f, thr, False, naleft,
+                                         float(cov[t, left]) / cj)]))
+            stack.append((left + 1, path + [(f, thr, True, naleft,
+                                             float(cov[t, left + 1])
+                                             / cj)]))
+        per_tree.append(leaves)
+    return per_tree
+
+
+def _pack_tables(per_tree: list[list]) -> ShapTables:
+    """Pad a group of enumerated trees to its own (L, D) and pack the
+    dense arrays (numpy leaves; callers device_put)."""
+    T = len(per_tree)
+    L = max(max(len(lv) for lv in per_tree), 1)
+    D = max(max((len(m) for m, _, _ in lv), default=0)
+            for lv in per_tree)
+    D = max(D, 1)
+    feat = np.full((T, L, D), -1, dtype=np.int32)
+    lo = np.full((T, L, D), -np.inf, dtype=np.float32)
+    hi = np.full((T, L, D), np.nan, dtype=np.float32)
+    na_ok = np.ones((T, L, D), dtype=bool)
+    z = np.ones((T, L, D), dtype=np.float32)
+    leaf_val = np.zeros((T, L), dtype=np.float32)
+    bias = np.zeros(T, dtype=np.float32)
+    for t, leaves in enumerate(per_tree):
+        b = 0.0
+        for li, (merged, v, P) in enumerate(leaves):
+            leaf_val[t, li] = v
+            b += v * P
+            for si, (d, e) in enumerate(merged.items()):
+                feat[t, li, si] = d
+                lo[t, li, si] = e["lo"]
+                hi[t, li, si] = e["hi"]
+                na_ok[t, li, si] = e["na"]
+                z[t, li, si] = e["z"]
+        bias[t] = b
+    return ShapTables(feat, lo, hi, na_ok, z, leaf_val, bias)
+
+
+def build_shap_tables(flat, cover: np.ndarray) -> ShapTables:
+    """Host-side path enumeration: flattened arrays (+ slot-aligned
+    per-node cover, core.flatten_cover / the MOJO ``flat_cover`` part)
+    -> ONE padded ShapTables bundle over the whole ensemble (see
+    ``_enumerate_paths`` for the merge semantics). The serving path
+    uses ``build_shap_table_groups`` instead, which buckets trees by
+    their own (leaves, depth) so a shallow tree never pays the
+    deepest tree's padding."""
+    return _pack_tables(_enumerate_paths(flat, cover))
+
+
+# leaves per VIRTUAL tree in the serving groups: one scan step's
+# working set is [_VLEAVES, D, chunk_rows] — 32 keeps it cache-resident
+# at the default 16k-row chunk (measured optimum on the CPU mesh)
+_VLEAVES = 32
+
+
+def build_shap_table_groups(flat, cover: np.ndarray
+                            ) -> list[ShapTables]:
+    """Bucketed table bundles for the serving kernel. TreeSHAP is
+    additive over LEAVES (each leaf contributes its per-slot terms
+    plus its v·P share of the bias), so tree identity is irrelevant to
+    the sum: all leaves of the ensemble pool together, bucket by their
+    OWN merged path depth D, and pack into virtual trees of _VLEAVES
+    leaves each. The kernel's work is O(rows · leaves · D), so this
+    makes the total exactly Σ_leaf D_leaf — no leaf ever pays the
+    deepest path's padding (a global pad costs ~30% extra on the
+    bench ensemble: early trees saturate depth while shrinkage-era
+    leaves stay shallow). Group order is deterministic (ascending D),
+    so the cross-group f32 sum order is fixed and evict→promote stays
+    bitwise."""
+    per_tree = _enumerate_paths(flat, cover)
+    buckets: dict[int, list] = {}
+    for leaves in per_tree:
+        for leaf in leaves:
+            D_l = max(len(leaf[0]), 1)
+            buckets.setdefault(D_l, []).append(leaf)
+    groups = []
+    for D_l in sorted(buckets):
+        leaves = buckets[D_l]
+        Lv = 1
+        while Lv < min(len(leaves), _VLEAVES):
+            Lv *= 2
+        groups.append(_pack_tables(
+            [leaves[i:i + Lv] for i in range(0, len(leaves), Lv)]))
+    return groups
+
+
+def _one_fractions(XT, feat, lo, hi, na_ok):
+    """[L, D, rows] bool hot indicators from the interval tables —
+    shared by both kernels. ``XT`` is the TRANSPOSED [F, rows]
+    canonicalized feature matrix: with rows as the minor axis, the
+    per-slot feature gather is a contiguous column slice and every
+    later op is rows-contiguous — the layout is what makes the kernel
+    stream at memory bandwidth on XLA:CPU instead of scalar-gathering
+    a [rows, L, D] cube. The sentinel encoding needs NO bound-side
+    isnan: `x >= NaN` is False for every x (so `~(x >= hi)` with the
+    NaN no-bound sentinel is unconditionally True, +inf rows
+    included), and a NaN feature value fails both comparisons, so the
+    NA branch is a plain OR."""
+    x = XT[jnp.maximum(feat, 0)]                      # [L, D, rows]
+    hot = (x >= lo[..., None]) & ~(x >= hi[..., None])
+    return (jnp.isnan(x) & na_ok[..., None]) | hot
+
+
+@jax.jit
+def flat_shap(tables: ShapTables, X, enum_mask):
+    """[rows, F+1] path-dependent TreeSHAP contributions on RAW
+    features (last column = bias term, the sum of per-tree expected
+    values — the caller scales and adds init_score).
+
+    Per tree (ordered lax.scan, so f32 accumulation is deterministic
+    and bitwise-reproducible across evict→promote): one_fractions are
+    evaluated for every (row, leaf, slot) from the interval tables,
+    the EXTEND recurrence runs once per (row, leaf) over the D padded
+    slots, and each slot's UNWIND-sum uses the binary-one_fraction
+    simplification (o ∈ {0,1} ⇒ the nonzero branch's divisor is 1).
+    Numerically equivalent to ``ensemble_shap`` (the f64 host
+    recursion) to float32 tolerance — pinned by tests/test_contrib.py
+    and the kernel gate's ``shap_parity`` check."""
+    # negative enum codes are NA — same canonicalization as flat_margin
+    Xc = jnp.where(enum_mask[None, :] & (X < 0), jnp.float32(jnp.nan), X)
+    XT = Xc.T                                         # [F, rows]
+    F = X.shape[1]
+    D = tables.feat.shape[2]
+
+    def one_tree(phi, tb):                            # phi [F+1, rows]
+        feat, lo, hi, na_ok, z, leaf_val, bias = tb
+        ob = _one_fractions(XT, feat, lo, hi, na_ok).astype(
+            jnp.float32)                              # [L, D, rows]
+        Lv, rows = ob.shape[0], ob.shape[2]
+        # per-slot [L, rows] one_fractions x [L, 1] zero-fractions
+        # through THE shared weight recurrence (_weight_sums), then
+        # scatter leaf_val·(o-z)·Σ to each slot's feature column.
+        # Padding slots contribute exactly 0 ((o - z) = 0) and scatter
+        # into the bias column harmlessly.
+        o = [ob[:, j, :] for j in range(D)]
+        zb = [z[:, j, None] for j in range(D)]
+        totals = _weight_sums(jnp, o, zb,
+                              jnp.ones((Lv, rows), dtype=jnp.float32))
+        contrib = jnp.stack(
+            [leaf_val[:, None] * (o[i] - zb[i]) * totals[i]
+             for i in range(D)], axis=1)              # [L, D, rows]
+        tgt = jnp.where(feat < 0, F, feat)            # [L, D]
+        # rows-minor scatter: 160 contiguous [rows] vector adds
+        phi = phi.at[tgt].add(contrib)
+        phi = phi.at[F].add(bias)
+        return phi, None
+
+    init = jnp.zeros((F + 1, X.shape[0]), dtype=jnp.float32)
+    phi, _ = lax.scan(one_tree, init, tables)
+    return phi.T
+
+
+# total pattern-table budget PER MODEL, across all depth groups: a
+# group that would push the model past it runs the DP kernel instead
+# (deep trees: a table is T·L·2^D·D floats — depth-5 GBMs are ~400KB
+# total, a depth-12 DRF would be GBs). Callers thread the remaining
+# budget through `pattern_table(budget=)` (models/base._contrib_prepare)
+_PATTERN_TABLE_MAX_BYTES = 64 << 20
+
+
+def _weight_sums(xp, o, z, w0) -> list:
+    """EXTEND + per-slot UNWIND-sum Shapley weight recurrence over a
+    padded path — THE one implementation, shared by the f32 device DP
+    kernel (``flat_shap``, xp=jnp) and the f64 host pattern-table
+    builder (``pattern_table``, xp=np) so the fast path can never
+    drift from the fallback. ``o``/``z`` are length-D sequences of
+    per-slot arrays broadcastable against the all-ones ``w0`` (which
+    fixes the working shape and dtype); the path starts as [bias
+    entry] (w = [w0]), step j extends at pre-extend length j+1
+    (matching the host recursion's (i+1)/(L+1), (L-i)/(L+1) factors),
+    and each slot's unwound sum uses the binary-one_fraction
+    simplification (o ∈ {0,1} ⇒ the nonzero branch's divisor is 1).
+    Returns the per-slot weight sums; callers apply
+    leaf_val · (o_i − z_i)."""
+    D = len(o)
+    w = [w0]
+    for j in range(D):
+        Ln = j + 1
+        oj, zj = o[j], z[j]
+        nxt = []
+        for i in range(j + 2):
+            v = None
+            if i <= j:
+                v = zj * w[i] * ((Ln - i) / (Ln + 1))
+            if i >= 1:
+                up = oj * w[i - 1] * (i / (Ln + 1))
+                v = up if v is None else v + up
+            nxt.append(v)
+        w = nxt
+    totals = []
+    for i in range(D):
+        oi, zi = o[i], z[i]
+        nonzero = oi != 0
+        zi_safe = xp.where(zi == 0, 1e-12, zi)
+        n = w[D]
+        total = xp.zeros_like(w0)
+        for jj in range(D - 1, -1, -1):
+            tmp = n * ((D + 1) / (jj + 1))
+            n = w[jj] - tmp * zi * ((D - jj) / (D + 1))
+            w_z = w[jj] * ((D + 1) / (D - jj)) / zi_safe
+            total = total + xp.where(nonzero, tmp, w_z)
+        totals.append(total)
+    return totals
+
+
+def pattern_table(tables: ShapTables,
+                  budget: "int | None" = None) -> "np.ndarray | None":
+    """[T, L, D, 2^D] float32 precomputed per-slot contributions
+    ``leaf_val · (o_i − z_i) · G_i(pattern)`` for EVERY possible hot
+    pattern of a leaf's D slots — the key throughput lever of the
+    serving kernel: one_fractions are binary, so a (row, leaf)'s whole
+    Shapley weight computation collapses to a D-bit pattern index and
+    a table gather. Built host-side in float64 (row-independent — the
+    same extend/unwind DP as the kernel, batched over [L, 2^D]), so
+    the fast path is slightly MORE precise than the in-kernel f32 DP.
+    Returns None when the table would exceed ``budget`` (default
+    _PATTERN_TABLE_MAX_BYTES; deep groups keep the direct DP
+    kernel)."""
+    feat = np.asarray(tables.feat)
+    T, L, D = feat.shape
+    P = 1 << D
+    if budget is None:
+        budget = _PATTERN_TABLE_MAX_BYTES
+    # D > 14 would overflow the kernel's int16 pattern accumulator
+    # (and its table would be enormous anyway) — DP kernel instead
+    if D > 14 or T * L * P * D * 4 > budget:
+        return None
+    z64 = np.asarray(tables.zfrac).astype(np.float64)
+    val64 = np.asarray(tables.leaf_val).astype(np.float64)
+    pats = np.arange(P)
+    obits = ((pats[:, None] >> np.arange(D)[None, :]) & 1).astype(
+        np.float64)                                   # [P, D]
+    out = np.zeros((T, L, D, P), dtype=np.float32)
+    for t in range(T):
+        # [L, 1] zero-fractions x [1, P] hot bits -> [L, P] work shape
+        o = [obits[:, i][None, :] for i in range(D)]
+        zb = [z64[t][:, i][:, None] for i in range(D)]
+        totals = _weight_sums(np, o, zb, np.ones((L, P)))
+        for i in range(D):
+            out[t, :, i, :] = (val64[t][:, None] * (o[i] - zb[i])
+                               * totals[i]).astype(np.float32)
+    return out
+
+
+@jax.jit
+def flat_shap_tab(tables: ShapTables, ctab, X, enum_mask):
+    """The pattern-table fast path of ``flat_shap`` (same contract,
+    same [rows, F+1] output): per (row, leaf) the kernel computes only
+    the D hot bits, folds them into a pattern index, and gathers the
+    precomputed per-slot contributions — O(rows·leaves·depth) simple
+    rows-contiguous ops instead of the O(depth²) weight DP per
+    element, with the scatter reduced to per-slot [rows] vector adds
+    in the transposed accumulator."""
+    Xc = jnp.where(enum_mask[None, :] & (X < 0), jnp.float32(jnp.nan), X)
+    XT = Xc.T                                           # [F, rows]
+    F = X.shape[1]
+    D = tables.feat.shape[2]
+    # int16 MAC: 2x the SIMD width of int32, and the pattern-table
+    # gate caps D well under 15 bits
+    pow2 = jnp.asarray([1 << i for i in range(D)], dtype=jnp.int16)
+
+    def one_tree(phi, tb):                              # phi [F+1, rows]
+        (feat, lo, hi, na_ok, _z, _lv, bias), ct = tb   # ct [L, D, P]
+        o = _one_fractions(XT, feat, lo, hi, na_ok)     # [L, D, rows]
+        pat = jnp.sum(o.astype(jnp.int16) * pow2[None, :, None],
+                      axis=1).astype(jnp.int32)         # [L, rows]
+        contrib = jnp.take_along_axis(
+            ct, pat[:, None, :], axis=2)                # [L, D, rows]
+        tgt = jnp.where(feat < 0, F, feat)              # [L, D]
+        phi = phi.at[tgt].add(contrib)
+        phi = phi.at[F].add(bias)
+        return phi, None
+
+    init = jnp.zeros((F + 1, X.shape[0]), dtype=jnp.float32)
+    phi, _ = lax.scan(one_tree, init, (tables, ctab))
+    return phi.T

@@ -91,6 +91,20 @@ def export_mojo(model, path) -> str:
         flat = model._flat()
         for f in ("split_feat", "thresh", "left", "na_left", "value"):
             arrays[f"flat_{f}"] = _np(getattr(flat, f))
+        # OPTIONAL cover part (still format 2 — extra npz keys are
+        # invisible to older readers): per-flat-node training weight
+        # mass, slot-aligned with the arrays above, which is all a
+        # scorer replica needs to serve predict_contributions
+        # (TreeSHAP path tables). Omitted when the source model
+        # predates per-node cover (persist.py NaN-backfill sentinel) —
+        # such artifacts keep serving margins and reject contributions
+        # with a re-export message.
+        cov = getattr(model.trees, "cover", None)
+        if cov is not None and not np.isnan(_np(cov)).any():
+            from .models.tree.core import flatten_cover
+
+            arrays["flat_cover"] = flatten_cover(
+                model.trees, model.params.max_depth)
     elif algo == "glm":
         from .models.glm import _famspec
 

@@ -62,10 +62,15 @@ class FlatTreeScorer(Model):
         # _flat_trees assuming a lazy rebuild from heap trees, which
         # a registry scorer does not have — see __getstate__ below.
         self._artifact_meta = dict(meta)
-        self._artifact_arrays = {
-            k: np.asarray(arrays[k]) for k in
-            ("init_score", "enum_mask", "flat_split_feat",
-             "flat_thresh", "flat_left", "flat_na_left", "flat_value")}
+        keep = ["init_score", "enum_mask", "flat_split_feat",
+                "flat_thresh", "flat_left", "flat_na_left",
+                "flat_value"]
+        if "flat_cover" in arrays:
+            # optional MOJO-v2 cover part: enables serving
+            # predict_contributions (TreeSHAP path tables); artifacts
+            # without it still serve margins
+            keep.append("flat_cover")
+        self._artifact_arrays = {k: np.asarray(arrays[k]) for k in keep}
         arrays = self._artifact_arrays
         self.algo = meta["algo"]
         self.feature_names = list(meta["feature_names"])
@@ -116,6 +121,51 @@ class FlatTreeScorer(Model):
     def _serving_evict(self) -> None:
         super()._serving_evict()
         self.__dict__.pop("_enum_mask", None)
+
+    # -- compiled TreeSHAP serving -------------------------------------------
+
+    def contrib_support(self) -> "str | None":
+        """Mirror of GBMModel.contrib_support for a registry scorer:
+        same precondition set, with the cover check against the
+        artifact's optional ``flat_cover`` part."""
+        if int(self.nclasses) > 2:
+            return ("predict_contributions supports binomial "
+                    "and regression models only")
+        if self.offset_column:
+            return ("predict_contributions is not supported "
+                    "for models trained with an offset")
+        if "flat_cover" not in self._artifact_arrays:
+            return (
+                "this artifact was exported without per-node cover "
+                "(pre-cover build, or a source model trained before "
+                "per-node cover existed); TreeSHAP needs it — "
+                "re-export the model with this build")
+        return None
+
+    def _shap_sources(self):
+        """(flat arrays, cover) straight from the kept artifact parts
+        — identical numpy values to the training-side model's, so the
+        base _contrib_prepare/_contrib_matrix produce the same device
+        constants, the same HLO, and bitwise-identical contributions
+        (pinned by tests/test_contrib.py)."""
+        from ..models.tree.core import FlatTrees
+
+        a = self._artifact_arrays
+        flat = FlatTrees(
+            *(np.asarray(a[f"flat_{f}"])
+              for f in ("split_feat", "thresh", "left", "na_left",
+                        "value")))
+        return flat, np.asarray(a["flat_cover"])
+
+    def _contrib_enum_mask(self):
+        _, em = self._serving_prepare()
+        return em
+
+    def _contrib_scale_init(self) -> tuple[float, float]:
+        scale = float(self.margin_scale)
+        if self.drf_mode:
+            scale /= self.ntrees
+        return scale, float(np.asarray(self.init_score).ravel()[0])
 
     def export_artifact(self) -> bytes:
         """Re-serialize this scorer as a MOJO-v2 zip from its kept

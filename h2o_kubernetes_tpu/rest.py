@@ -125,6 +125,11 @@ SLO_CLASSES: dict[str, dict] = {
                  "queue_share": 0.5},
     # throughput traffic: dispatched last, may fill the whole queue
     "batch": {"priority": 2, "deadline_ms": None, "queue_share": 1.0},
+    # explainability traffic (the contributions route's own class):
+    # TreeSHAP is O(leaves·depth) heavier per row than scoring, so it
+    # dispatches behind latency traffic and one model's explain flood
+    # may hold at most half the queue
+    "explain": {"priority": 2, "deadline_ms": None, "queue_share": 0.5},
 }
 
 # model_key -> per-tenant serving counters, scraped via GET /3/Stats
@@ -164,7 +169,8 @@ def _model_stats(key: str, slo: str | None = None) -> dict:
     if rec is None:
         rec = {"slo": slo or _default_slo(), "requests": 0, "shed": 0,
                "deadline_504": 0, "breaker_rejects": 0, "batches": 0,
-               "rows": 0, "rate_limited": 0}
+               "rows": 0, "rate_limited": 0, "contrib_requests": 0,
+               "contrib_batches": 0, "contrib_rows": 0}
         MODEL_STATS[key] = rec
     elif slo:
         rec["slo"] = slo
@@ -265,6 +271,21 @@ def _resolve_slo(mkey: str, header_slo: str | None) -> str:
     return _default_slo()
 
 
+def _resolve_contrib_slo(header_slo: str | None) -> str:
+    """Contributions requests get their OWN SLO class by default
+    (`explain` — heavier per row than scoring, never ahead of latency
+    traffic): X-H2O-SLO still wins per request, and
+    H2O_TPU_CONTRIB_SLO_DEFAULT re-tunes the route-level default.
+    The model's scoring registry default deliberately does NOT apply
+    here — an `interactive` scoring tenant must not get interactive
+    priority for its explain flood."""
+    if header_slo:
+        return header_slo
+    raw = (os.environ.get("H2O_TPU_CONTRIB_SLO_DEFAULT")
+           or "explain").lower()
+    return raw if raw in SLO_CLASSES else "explain"
+
+
 def _registry_gate():
     if REQUIRED_MODEL_IDS:
         missing = sorted(REQUIRED_MODEL_IDS - set(REGISTRY_MODELS))
@@ -332,15 +353,15 @@ def _ready_state(ignore_cordon: bool = False) -> tuple[bool, list, dict]:
 # TimeoutError (503) instead of hanging the client.
 
 
-def _score_row_cap() -> int:
-    """H2O_TPU_SCORE_MAX_ROWS as a usable int cap.  <= 0 or inf reads
-    as UNCAPPED (the 0-disables convention of the other H2O_TPU
+def _row_cap(env: str) -> int:
+    """A H2O_TPU_*_MAX_ROWS knob as a usable int cap. <= 0 or inf
+    reads as UNCAPPED (the 0-disables convention of the other H2O_TPU
     knobs) — and never raises, whatever the env holds: this runs on
     the dispatcher thread, where an OverflowError would kill the
     batcher with waiters still queued."""
     import math
 
-    v = _env_float("H2O_TPU_SCORE_MAX_ROWS", 100_000.0)
+    v = _env_float(env, 100_000.0)
     if not math.isfinite(v) or v <= 0:
         import sys
 
@@ -348,11 +369,24 @@ def _score_row_cap() -> int:
     return max(1, int(v))
 
 
+def _score_row_cap() -> int:
+    return _row_cap("H2O_TPU_SCORE_MAX_ROWS")
+
+
+def _contrib_row_cap() -> int:
+    """H2O_TPU_CONTRIB_MAX_ROWS (default 100k) — the contributions
+    route's own per-request row cap (413 past it): a contributions
+    response is [rows, F+1] floats, and one oversized TreeSHAP
+    dispatch must no more lock the cloud than an oversized score."""
+    return _row_cap("H2O_TPU_CONTRIB_MAX_ROWS")
+
+
 class _ScoreJob:
     __slots__ = ("model", "X", "offset", "event", "out", "err",
-                 "deadline", "key", "slo")
+                 "deadline", "key", "slo", "kind")
 
-    def __init__(self, model, X, offset, key=None, slo=None):
+    def __init__(self, model, X, offset, key=None, slo=None,
+                 kind="score"):
         self.model = model
         self.X = X
         self.offset = offset
@@ -362,6 +396,7 @@ class _ScoreJob:
         self.deadline = float("inf")
         self.key = key          # model key (per-tenant accounting)
         self.slo = slo          # SLO class name (fairness + priority)
+        self.kind = kind        # "score" | "contrib" (dispatch target)
 
 
 class ScoreBatcher:
@@ -405,7 +440,8 @@ class ScoreBatcher:
                timeout: float | None = None,
                deadline: float | None = None,
                model_key: str | None = None,
-               slo: str | None = None) -> np.ndarray:
+               slo: str | None = None,
+               kind: str = "score") -> np.ndarray:
         """Enqueue one scoring request; blocks until its slice of the
         batched result (or raises: health/breaker/drain fail-fast,
         queue-full load shed, timeout).
@@ -449,7 +485,8 @@ class ScoreBatcher:
             deadline = time.monotonic() + cls["deadline_ms"] / 1000.0
         if timeout is None:
             timeout = _env_float("H2O_TPU_SCORE_TIMEOUT", 60.0)
-        job = _ScoreJob(model, X, offset, key=model_key, slo=slo)
+        job = _ScoreJob(model, X, offset, key=model_key, slo=slo,
+                        kind=kind)
         # the dispatcher drops jobs whose waiter has already timed out
         # (503'd and gone) instead of burning device time on them
         job.deadline = time.monotonic() + timeout
@@ -507,7 +544,10 @@ class ScoreBatcher:
             self._ensure_thread()
             self._pending.append(job)
             self.stats["requests"] += 1
-            _bump_model_stat(model_key, "requests", slo=slo)
+            _bump_model_stat(
+                model_key,
+                "contrib_requests" if kind == "contrib" else "requests",
+                slo=slo)
             self._cond.notify_all()
         # admitted: account serving-while-not-capable. The full
         # _ready_state() would add several lock acquisitions per
@@ -622,8 +662,11 @@ class ScoreBatcher:
                 live.append(job)
         groups: dict[tuple, list[_ScoreJob]] = {}
         for job in live:
+            # kind in the key: score and contrib dispatches run
+            # different programs and must never concatenate
             groups.setdefault(
-                (id(job.model), job.offset is not None), []).append(job)
+                (id(job.model), job.offset is not None, job.kind),
+                []).append(job)
         ordered = list(groups.values())
         if _fairness_on() and len(ordered) > 1:
             # SLO-priority dispatch order, smallest group first within
@@ -659,23 +702,33 @@ class ScoreBatcher:
                     f"{health.health_status()['error']} — queued "
                     "scoring request dropped (fail-fast)")
             model = jobs[0].model
+            contrib = jobs[0].kind == "contrib"
             self.stats["batches"] += 1
             self.stats["max_batch_requests"] = max(
                 self.stats["max_batch_requests"], len(jobs))
             if jobs[0].key is not None:
-                _bump_model_stat(jobs[0].key, "batches")
-                _bump_model_stat(jobs[0].key, "rows",
+                _bump_model_stat(jobs[0].key,
+                                 "contrib_batches" if contrib
+                                 else "batches")
+                _bump_model_stat(jobs[0].key,
+                                 "contrib_rows" if contrib else "rows",
                                  sum(j.X.shape[0] for j in jobs))
+
+            def dispatch(X, offset=None):
+                if contrib:
+                    return model.contrib_numpy(X)
+                return model.score_numpy(X, offset=offset)
+
             if len(jobs) == 1:
-                jobs[0].out = model.score_numpy(
-                    jobs[0].X, offset=jobs[0].offset)
+                jobs[0].out = dispatch(jobs[0].X,
+                                       offset=jobs[0].offset)
             else:
                 X = np.concatenate([j.X for j in jobs])
                 off = None
                 if jobs[0].offset is not None:
                     off = np.concatenate([j.offset for j in jobs])
                 self.stats["batched_rows"] += X.shape[0]
-                out = model.score_numpy(X, offset=off)
+                out = dispatch(X, offset=off)
                 lo = 0
                 for j in jobs:
                     hi = lo + j.X.shape[0]
@@ -1048,6 +1101,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "algo": info.get("algo"),
                         "slo": info.get("slo"),
                         "warmed_buckets": info.get("warmed_buckets"),
+                        "contributions": info.get("contributions"),
                         "warm_cache_misses": wcm,
                     }
                 with _STATS_LOCK:
@@ -1320,6 +1374,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._build_model(algo, params)
             if path.startswith("/3/Predictions/models/"):
                 rest = path[len("/3/Predictions/models/"):]
+                if rest.endswith("/contributions") and \
+                        "/frames/" not in rest:
+                    # explainable serving: per-row TreeSHAP through
+                    # the micro-batcher, under its own SLO class
+                    mkey = urllib.parse.unquote(
+                        rest[: -len("/contributions")])
+                    if mkey not in MODELS:
+                        return self._error(404,
+                                           f"model '{mkey}' not found")
+                    return self._contrib_rows(MODELS[mkey], mkey,
+                                              params, deadline=deadline,
+                                              slo=slo)
                 mkey, sep, fpart = rest.partition("/frames/")
                 mkey = urllib.parse.unquote(mkey)
                 fpart = urllib.parse.unquote(fpart)
@@ -1459,8 +1525,14 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._error(400, f"unservable artifact: {e}")
         buckets = params.get("warm_buckets")
+        # contributions ride the same warm-up contract: when the
+        # artifact supports TreeSHAP (has the cover part, binomial/
+        # regression, no offset), its contrib executables pre-trace
+        # here too, so the FIRST explain request after readyz is also
+        # zero-compile (warm_cache_misses == 0 covers both programs)
+        warm_contrib = model.contrib_support() is None
         try:
-            warmed = model.warm_up(buckets)
+            warmed = model.warm_up(buckets, contributions=warm_contrib)
         except ValueError as e:
             return self._error(400, str(e))
         MODELS[model_id] = model
@@ -1471,6 +1543,7 @@ class _Handler(BaseHTTPRequestHandler):
             "algo": model.algo,
             "slo": slo,
             "warmed_buckets": warmed,
+            "contributions": warm_contrib,
             # per-MODEL baseline: traces paid so far that were not
             # promotions — /3/Stats diffs against this, so eviction
             # re-traces (promotions) can never read as warm misses
@@ -1484,7 +1557,8 @@ class _Handler(BaseHTTPRequestHandler):
                            "version": params.get("version"),
                            "algo": model.algo,
                            "slo": slo,
-                           "warmed_buckets": warmed})
+                           "warmed_buckets": warmed,
+                           "contributions": warm_contrib})
 
     def _score_rows(self, model, mkey: str, params: dict,
                     deadline: float | None = None,
@@ -1550,6 +1624,50 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 resp["predict"] = [float(v) for v in out]
         return self._json(resp)
+
+    def _contrib_rows(self, model, mkey: str, params: dict,
+                      deadline: float | None = None,
+                      slo: str | None = None):
+        """POST /3/Predictions/models/{key}/contributions — per-row
+        TreeSHAP contributions over the serving stack: JSON rows in,
+        one [rows, F+1] device TreeSHAP dispatch (coalesced by the
+        micro-batcher under the `explain` SLO class) out.
+
+        Error hygiene contract: every precondition failure —
+        multinomial, offset-trained, a pre-cover / NaN-cover model or
+        artifact — surfaces as a clean 400 carrying the model's own
+        retrain/re-export message, never a 500 traceback."""
+        support = getattr(model, "contrib_support", None)
+        reason = support() if callable(support) else (
+            f"model '{mkey}' ({getattr(model, 'algo', '?')}) does not "
+            "support predict_contributions")
+        if reason:
+            return self._error(
+                400, f"contributions unavailable for model '{mkey}': "
+                f"{reason}")
+        rows = params.get("rows")
+        if rows is None:
+            return self._error(400, "missing 'rows' (JSON list of "
+                               "row dicts, or lists + 'columns')")
+        max_rows = _contrib_row_cap()
+        if isinstance(rows, list) and len(rows) > max_rows:
+            return self._error(
+                413, f"{len(rows)} rows exceeds the per-request limit "
+                f"of {max_rows} (H2O_TPU_CONTRIB_MAX_ROWS); split the "
+                "batch")
+        try:
+            X = _rows_to_matrix(model, rows, params.get("columns"))
+        except (ValueError, TypeError, KeyError, IndexError) as e:
+            return self._error(400, f"bad contributions payload: {e!r}")
+        out = BATCHER.submit(model, X, deadline=deadline,
+                             model_key=mkey,
+                             slo=_resolve_contrib_slo(slo),
+                             kind="contrib")
+        cols = list(model.feature_names) + ["BiasTerm"]
+        return self._json({
+            "model_id": {"name": mkey}, "rows": len(rows),
+            "columns": cols,
+            "contributions": [[float(v) for v in row] for row in out]})
 
     def _run_job(self, job, fn, sync_timeout: float):
         """Run fn on a worker thread under `job`, waiting up to

@@ -1,0 +1,371 @@
+"""Compiled TreeSHAP serving (ISSUE 10 tentpole): the device
+path-enumeration kernel must match the f64 host recursion on the rich
+fixtures (NAs, grouped high-card enums, weights, DRF scaling, laplace
+margin_scale), hold the on-device additivity invariant, survive
+evict→promote bitwise, serve from registry artifacts bitwise vs the
+training-side model, expose itself on the XGBoost estimator surface,
+and turn every precondition failure into a clean 400 on the REST
+contributions route."""
+
+import io
+import json
+import socket
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu import rest
+from h2o_kubernetes_tpu.models import DRF, GBM, XGBoost
+from h2o_kubernetes_tpu.models.base import (evict_scorer_cache,
+                                            model_scorer_counters)
+
+
+def _rich_frame(n=500, seed=7, nlevels=60):
+    """Numeric-with-NA + low-card enum + HIGH-card enum (grouped code
+    ranges at nbins=64) + weights + binary response — the flat-scorer
+    parity matrix, minus offset (contributions reject it)."""
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n).astype(np.float32)
+    x0[::17] = np.nan
+    x1 = rng.exponential(2.0, size=n).astype(np.float32)
+    g = np.array([f"L{i}" for i in range(nlevels)])[
+        rng.integers(0, nlevels, n)]
+    c = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    w = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    y = np.where(np.nan_to_num(x0) + (c == "a")
+                 + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays(
+        {"x0": x0, "x1": x1, "g": g, "c": c, "w": w, "y": y})
+
+
+def _host_phi(m, fr) -> np.ndarray:
+    contrib = m.predict_contributions(fr)
+    return np.stack([contrib.vec(c).to_numpy() for c in contrib.names],
+                    axis=1)
+
+
+def _X(m, fr) -> np.ndarray:
+    return np.asarray(m._design_matrix(fr))[: fr.nrows]
+
+
+def _assert_device_contract(m, fr, tol=1e-4):
+    """Device-vs-host parity + on-device additivity, the tentpole's
+    two numerical assertions."""
+    import jax.numpy as jnp
+
+    X = _X(m, fr)
+    dev = m.contrib_numpy(X)
+    host = _host_phi(m, fr)
+    assert dev.shape == host.shape
+    np.testing.assert_allclose(dev, host, rtol=tol, atol=tol)
+    margins = np.asarray(m._margins(jnp.asarray(X)))[: fr.nrows]
+    np.testing.assert_allclose(dev.sum(axis=1), margins,
+                               rtol=tol, atol=tol)
+    return dev
+
+
+def test_device_matches_host_rich_binomial(mesh8):
+    fr = _rich_frame()
+    m = GBM(ntrees=8, max_depth=4, nbins=64, seed=1).train(
+        y="y", training_frame=fr, weights_column="w")
+    _assert_device_contract(m, fr)
+
+
+def test_device_matches_host_drf_scale(mesh8):
+    fr = _rich_frame(n=400, seed=11)
+    m = DRF(ntrees=5, max_depth=3, seed=5).train(
+        y="y", training_frame=fr)
+    _assert_device_contract(m, fr)
+
+
+def test_device_matches_host_laplace_margin_scale(mesh8):
+    rng = np.random.default_rng(3)
+    n = 400
+    x = rng.normal(size=n).astype(np.float32)
+    x[::11] = np.nan
+    y = (2.0 * np.nan_to_num(x)
+         + rng.normal(scale=0.3, size=n)).astype(np.float32)
+    fr = h2o.Frame.from_arrays({"x": x, "y": y})
+    m = GBM(ntrees=5, max_depth=3, distribution="laplace",
+            seed=2).train(y="y", training_frame=fr)
+    assert m.margin_scale != 1.0       # the scaled path, not a no-op
+    _assert_device_contract(m, fr)
+
+
+def test_dp_fallback_kernel_matches_host(mesh8, monkeypatch):
+    """The direct extend/unwind DP kernel (flat_shap) serves ensembles
+    too deep for a pattern table — every other test/gate/bench model
+    is shallow enough to take flat_shap_tab, so pin the fallback
+    explicitly by forcing the pattern-table gate shut."""
+    from h2o_kubernetes_tpu.models.tree import shap as S
+
+    monkeypatch.setattr(S, "_PATTERN_TABLE_MAX_BYTES", 0)
+    fr = _rich_frame(n=400, seed=41)
+    m = GBM(ntrees=5, max_depth=4, nbins=64, seed=2).train(
+        y="y", training_frame=fr, weights_column="w")
+    dev = _assert_device_contract(m, fr)
+    assert all(c is None for c in m._shap_ctab_np)   # DP path ran
+    # and the two kernels agree with each other: rebuild with the
+    # pattern tables enabled on a fresh prepare
+    monkeypatch.setattr(S, "_PATTERN_TABLE_MAX_BYTES", 64 << 20)
+    evict_scorer_cache(m)
+    for k in ("_shap_tables_np", "_shap_ctab_np"):
+        m.__dict__.pop(k, None)
+    dev_tab = m.contrib_numpy(_X(m, fr))
+    assert any(c is not None for c in m._shap_ctab_np)
+    np.testing.assert_allclose(dev_tab, dev, rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_evict_promote_bitwise(mesh8):
+    fr = _rich_frame(n=300, seed=19)
+    m = GBM(ntrees=4, max_depth=3, nbins=64, seed=3).train(
+        y="y", training_frame=fr)
+    X = _X(m, fr)
+    phi1 = m.contrib_numpy(X)
+    ctr0 = model_scorer_counters(m)
+    evict_scorer_cache(m)
+    # eviction drops the DEVICE tables + executables; host tables stay
+    assert "_shap_tables" not in m.__dict__
+    assert "_shap_tables_np" in m.__dict__
+    phi2 = m.contrib_numpy(X)
+    assert np.array_equal(phi1, phi2)
+    ctr1 = model_scorer_counters(m)
+    assert ctr1["promotions"] > ctr0["promotions"]
+
+
+def test_contrib_warm_up_then_zero_misses(mesh8):
+    fr = _rich_frame(n=300, seed=23)
+    m = GBM(ntrees=3, max_depth=3, nbins=64, seed=3).train(
+        y="y", training_frame=fr)
+    X = _X(m, fr)
+    m.warm_up([256], contributions=True)
+    c0 = model_scorer_counters(m)
+    m.contrib_numpy(X[:50])
+    m.contrib_numpy(X[:200])
+    m.score_numpy(X[:200])
+    c1 = model_scorer_counters(m)
+    assert c1["misses"] == c0["misses"]      # both programs warm
+
+
+def test_xgboost_contrib_parity_on_identical_trees(mesh8):
+    """XGBoost shares the GBM tree stack: with the regularization
+    knobs aligned the two estimators grow IDENTICAL trees, and their
+    contributions must agree exactly."""
+    rng = np.random.default_rng(9)
+    n = 400
+    x0 = rng.normal(size=n).astype(np.float32)
+    x1 = rng.normal(size=n).astype(np.float32)
+    y = (x0 - 0.5 * x1 + rng.normal(scale=0.3, size=n)).astype(
+        np.float32)
+    fr = h2o.Frame.from_arrays({"x0": x0, "x1": x1, "y": y})
+    mg = GBM(ntrees=4, max_depth=3, learn_rate=0.3, min_rows=1.0,
+             min_split_improvement=0.0, seed=2).train(
+        y="y", training_frame=fr)
+    mx = XGBoost(ntrees=4, max_depth=3, eta=0.3, reg_lambda=0.0,
+                 gamma=0.0, min_child_weight=0.0, seed=2).train(
+        y="y", training_frame=fr)
+    for f in ("split_feat", "split_bin", "na_left", "is_split",
+              "value", "cover"):
+        assert np.array_equal(np.asarray(getattr(mg.trees, f)),
+                              np.asarray(getattr(mx.trees, f))), f
+    hg, hx = _host_phi(mg, fr), _host_phi(mx, fr)
+    assert np.array_equal(hg, hx)
+    # the serving kernel agrees on the xgboost surface too
+    _assert_device_contract(mx, fr)
+
+
+def test_registry_scorer_contrib_bitwise_and_coverless_reject(mesh8):
+    from h2o_kubernetes_tpu.mojo import export_mojo
+    from h2o_kubernetes_tpu.operator.registry import (FlatTreeScorer,
+                                                      load_artifact)
+
+    fr = _rich_frame(n=300, seed=29)
+    m = GBM(ntrees=4, max_depth=3, nbins=64, seed=5).train(
+        y="y", training_frame=fr)
+    X = _X(m, fr)
+    want = m.contrib_numpy(X)
+    buf = io.BytesIO()
+    export_mojo(m, buf)
+    fts = load_artifact(buf.getvalue())
+    assert fts.contrib_support() is None
+    got = fts.contrib_numpy(X)
+    # registry-pushed artifact serves contributions BITWISE-identical
+    # to the training-side model (same tables -> same HLO)
+    assert np.array_equal(got, want)
+    # an artifact without the cover part keeps serving margins but
+    # rejects contributions with the re-export message
+    arrays = {k: v for k, v in fts._artifact_arrays.items()
+              if k != "flat_cover"}
+    bare = FlatTreeScorer(fts._artifact_meta, arrays)
+    reason = bare.contrib_support()
+    assert reason is not None and "re-export" in reason
+    with pytest.raises(ValueError, match="re-export"):
+        bare.contrib_numpy(X)
+    assert bare.score_numpy(X).shape[0] == X.shape[0]
+
+
+def test_pre_cover_model_rejected_everywhere(mesh8):
+    """The persist.py NaN-cover sentinel (pre-cover pickles) must
+    reject through BOTH the host accessor and the serving entry with
+    the retrain message — and never through a traceback."""
+    fr = _rich_frame(n=300, seed=31)
+    m = GBM(ntrees=3, max_depth=2, nbins=64, seed=1).train(
+        y="y", training_frame=fr)
+    m.trees = m.trees._replace(cover=np.full(
+        np.asarray(m.trees.cover).shape, np.nan, np.float32))
+    with pytest.raises(ValueError, match="per-node cover"):
+        m.predict_contributions(fr)
+    with pytest.raises(ValueError, match="per-node cover"):
+        m.contrib_numpy(_X(m, fr))
+
+
+# -- REST contributions route -------------------------------------------------
+
+
+@pytest.fixture
+def server(mesh8):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = rest.start_server(port)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    rest.MODELS.clear()
+    rest.FRAMES.clear()
+
+
+def _post_json(base, route, payload):
+    req = urllib.request.Request(
+        base + route, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=600) as r:
+        return json.loads(r.read())
+
+
+def _small_binomial(seed=3, n=300):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n).astype(np.float32)
+    y = np.where(x + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays({"x": x, "y": y})
+
+
+def test_rest_contrib_two_request_batcher_smoke(server):
+    """Tier-1 micro-batcher smoke on the contributions route: two
+    concurrent requests coalesce, each gets ITS slice, and the
+    per-model contrib counters land on /3/Stats."""
+    fr = _small_binomial()
+    m = GBM(ntrees=3, max_depth=2, seed=1).train(
+        y="y", training_frame=fr)
+    rest.MODELS["cgbm"] = m
+    s0 = dict(rest.BATCHER.stats)
+    results = [None, None]
+
+    def hit(i):
+        results[i] = _post_json(
+            server, "/3/Predictions/models/cgbm/contributions",
+            {"rows": [{"x": float(i)}, {"x": -float(i)}]})
+
+    ts = [threading.Thread(target=hit, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(r is not None and r["rows"] == 2 for r in results)
+    assert all(r["columns"] == ["x", "BiasTerm"] for r in results)
+    s1 = rest.BATCHER.stats
+    assert s1["requests"] >= s0["requests"] + 2
+    for i, r in enumerate(results):
+        want = m.contrib_numpy(
+            np.array([[float(i)], [-float(i)]], np.float32))
+        np.testing.assert_allclose(
+            np.array(r["contributions"], np.float32), want,
+            rtol=1e-5, atol=1e-6)
+    with urllib.request.urlopen(server + "/3/Stats", timeout=60) as r:
+        stats = json.loads(r.read())
+    rec = stats["models"]["cgbm"]
+    assert rec["contrib_requests"] >= 2
+    assert rec["contrib_rows"] >= 4
+    assert rec["contrib_batches"] >= 1
+
+
+def test_rest_contrib_precondition_400s(server):
+    """Error hygiene: multinomial / offset-trained / NaN-cover models
+    answer the contributions route with a clean 400 + the retrain or
+    re-export message — never a 500 traceback."""
+    rng = np.random.default_rng(2)
+    n = 240
+    x = rng.normal(size=n).astype(np.float32)
+    off = rng.normal(scale=0.1, size=n).astype(np.float32)
+    y3 = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    y2 = np.where(x > 0, "p", "n")
+    fr3 = h2o.Frame.from_arrays({"x": x, "y": y3})
+    fr2 = h2o.Frame.from_arrays({"x": x, "off": off, "y": y2})
+    rest.MODELS["multi"] = GBM(ntrees=2, max_depth=2, seed=0).train(
+        y="y", training_frame=fr3)
+    rest.MODELS["offm"] = GBM(ntrees=2, max_depth=2, seed=0).train(
+        y="y", training_frame=fr2, offset_column="off")
+    nocov = GBM(ntrees=2, max_depth=2, seed=0).train(
+        y="y", training_frame=h2o.Frame.from_arrays(
+            {"x": x, "y": y2}))
+    nocov.trees = nocov.trees._replace(cover=np.full(
+        np.asarray(nocov.trees.cover).shape, np.nan, np.float32))
+    rest.MODELS["nocov"] = nocov
+
+    def expect_400(key, needle, row):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post_json(server,
+                       f"/3/Predictions/models/{key}/contributions",
+                       {"rows": [row]})
+        assert e.value.code == 400, (key, e.value.code)
+        body = e.value.read().decode()
+        assert needle in body, (key, body)
+
+    expect_400("multi", "binomial and regression", {"x": 1.0})
+    expect_400("offm", "trained with an offset",
+               {"x": 1.0, "off": 0.0})
+    expect_400("nocov", "per-node cover", {"x": 1.0})
+    # unknown model stays a 404, malformed payload a 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(server, "/3/Predictions/models/nope/contributions",
+                   {"rows": [{"x": 1.0}]})
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post_json(server,
+                   "/3/Predictions/models/multi/contributions", {})
+    assert e.value.code == 400
+
+
+def test_registry_load_warms_contributions(server):
+    """The operator push route pre-traces the contributions
+    executables too: the first explain request after load adds zero
+    scorer-cache misses (the warm-up contract covers both programs)."""
+    import base64
+
+    from h2o_kubernetes_tpu.mojo import export_mojo
+
+    fr = _small_binomial(seed=5)
+    m = GBM(ntrees=3, max_depth=2, seed=1).train(
+        y="y", training_frame=fr)
+    buf = io.BytesIO()
+    export_mojo(m, buf)
+    out = _post_json(server, "/3/ModelRegistry/load", {
+        "model_id": "ten1",
+        "artifact_b64": base64.b64encode(buf.getvalue()).decode(),
+        "warm_buckets": [128]})
+    assert out["contributions"] is True
+    loaded = rest.MODELS["ten1"]
+    c0 = model_scorer_counters(loaded)
+    r = _post_json(server, "/3/Predictions/models/ten1/contributions",
+                   {"rows": [{"x": 0.5}, {"x": -0.5}]})
+    assert r["rows"] == 2
+    c1 = model_scorer_counters(loaded)
+    assert c1["misses"] == c0["misses"]
+    with urllib.request.urlopen(server + "/3/Stats", timeout=60) as rr:
+        stats = json.loads(rr.read())
+    reg = stats["registry"]["ten1"]
+    assert reg["contributions"] is True
+    assert reg["warm_cache_misses"] == 0

@@ -52,12 +52,21 @@ they never clobber a full-suite artifact.
   histogram width F→Fb, binned-matrix bytes both ways.
   ``BENCH_WS_ROWS`` / ``BENCH_WS_GROUPS`` / ``BENCH_WS_CARD`` size it;
 
+- config #5c ``gbm_shap_rows_per_sec`` — compiled TreeSHAP serving
+  (docs/SERVING.md "Explainable serving"): warm device
+  ``contrib_numpy`` rows/s at a 100k-row serving shape vs the
+  host-numpy ``ensemble_shap`` recursion (measured single-shot at the
+  same shape), with the device additivity check
+  (``sum phi + bias == margin`` to 1e-4), a device-vs-host parity
+  check on the slice, and the warm-repeat recompile check.
+  ``BENCH_SHAP_ROWS`` / ``BENCH_SHAP_HOST_ROWS`` size it;
+
 Every config reports BOTH timings: ``compile_seconds`` (the first
 call — what a cold user pays, XLA compile included) and ``seconds``
 (steady state, compile cached; repeated until ≥1 s of measured work
 or 3 calls on the CPU mesh, single repeat on TPU where trains are
 long and chip windows are ~20 min). One JSON line per config + a
-trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r09.json`` at the
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r10.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -308,6 +317,71 @@ def main() -> int:
                out.pop("compile_seconds"),
                rows_score=out.pop("rows"), ntrees=20, max_depth=5,
                **out)
+
+    if _want("gbm_shap_rows_per_sec"):
+        # config #5c (ISSUE 10): compiled TreeSHAP serving — the
+        # device path-enumeration kernel (models/tree/shap.flat_shap,
+        # dispatched via Model.contrib_numpy through the jitted-scorer
+        # cache) against the host-numpy ensemble_shap recursion it
+        # replaces on the serving path. The host leg is measured on a
+        # SLICE (the recursion is linear in rows — per-node numpy ops
+        # are [rows]-vectorized, so rows/s is shape-stable) and
+        # reported as rows/s; the device leg runs the full serving
+        # shape warm, with the recompile check and the on-device
+        # additivity + host-parity assertions recorded in the row.
+        import jax.numpy as jnp
+
+        from h2o_kubernetes_tpu.models.base import scorer_cache_stats
+        from h2o_kubernetes_tpu.models.tree.binning import apply_bins_jit
+        from h2o_kubernetes_tpu.models.tree.shap import ensemble_shap
+
+        sh_rows = int(os.environ.get("BENCH_SHAP_ROWS", 100_000))
+        fr_sh = _higgs(sh_rows, seed=6)
+        m_sh = GBM(ntrees=20, max_depth=5, learn_rate=0.2,
+                   seed=1).train(y="y", training_frame=fr_sh)
+        X_sh = np.asarray(m_sh._design_matrix(fr_sh))[:sh_rows]
+        phi, dt, calls, cdt = _timed(
+            lambda: m_sh.contrib_numpy(X_sh), on_tpu)
+        # warm-repeat recompile check: one more full-shape call must
+        # add zero scorer-cache misses
+        s0 = scorer_cache_stats()
+        m_sh.contrib_numpy(X_sh)
+        warm_misses = scorer_cache_stats()["misses"] - s0["misses"]
+        # device additivity: sum_f phi + bias == the flat margin
+        margins = np.asarray(
+            m_sh._margins(jnp.asarray(X_sh)))[:sh_rows]
+        add_err = float(np.abs(phi.sum(axis=1) - margins).max())
+        # host-numpy baseline + parity — at the FULL serving shape by
+        # default (single-shot, like the 10M configs: the recursion is
+        # ~10s at 100k rows); BENCH_SHAP_HOST_ROWS shrinks it for
+        # quick captures
+        host_rows = min(sh_rows,
+                        int(os.environ.get("BENCH_SHAP_HOST_ROWS",
+                                           sh_rows)))
+        binned_h = np.asarray(apply_bins_jit(
+            jnp.asarray(X_sh[:host_rows]), m_sh._edges,
+            m_sh._enum_mask, m_sh.bin_spec.na_bin))
+        trees_np = {f: np.asarray(getattr(m_sh.trees, f))
+                    for f in ("split_feat", "split_bin", "na_left",
+                              "is_split", "value", "cover")}
+        t0 = time.perf_counter()
+        phi_h = ensemble_shap(trees_np, binned_h,
+                              len(m_sh.feature_names),
+                              m_sh.bin_spec.na_bin)
+        host_dt = time.perf_counter() - t0
+        phi_h[:, -1] += float(m_sh.init_score)
+        parity_err = float(np.abs(phi[:host_rows] - phi_h).max())
+        dev_rps = sh_rows / dt
+        host_rps = host_rows / host_dt
+        record("gbm_shap_rows_per_sec", dev_rps, "rows/s", dt, calls,
+               cdt, rows_shap=sh_rows, ntrees=20, max_depth=5,
+               host_rows=host_rows, host_seconds=round(host_dt, 3),
+               host_rows_per_s=round(host_rps, 1),
+               speedup_vs_host=round(dev_rps / max(host_rps, 1e-9), 1),
+               additivity_max_err=add_err,
+               host_parity_max_err=parity_err,
+               warm_repeat_misses=warm_misses)
+        del fr_sh, m_sh, X_sh, phi
 
     if _want("automl_wall_100k"):
         # config #7: pipelined AutoML wall-clock (ISSUE 5 tentpole) on
@@ -572,7 +646,7 @@ def main() -> int:
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r09{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r10{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

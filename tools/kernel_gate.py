@@ -169,6 +169,23 @@ def main() -> int:
     checks.append({"check": "flat_scorer_parity_multinomial",
                    "ok": flat3_ok})
 
+    # 4b. compiled TreeSHAP serving (models/tree/shap.flat_shap) must
+    # match the f64 host recursion on chip AND hold the additivity
+    # invariant on device — the path tables + unwind DP must survive
+    # real lowering, not just CPU interpret. Same NA + high-card
+    # grouped-enum frame as the flat-scorer check.
+    Xf_np = np.asarray(Xf)[: n]
+    contrib = mf.predict_contributions(frf)
+    host_phi = np.stack([contrib.vec(c).to_numpy()
+                         for c in contrib.names], axis=1)
+    dev_phi = mf.contrib_numpy(Xf_np)
+    shap_err = float(np.abs(dev_phi - host_phi).max())
+    margins_f = np.asarray(mf._margins(Xf))[: n]
+    add_err = float(np.abs(dev_phi.sum(axis=1) - margins_f).max())
+    checks.append({"check": "shap_parity",
+                   "ok": shap_err < 1e-4 and add_err < 1e-4,
+                   "host_err": shap_err, "additivity_err": add_err})
+
     # 5. EFB parity on chip: bundled vs unbundled training must pick
     # identical splits and produce bitwise-identical predictions on an
     # exact-sum wide one-hot fixture (models/tree/efb.py — the bundled

@@ -15,6 +15,8 @@ Usage::
         # starts an in-process REST server with a synthetic GBM
     python tools/score_load.py --url http://host:54321 --model gbm1
     python tools/score_load.py --concurrency 16 --rows 32 --seconds 10
+    python tools/score_load.py --contributions    # TreeSHAP explain
+        # route (POST .../contributions) under the same closed loop
     python tools/score_load.py \
         --url http://h1:54321,http://h2:54321 --model pool \
         --columns x0,...  --assert-zero-5xx      # drive a scorer POOL
@@ -135,9 +137,17 @@ def _result_record(latencies: list[float], wall: float,
 
 def run_load(url: str, model_key: str, columns: list[str],
              concurrency: int = 8, rows_per_request: int = 32,
-             seconds: float = 10.0, seed: int = 0) -> dict:
-    """Closed-loop drive; returns the result record (also printable)."""
-    route = f"{url}/3/Predictions/models/{model_key}"
+             seconds: float = 10.0, seed: int = 0,
+             contributions: bool = False) -> dict:
+    """Closed-loop drive; returns the result record (also printable).
+
+    ``contributions=True`` drives the explainable-serving route
+    (``POST .../contributions`` — per-row TreeSHAP through the same
+    micro-batcher, docs/SERVING.md "Explainable serving") instead of
+    predictions; success = a [rows, F+1] contributions matrix back."""
+    suffix = "/contributions" if contributions else ""
+    route = f"{url}/3/Predictions/models/{model_key}{suffix}"
+    out_key = "contributions" if contributions else "predict"
     bodies = _make_bodies(columns, rows_per_request, seed)
     deadline = time.perf_counter() + seconds
     lock = threading.Lock()
@@ -155,7 +165,7 @@ def run_load(url: str, model_key: str, columns: list[str],
             t0 = time.perf_counter()
             try:
                 out = _post_json(route, body)
-                ok = len(out["predict"]) == rows_per_request
+                ok = len(out[out_key]) == rows_per_request
             except urllib.error.HTTPError as e:
                 # 5xx tracked apart from transport noise so
                 # --assert-zero-5xx has a precise needle
@@ -186,7 +196,9 @@ def run_load(url: str, model_key: str, columns: list[str],
         t.join()
     wall = time.perf_counter() - t_start
     return _result_record(latencies, wall, rows_per_request,
-                          concurrency, fivexx, errors)
+                          concurrency, fivexx, errors,
+                          route="contributions" if contributions
+                          else "predictions")
 
 
 def _make_bodies(columns: list[str], rows_per_request: int, seed: int,
@@ -833,7 +845,16 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--assert-zero-5xx", action="store_true",
                     help="fail (rc 1) if ANY response was a 5xx — the "
                     "rolling-update drill's acceptance bar")
+    ap.add_argument("--contributions", action="store_true",
+                    help="drive the explainable-serving route "
+                    "(POST .../contributions, per-row TreeSHAP) "
+                    "instead of predictions — single-target mode")
     args = ap.parse_args(argv)
+    if args.contributions and (args.models > 0 or
+                               (args.url and "," in args.url)):
+        print("--contributions is a single-target mode (no --models / "
+              "multi-URL)", file=sys.stderr)
+        return 2
 
     srv = None
     multi = args.url is not None and "," in args.url
@@ -890,7 +911,8 @@ def main(argv: list[str]) -> int:
             out = run_load(url.rstrip("/"), model_key, columns,
                            concurrency=args.concurrency,
                            rows_per_request=args.rows,
-                           seconds=args.seconds)
+                           seconds=args.seconds,
+                           contributions=args.contributions)
         if srv is not None:
             from h2o_kubernetes_tpu import rest
 
