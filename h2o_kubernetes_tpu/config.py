@@ -69,6 +69,11 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_POOL_ROLLOUT_RETRIES | 3 | new-version readiness failures before a surge-one rollout auto-rolls-back to the pinned last-good version (`rollout_rolled_back` event) |
 | H2O_TPU_POOL_LOG_MAX_BYTES | 8 MiB | per-replica log size that triggers rotate-on-respawn (operator/reconcile.py) |
 | H2O_TPU_POOL_LOG_KEEP | 16 | replica log files kept per pool; older ones are pruned at spawn so a crash loop cannot fill the disk the durable store lives on |
+| H2O_TPU_ROUTER_RETRY_BUDGET | 2 | fleet router: per-TENANT cross-shard retry budget, retries/second (burst = 1 s, min 1 token); 0 = no retries, every failure relays to the client — a dying shard must not amplify load onto survivors (operator/router.py, docs/OPERATOR.md "Sharded routing") |
+| H2O_TPU_ROUTER_HEDGE_MS | 0 (off) | hedged-dispatch kill switch: > 0 arms speculative re-dispatch for `interactive`-class requests after this many ms without a primary answer (first response wins; hedges consume retry-budget tokens) |
+| H2O_TPU_ROUTER_HEALTH_INTERVAL | 0.5 | seconds between router health sweeps over every replica's /3/Stats; each scrape rides the shared probe helper (H2O_TPU_POOL_PROBE_TIMEOUT + 3 attempts before unhealthy, so a scoring burst can't flap a shard out of the ring) |
+| H2O_TPU_ROUTER_MAX_INFLIGHT | 256 | router admission bound on concurrently forwarded requests; past it 429 + Retry-After (<=0 unbounded) |
+| H2O_TPU_ROUTER_TIMEOUT | 30 | per-forward upstream timeout on the router, seconds; clamped under the request's remaining X-H2O-Deadline-Ms budget |
 | JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection

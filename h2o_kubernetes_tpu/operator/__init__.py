@@ -36,6 +36,16 @@ pods into a FLEET:
   reconciler + pod ADOPTION on restart (live pods found via workdir
   manifests are identity-probed over /3/Stats and inherited, never
   duplicated).
+- ``placement`` — rendezvous-hash tenant placement with
+  popularity-aware replication (Zipf head on every shard, tail on
+  ``tail_replicas``); pure math, stability pinned by property tests.
+- ``router``    — the device-free front-door scoring router over a
+  sharded fleet: health-swept failover, per-tenant retry budgets with
+  Retry-After honoring, optional hedged dispatch, and the typed
+  ``placement_pending`` degraded 503.
+- ``probe``     — THE replica scrape helper (probe timeout + 3
+  attempts before unhealthy) shared by the reconciler's adoption/
+  autoscale scrapes and the router's health sweeps.
 
 docs/OPERATOR.md documents the spec schema, reconcile semantics, the
 rolling-update contract, and the autoscale signal; tools/chaos.py's
@@ -43,12 +53,17 @@ rolling-update contract, and the autoscale signal; tools/chaos.py's
 stack end to end.
 """
 
+from .placement import PlacementPlan, plan_placement, shard_preference
 from .registry import FlatTreeScorer, ModelRegistry, load_artifact
-from .reconcile import AdoptedReplica, Reconciler, ScorerReplica
+from .reconcile import (AdoptedReplica, Reconciler, ScorerReplica,
+                        ShardedPool)
+from .router import ScoringRouter, start_router
 from .spec import PoolStore, ScorerPoolSpec, StaleGenerationError
 from .store import DurablePoolStore
 
 __all__ = ["ScorerPoolSpec", "PoolStore", "DurablePoolStore",
            "StaleGenerationError", "ModelRegistry", "FlatTreeScorer",
            "load_artifact", "Reconciler", "ScorerReplica",
-           "AdoptedReplica"]
+           "AdoptedReplica", "ShardedPool", "PlacementPlan",
+           "plan_placement", "shard_preference", "ScoringRouter",
+           "start_router"]

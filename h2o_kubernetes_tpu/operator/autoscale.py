@@ -37,26 +37,44 @@ from .spec import ScorerPoolSpec
 __all__ = ["desired_replicas"]
 
 
-def _totals(samples: list[dict]) -> dict:
+def _totals(samples: list[dict],
+            model_keys: "set | None" = None) -> dict:
+    """Pressure counters summed across replicas. ``model_keys``
+    restricts the cumulative counters to THOSE tenants' per-model
+    stats (/3/Stats ``models``) — the shard-aware signal: a sharded
+    pool must scale the shard whose own tenants shed, and a re-placed
+    tenant's burst must pull up the shard actually serving it, not
+    every shard that happens to share a process-global counter."""
     t = {"shed": 0, "deadline_504": 0, "requests": 0}
     for s in samples:
-        b = s.get("batcher") or {}
-        c = s.get("counters") or {}
-        t["shed"] += int(b.get("shed") or 0)
-        t["deadline_504"] += int(c.get("deadline_504") or 0)
-        t["requests"] += int(b.get("requests") or 0)
+        if model_keys is None:
+            b = s.get("batcher") or {}
+            c = s.get("counters") or {}
+            t["shed"] += int(b.get("shed") or 0)
+            t["deadline_504"] += int(c.get("deadline_504") or 0)
+            t["requests"] += int(b.get("requests") or 0)
+        else:
+            for key, m in (s.get("models") or {}).items():
+                if key not in model_keys:
+                    continue
+                t["shed"] += int(m.get("shed") or 0)
+                t["deadline_504"] += int(m.get("deadline_504") or 0)
+                t["requests"] += int(m.get("requests") or 0)
     return t
 
 
 def desired_replicas(spec: ScorerPoolSpec, samples: list[dict],
-                     prev_totals: dict | None = None
+                     prev_totals: dict | None = None,
+                     model_keys: "set | None" = None
                      ) -> tuple[int, str, dict]:
     """(desired, reason, totals). ``samples`` are /3/Stats dicts from
     the READY replicas; pass the returned ``totals`` back as
     ``prev_totals`` next scrape so cumulative counters become rates.
-    With no samples (pool still converging) the signal holds."""
+    ``model_keys`` (a sharded pool's placed tenant set) attributes the
+    cumulative pressure counters to the shard's own tenants. With no
+    samples (pool still converging) the signal holds."""
     n = spec.replicas
-    totals = _totals(samples)
+    totals = _totals(samples, model_keys)
     if not samples:
         return n, "no ready replicas to scrape", totals
     lo, hi = spec.min_replicas, spec.max_replicas

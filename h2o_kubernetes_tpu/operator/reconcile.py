@@ -44,13 +44,17 @@ import threading
 import time
 import urllib.request
 
+from dataclasses import replace as _dc_replace
+
 from ..runtime.retry import _env_float
+from .placement import PlacementPlan, plan_placement, shard_preference
+from .probe import probe_json
 from .registry import ModelRegistry
 from .spec import PoolStore, ScorerPoolSpec
 
-__all__ = ["Reconciler", "ScorerReplica", "AdoptedReplica", "PENDING",
-           "STARTING", "LOADING", "READY", "CORDONED", "DRAINING",
-           "DEAD"]
+__all__ = ["Reconciler", "ScorerReplica", "AdoptedReplica",
+           "ShardedPool", "PENDING", "STARTING", "LOADING", "READY",
+           "CORDONED", "DRAINING", "DEAD"]
 
 PENDING = "PENDING"        # created, not yet spawned
 STARTING = "STARTING"      # process up, waiting for /healthz
@@ -80,8 +84,11 @@ def _deregister_grace() -> float:
 def _probe_timeout() -> float:
     """Per-probe cap on every reconciler health/readyz//3/Stats
     scrape: one hung replica must not stall the whole pass (and with
-    it death-detection for its siblings)."""
-    return max(0.1, _env_float("H2O_TPU_POOL_PROBE_TIMEOUT", 2.0))
+    it death-detection for its siblings). Shared with the router's
+    health sweeps — operator/probe.py is the one implementation."""
+    from .probe import probe_timeout
+
+    return probe_timeout()
 
 
 def _backoff_base() -> float:
@@ -280,7 +287,10 @@ class ScorerReplica:
         return bool(out and out.get("ready"))
 
     def stats(self) -> dict | None:
-        return self._get_json("/3/Stats")
+        # the shared probe helper (3 attempts inside one probe
+        # timeout each): an autoscale scrape that lands mid scoring
+        # burst must not read a healthy replica as gone
+        return probe_json(self.url, "/3/Stats", retries=3)
 
     def loaded_version(self) -> int | None:
         out = self._get_json("/3/ModelRegistry")
@@ -435,6 +445,11 @@ class Reconciler:
         self.replicas: list = []
         self._seq = 0
         self._last_totals: dict | None = None   # autoscale deltas
+        # shard-aware autoscale: when set (ShardedPool wires it to the
+        # shard's placed tenant set), the cumulative pressure counters
+        # come from THOSE tenants' per-model stats — the shard whose
+        # tenants shed scales, not whichever shard shares a counter
+        self.autoscale_keys: set | None = None
         self._lock = threading.Lock()           # replicas list mutation
         self._stopped = False                   # shutdown() flips it
         self._adopted = False                   # adopt_existing ran
@@ -515,13 +530,11 @@ class Reconciler:
     def _probe_stats(self, url: str) -> dict | None:
         """GET /3/Stats off a candidate adoptee — identity fields
         (pool/replica/pid), lifecycle state, and loaded model versions
-        in one device-free scrape. Injectable for tests."""
-        try:
-            with urllib.request.urlopen(url + "/3/Stats",
-                                        timeout=_probe_timeout()) as r:
-                return json.loads(r.read())
-        except Exception:  # noqa: BLE001 — unreachable reads None
-            return None
+        in one device-free scrape, through the shared probe helper
+        (probe timeout + 3 attempts: one timed-out scrape under a
+        scoring burst must not get a healthy pod killed). Injectable
+        for tests."""
+        return probe_json(url, "/3/Stats", retries=3)
 
     @staticmethod
     def _pid_alive(pid: int) -> bool:
@@ -599,17 +612,12 @@ class Reconciler:
                 except OSError:
                     pass
                 continue
-            # retried: killing a live pod on ONE timed-out scrape
+            # _probe_stats retries internally (the shared probe
+            # helper): killing a live pod on ONE timed-out scrape
             # (GIL-bound scoring burst, transient reset) would break
             # the 'data plane never notices' contract adoption exists
             # for
-            st = None
-            for attempt in range(3):
-                st = self._probe_stats(f"http://127.0.0.1:{port}")
-                if st is not None:
-                    break
-                if attempt < 2:
-                    time.sleep(0.2)
+            st = self._probe_stats(f"http://127.0.0.1:{port}")
             ident = (st or {}).get("identity") or {}
             if st is not None and (
                     ident.get("pool") != self.pool
@@ -1075,7 +1083,8 @@ class Reconciler:
         from .autoscale import desired_replicas
 
         desired, why, totals = desired_replicas(
-            spec, samples, self._last_totals)
+            spec, samples, self._last_totals,
+            model_keys=self.autoscale_keys)
         self._last_totals = totals
         if desired != spec.replicas:
             self.store.apply_update(self.pool, replicas=desired)
@@ -1084,3 +1093,494 @@ class Reconciler:
                         f"({why})")
             return desired
         return None
+
+
+# ---------------------------------------------------------------------------
+# Sharded pools: placement + re-placement over child reconcilers
+# ---------------------------------------------------------------------------
+
+
+class ShardedPool:
+    """A tenant-sharded fleet: one child Reconciler per shard, each
+    converging a child pool that holds only the tenants placement put
+    there (operator/placement.py — rendezvous hashing, the Zipf head
+    replicated on every shard, the tail on ``tail_replicas``), plus
+    the failure half:
+
+    - **shard health** is derived from the children's observed state
+      (a shard with zero live READY replicas is DOWN);
+    - **re-placement**: a tail tenant whose every placed shard is down
+      is re-placed onto the next surviving shard in its rendezvous
+      preference order — a TARGETED ``registry.push`` of that one
+      artifact to the survivor's live replicas (never a full-catalog
+      re-push), the survivor's child spec extended so future spawns of
+      that shard keep serving it, and the routing table extended so
+      the router finds it (the degraded-503 window closes);
+    - **shard-aware autoscale**: each child reconciler autoscales its
+      OWN shard from its own replicas' /3/Stats, with the pressure
+      counters attributed to the shard's placed tenants
+      (``Reconciler.autoscale_keys``) — the shard whose tenants shed
+      scales, not the pool.
+
+    The level-triggered discipline carries over: every pass re-derives
+    placement health from observed state; ``overrides`` (re-placements
+    already pushed) are the only memory, and re-deriving them costs an
+    idempotent push at worst. The parent pool's spec is the single
+    declarative input — child specs are derived, and a parent change
+    (version bump, resize) re-derives and re-applies them, so rolling
+    updates ride the existing surge-one machinery per shard."""
+
+    def __init__(self, store: PoolStore, registry: ModelRegistry,
+                 pool: str, workdir: str | None = None,
+                 log_dir: str | None = None, replica_factory=None):
+        self.store = store
+        self.registry = registry
+        self.pool = pool
+        self.workdir = workdir
+        self.log_dir = log_dir
+        self.replica_factory = replica_factory
+        self.recs: dict[str, Reconciler] = {}
+        self.plan: PlacementPlan | None = None
+        # key -> tuple of EXTRA shard ids the tenant was re-placed
+        # onto (appended to the plan's preference order for routing)
+        self.overrides: dict[str, tuple] = {}
+        self._gen_seen: int | None = None
+        self._parent_replicas: int | None = None
+        self._lock = threading.Lock()
+        self._down_since: dict[str, float] = {}
+        # run()-managed child reconciler threads, one per shard, each
+        # with its OWN stop event so a shard removed by a spec change
+        # can be stopped + drained without touching its siblings
+        self._child_threads: dict[str, threading.Thread] = {}
+        self._child_stops: dict[str, threading.Event] = {}
+        # shards that have served at least once: re-placement (and the
+        # degraded accounting) applies to shards that were LOST, never
+        # to shards still converging toward their first READY replica
+        # — re-placing a booting shard's tenants would double-place
+        # the whole catalog on every cold start
+        self._ever_healthy: set = set()
+        # a RESTARTED controller resumes re-placement state from the
+        # durable status it published (the PR-9 rollback-pin pattern):
+        # without this, the restart would re-derive child specs from
+        # the plan alone — clobbering the survivors' extended specs —
+        # and a shard that died BEFORE the restart would read as
+        # "still converging" forever, leaving its tenants degraded
+        # with no recovery path
+        st = store.get_status(pool)
+        pl = st.get("placement") or {}
+        self.overrides = {k: tuple(v) for k, v in
+                          (pl.get("overrides") or {}).items()}
+        self._ever_healthy = set(pl.get("ever_healthy") or ())
+        self._ensure_children()
+
+    # -- derivation -----------------------------------------------------------
+
+    def _event(self, kind: str, msg: str = "") -> None:
+        self.store.record_event(self.pool, kind, msg)
+        from ..diagnostics import log
+
+        log.warning("operator[%s]: %s %s", self.pool, kind, msg)
+
+    def shard_ids(self, spec: ScorerPoolSpec | None = None) -> list:
+        if spec is None:
+            spec, _ = self.store.get(self.pool)
+        return [f"{self.pool}-s{i}" for i in range(max(1, spec.shards))]
+
+    @staticmethod
+    def _catalog(spec: ScorerPoolSpec) -> dict:
+        """model_key -> (artifact, version, model_key, slo), catalog
+        (= popularity) order preserved by dict insertion."""
+        return {ent[2]: tuple(ent) for ent in spec.all_artifacts()}
+
+    def _derive_plan(self, spec: ScorerPoolSpec) -> PlacementPlan:
+        return plan_placement(list(self._catalog(spec)),
+                              self.shard_ids(spec),
+                              head=spec.head_models,
+                              tail_replicas=spec.tail_replicas)
+
+    def _child_spec(self, spec: ScorerPoolSpec, sid: str,
+                    plan: PlacementPlan) -> ScorerPoolSpec:
+        catalog = self._catalog(spec)
+        keys = [k for k in plan.keys_for(sid)]
+        for key, extra_sids in self.overrides.items():
+            if sid in extra_sids and key not in keys and key in catalog:
+                keys.append(key)
+        extra = tuple(catalog[k] for k in keys if k != spec.model_key)
+        replicas = spec.replicas
+        try:
+            cur, _ = self.store.get(sid)
+            if spec.autoscale or spec.replicas == self._parent_replicas:
+                # keep the child's own width when (a) it autoscales
+                # itself, or (b) the PARENT's replicas field did not
+                # change — a reapply triggered by some other field
+                # (version bump, head tweak) or by a re-placement
+                # spec extension must not clobber a directly-resized
+                # child (an operator's capacity-zero on a lost shard,
+                # a survivor scaled up mid-incident). An explicit
+                # parent resize still flows into every shard.
+                replicas = cur.replicas
+        except KeyError:
+            pass
+        return _dc_replace(
+            spec, name=sid, replicas=replicas, extra_artifacts=extra,
+            shards=1, head_models=min(1, len(keys) or 1),
+            tail_replicas=1)
+
+    def _recs_snapshot(self) -> dict:
+        """Stable view of the child map: _ensure_children mutates it
+        under the lock when the shard set changes, and the router's
+        request path iterates it (routing_table) — iterating the live
+        dict would RuntimeError mid-reconfiguration."""
+        with self._lock:
+            return dict(self.recs)
+
+    def _ensure_children(self) -> None:
+        """Derive + apply the child specs and build one Reconciler per
+        shard. Re-runs whenever the parent spec generation moved (a
+        version bump or resize flows into every child, riding the
+        normal per-shard surge-one rollout); a shard REMOVED by the
+        change is stopped, drained, and deleted from the store — its
+        tenants already live in the re-derived plan of the survivors."""
+        spec, gen = self.store.get(self.pool)
+        if gen == self._gen_seen and self.recs:
+            return
+        removed: list = []
+        with self._lock:
+            if gen == self._gen_seen and self.recs:
+                return
+            plan = self._derive_plan(spec)
+            # a changed shard SET invalidates the overrides (they name
+            # shards that may no longer exist); a same-shape reapply
+            # keeps them — orphans are re-detected level-triggered
+            # either way, re-placement is idempotent
+            if self.plan is not None and \
+                    self.plan.shards != plan.shards:
+                self.overrides.clear()
+            self.plan = plan
+            want = set(self.shard_ids(spec))
+            for sid in sorted(set(self.recs) - want):
+                removed.append((sid, self.recs.pop(sid)))
+                self._ever_healthy.discard(sid)
+                self._down_since.pop(sid, None)
+            for sid in self.shard_ids(spec):
+                child = self._child_spec(spec, sid, plan)
+                self.store.apply(child)
+                if sid not in self.recs:
+                    wd = os.path.join(self.workdir, sid) \
+                        if self.workdir else None
+                    ld = os.path.join(self.log_dir, sid) \
+                        if self.log_dir else None
+                    self.recs[sid] = Reconciler(
+                        self.store, self.registry, sid, log_dir=ld,
+                        workdir=wd,
+                        replica_factory=self.replica_factory)
+                self._set_autoscale_keys(sid)
+            self._gen_seen = gen
+            self._parent_replicas = spec.replicas
+        for sid, rec in removed:
+            ev = self._child_stops.pop(sid, None)
+            if ev is not None:
+                ev.set()
+            self._child_threads.pop(sid, None)
+            self._event("shard_removed",
+                        f"{sid} left the shard set — draining")
+            # drain outside the lock and off this thread: retiring a
+            # shard's pods can take a full drain window and must not
+            # stall routing_table() or the surviving shards' loop
+            threading.Thread(target=self._retire_child,
+                             args=(sid, rec), daemon=True).start()
+
+    def _retire_child(self, sid: str, rec: "Reconciler") -> None:
+        try:
+            rec.shutdown(timeout=90)
+        finally:
+            try:
+                self.store.delete(sid)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+
+    def _set_autoscale_keys(self, sid: str) -> None:
+        keys = set(self.plan.keys_for(sid)) if self.plan else set()
+        keys.update(k for k, sids in self.overrides.items()
+                    if sid in sids)
+        self.recs[sid].autoscale_keys = keys
+
+    # -- health + re-placement ------------------------------------------------
+
+    def shard_healthy(self, sid: str) -> bool:
+        """A shard serves iff it has at least one live READY replica —
+        derived from the child's OBSERVED state (the reconciler just
+        probed these pods), no extra HTTP."""
+        rec = self.recs.get(sid)
+        if rec is None:
+            return False
+        with rec._lock:
+            reps = list(rec.replicas)
+        return any(r.state == READY and r.alive() for r in reps)
+
+    def _placed_shards(self, key: str) -> tuple:
+        return (self.plan.assignments.get(key, ())
+                + self.overrides.get(key, ()))
+
+    def _health_maps(self) -> tuple[dict, dict]:
+        """(actual, effective) shard health. ``actual`` is the live
+        has-a-READY-replica answer (push targets use it); ``effective``
+        additionally treats a shard as not-down while it (a) has NEVER
+        been healthy — a cold-starting shard is converging, not lost —
+        or (b) has not finished pod ADOPTION yet: a restarted
+        controller's children inherit live pods on their first pass,
+        and judging a shard lost in the window before that pass would
+        spuriously re-place a healthy fleet's whole catalog."""
+        actual = {sid: self.shard_healthy(sid)
+                  for sid in (self.plan.shards if self.plan else ())}
+        for sid, ok in actual.items():
+            if ok:
+                self._ever_healthy.add(sid)
+        effective = {}
+        for sid, ok in actual.items():
+            rec = self.recs.get(sid)
+            adopted = bool(rec is not None and rec._adopted)
+            effective[sid] = (ok or sid not in self._ever_healthy
+                              or not adopted)
+        return actual, effective
+
+    def pending_orphans(self) -> list:
+        """Tenants currently unservable: every placed shard was lost.
+        The router 503s these with the ``placement_pending`` hint
+        until re-placement (or shard recovery) closes the gap."""
+        if self.plan is None:
+            return []
+        _, effective = self._health_maps()
+        return [k for k in self.plan.assignments
+                if not any(effective.get(s) for s in
+                           self._placed_shards(k))]
+
+    def _push_tenant(self, key: str, sid: str,
+                     spec: ScorerPoolSpec) -> bool:
+        """Targeted push of ONE tenant's artifact to every live READY
+        replica of ``sid`` (each replica must hold the full shard
+        set). Returns False on any failure — the level-triggered loop
+        retries next pass. Deliberately does NOT touch the replica's
+        required-model set: extending it mid-push would flip a serving
+        replica unready; the child-spec update below covers future
+        spawns instead."""
+        ent = self._catalog(spec).get(key)
+        rec = self.recs.get(sid)
+        if ent is None or rec is None:
+            return False
+        with rec._lock:
+            targets = [r for r in rec.replicas
+                       if r.state == READY and r.alive()]
+        if not targets:
+            return False
+        name, version, model_key, slo = ent
+        buckets = None if spec.warm_buckets is None \
+            else list(spec.warm_buckets)
+        for r in targets:
+            try:
+                self.registry.push(r.url, name, int(version), model_key,
+                                   warm_buckets=buckets, slo=slo)
+            except Exception as e:  # noqa: BLE001 — retry next pass
+                self._event("tenant_replace_failed",
+                            f"'{key}' -> {sid} ({r.rid}): "
+                            f"{repr(e)[:200]}")
+                return False
+        return True
+
+    def _replace_once(self) -> int:
+        """One re-placement pass: every orphaned tenant (all placed
+        shards down) is pushed onto the first HEALTHY shard in its
+        rendezvous preference order. Catalog order = popularity order,
+        so the hottest orphans close their degraded window first.
+        Returns the number of tenants re-placed this pass."""
+        if self.plan is None:
+            return 0
+        spec, _ = self.store.get(self.pool)
+        actual, effective = self._health_maps()
+        for sid, down in ((s, not ok) for s, ok in effective.items()):
+            if down and sid not in self._down_since:
+                self._down_since[sid] = time.monotonic()
+                self._event("shard_down",
+                            f"{sid} has no live READY replica")
+            elif not down and sid in self._down_since:
+                dt = time.monotonic() - self._down_since.pop(sid)
+                self._event("shard_recovered",
+                            f"{sid} serving again after {dt:.1f}s")
+        if not any(actual.values()):
+            return 0          # nowhere to re-place onto
+        moved = 0
+        for key in list(self.plan.assignments):
+            placed = self._placed_shards(key)
+            if any(effective.get(s) for s in placed):
+                continue
+            # re-check live health before each push: if the home
+            # shard recovered mid-loop, the remaining orphans are
+            # served again and need no re-placement
+            actual, effective = self._health_maps()
+            if any(effective.get(s) for s in placed):
+                continue
+            for sid in shard_preference(key, self.plan.shards):
+                if sid in placed or not actual.get(sid):
+                    continue
+                if self._push_tenant(key, sid, spec):
+                    self.overrides[key] = \
+                        self.overrides.get(key, ()) + (sid,)
+                    moved += 1
+                    self._event(
+                        "tenant_replaced",
+                        f"'{key}' re-placed onto {sid} (home "
+                        f"shard(s) {list(placed)} down)")
+                    # durable intent: future spawns of the survivor
+                    # carry the tenant (same version — no rollout)
+                    try:
+                        self.store.apply(
+                            self._child_spec(spec, sid, self.plan))
+                    except Exception as e:  # noqa: BLE001
+                        self._event("tenant_replace_spec_error",
+                                    repr(e)[:200])
+                    self._set_autoscale_keys(sid)
+                break
+        return moved
+
+    # -- the loop -------------------------------------------------------------
+
+    def reconcile_once(self) -> None:
+        """Test-driving entry: one parent sync + one pass of every
+        child + one re-placement sweep + status publish. Adoption
+        first, same as Reconciler.run — shard-loss judgment is gated
+        on it (_health_maps)."""
+        self._ensure_children()
+        for rec in self._recs_snapshot().values():
+            if not rec._adopted:
+                try:
+                    rec.adopt_existing()
+                except Exception as e:  # noqa: BLE001 — pass must run
+                    self._event("adoption_error", repr(e)[:200])
+            rec.reconcile_once()
+            rec.autoscale_once()
+        self._replace_once()
+        self._publish_status()
+
+    def _sync_child_threads(self, interval: float | None) -> None:
+        """Every shard in the child map gets a running reconciler
+        thread — including shards ADDED by a mid-run spec change (a
+        thread list built once before the loop would leave a new
+        shard's pods unspawned forever, its tenants 503ing with no
+        recovery path). Each thread has its own stop event so shard
+        removal stops exactly one."""
+        for sid, rec in self._recs_snapshot().items():
+            t = self._child_threads.get(sid)
+            if t is not None and t.is_alive():
+                continue
+            ev = self._child_stops.get(sid)
+            if ev is None or ev.is_set():
+                ev = threading.Event()
+                self._child_stops[sid] = ev
+            t = threading.Thread(target=rec.run, args=(ev,),
+                                 kwargs={"interval": interval},
+                                 name=f"h2o-shard-{sid}", daemon=True)
+            t.start()
+            self._child_threads[sid] = t
+
+    def run(self, stop: threading.Event,
+            interval: float | None = None) -> None:
+        """Blocking loop: children run on their own threads (each the
+        normal Reconciler.run with adoption-first), this thread owns
+        parent sync, re-placement, and parent status."""
+        self._ensure_children()
+        self._sync_child_threads(interval)
+        while not stop.is_set():
+            try:
+                self._ensure_children()
+                self._sync_child_threads(interval)
+                self._replace_once()
+                self._publish_status()
+            except Exception as e:  # noqa: BLE001 — the loop survives
+                self._event("shard_loop_error", repr(e)[:300])
+            stop.wait(interval if interval is not None else _interval())
+        for ev in list(self._child_stops.values()):
+            ev.set()
+        for t in list(self._child_threads.values()):
+            t.join(timeout=10)
+
+    def converged(self) -> bool:
+        recs = self._recs_snapshot()
+        if not recs:
+            return False
+        return all(rec.converged() for rec in recs.values())
+
+    def wait_converged(self, timeout: float = 240.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.converged():
+                return True
+            time.sleep(0.1)
+        return self.converged()
+
+    def shutdown(self, timeout: float = 60.0) -> None:
+        for ev in list(self._child_stops.values()):
+            ev.set()
+        threads = [threading.Thread(
+            target=rec.shutdown, kwargs={"timeout": timeout},
+            daemon=True) for rec in self._recs_snapshot().values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 10)
+
+    # -- the router's view ----------------------------------------------------
+
+    def routing_table(self) -> dict:
+        """The router input: every key's shard preference order (plan
+        + re-placement overrides appended) and every shard's current
+        endpoint URLs. Device-free and cheap — safe to call per
+        health sweep."""
+        if self.plan is None:
+            return {"keys": {}, "shards": {}}
+        return {
+            "keys": {k: list(self._placed_shards(k))
+                     for k in self.plan.assignments},
+            "shards": {sid: rec.endpoints()
+                       for sid, rec in self._recs_snapshot().items()},
+        }
+
+    def endpoints(self) -> list:
+        out = []
+        for rec in self._recs_snapshot().values():
+            out.extend(rec.endpoints())
+        return out
+
+    def _publish_status(self) -> None:
+        shards = {}
+        for sid, rec in self._recs_snapshot().items():
+            st = rec.status()
+            shards[sid] = {
+                "ready": st["ready"],
+                "converged": rec.converged(),
+                "healthy": self.shard_healthy(sid),
+                "tenants": len(rec.autoscale_keys or ()),
+                "replicas": st["replicas"],
+            }
+        orphans = self.pending_orphans()
+        status = {
+            "sharded": True,
+            "shards": shards,
+            "converged": bool(self.recs) and all(
+                s["converged"] for s in shards.values()),
+            "placement": {
+                "catalog": len(self.plan.assignments)
+                if self.plan else 0,
+                "head": len(self.plan.head_keys) if self.plan else 0,
+                # overrides + ever_healthy ARE the re-placement state
+                # a restarted controller resumes from (see __init__)
+                "overrides": {k: list(v)
+                              for k, v in self.overrides.items()},
+                "ever_healthy": sorted(self._ever_healthy),
+            },
+            "degraded_tenants": orphans[:64],
+            "degraded_count": len(orphans),
+        }
+        try:
+            self.store.set_status(self.pool, status)
+        except Exception:  # noqa: BLE001 — status is best-effort
+            pass

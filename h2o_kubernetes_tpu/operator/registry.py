@@ -423,11 +423,49 @@ class ModelRegistry:
     @staticmethod
     def _post_json(base_url: str, path: str, body: dict,
                    timeout: float) -> dict:
+        """POST with the runtime/retry.py backoff layer on TRANSIENT
+        failures (replica 5xx/429, connection reset/refused, timeout):
+        one flaky push during a rollout used to surface as
+        ``load_failed`` and burn a crash-loop backoff slot on a
+        replica that was merely busy. Permanent outcomes (4xx other
+        than 429 — bad artifact, digest mismatch) propagate on the
+        first attempt unchanged, so the poison-rollback path still
+        fails fast. The load route is idempotent, so retrying a push
+        whose response was lost is safe."""
+        import urllib.error
         import urllib.request
 
-        req = urllib.request.Request(
-            base_url.rstrip("/") + path,
-            data=json.dumps(body).encode(), method="POST",
-            headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return json.loads(r.read())
+        from ..runtime import retry as _retry
+
+        data = json.dumps(body).encode()
+
+        def attempt() -> dict:
+            req = urllib.request.Request(
+                base_url.rstrip("/") + path, data=data, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 429 or e.code >= 500:
+                    ra = e.headers.get("Retry-After")
+                    try:
+                        ra = float(ra) if ra is not None else None
+                    except ValueError:
+                        ra = None
+                    detail = e.read()[:200]
+                    raise _retry.TransientError(
+                        f"replica POST {path}: HTTP {e.code} "
+                        f"{detail!r}", retry_after=ra) from None
+                raise                       # 4xx: permanent, no retry
+            except urllib.error.URLError as e:
+                # refused / reset / DNS — the replica is restarting or
+                # mid-drain; classic transient
+                raise _retry.TransientError(
+                    f"replica POST {path}: {e.reason!r}") from None
+            except (TimeoutError, ConnectionError, OSError) as e:
+                raise _retry.TransientError(
+                    f"replica POST {path}: {e!r}") from None
+
+        return _retry.call(attempt,
+                           describe=f"registry push {path}")

@@ -57,6 +57,17 @@ class ScorerPoolSpec:
     # The PRIMARY artifact/version still drives rolling updates; a
     # changed extra artifact rides the next primary version bump.
     extra_artifacts: tuple = ()
+    # tenant sharding (operator/placement.py + ShardedPool): >1 splits
+    # the catalog across this many shard groups (each `replicas` wide)
+    # via rendezvous hashing instead of pushing everything everywhere.
+    # The catalog order (primary first, then extra_artifacts) is the
+    # POPULARITY rank: the first `head_models` keys are replicated on
+    # every shard (instant router failover for the Zipf head), the
+    # tail lands on exactly `tail_replicas` shards. shards == 1 is the
+    # legacy everyone-has-everything pool, bit-for-bit.
+    shards: int = 1
+    head_models: int = 1           # catalog prefix replicated everywhere
+    tail_replicas: int = 1         # shards per tail tenant
     env: dict = field(default_factory=dict)   # extra pod env overrides
 
     def validate(self) -> "ScorerPoolSpec":
@@ -106,6 +117,23 @@ class ScorerPoolSpec:
             raise ValueError(
                 f"duplicate model_key across the pool's artifacts: "
                 f"{sorted(k for k in set(keys) if keys.count(k) > 1)}")
+        if self.shards < 1:
+            raise ValueError(f"shards must be >= 1, got {self.shards}")
+        if not (1 <= self.tail_replicas <= max(1, self.shards)):
+            raise ValueError(
+                f"need 1 <= tail_replicas ({self.tail_replicas}) <= "
+                f"shards ({self.shards})")
+        if not (0 <= self.head_models <= len(keys)):
+            raise ValueError(
+                f"head_models ({self.head_models}) must be within the "
+                f"catalog (0..{len(keys)})")
+        if self.shards > 1 and self.head_models < 1:
+            # every shard's child pool needs the primary artifact (it
+            # is the rank-1 head by the catalog-order convention), so
+            # a sharded pool replicates at least the primary
+            raise ValueError("a sharded pool needs head_models >= 1 "
+                             "(the primary model is the rank-1 head "
+                             "and lives on every shard)")
         return self
 
     def all_artifacts(self) -> list[tuple]:

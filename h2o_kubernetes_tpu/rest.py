@@ -218,18 +218,12 @@ def _rate_limit_admit(model_key: str | None,
     rate = _model_rate_limit()
     if rate <= 0 or model_key is None:
         return
-    burst = max(1.0, rate)
-    now = _bucket_now()
+    from .runtime.retry import bucket_take
+
     with _RATE_LOCK:
-        b = _RATE_BUCKETS.get(model_key)
-        if b is None:
-            b = _RATE_BUCKETS[model_key] = [burst, now]
-        tokens = min(burst, b[0] + (now - b[1]) * rate)
-        if tokens < 1.0:
-            b[0], b[1] = tokens, now
-            retry = (1.0 - tokens) / rate
-        else:
-            b[0], b[1] = tokens - 1.0, now
+        retry = bucket_take(_RATE_BUCKETS, model_key, rate,
+                            _bucket_now())
+        if retry == 0.0:
             return
     _bump_stat("rate_limited")
     _bump_model_stat(model_key, "rate_limited", slo=slo)
@@ -968,13 +962,17 @@ def _frame_schema(key: str, fr) -> dict:
                          "type": fr.vec(n).kind} for n in fr.names]}
 
 
-class _Handler(BaseHTTPRequestHandler):
+class JsonHttpHandler(BaseHTTPRequestHandler):
+    """The JSON request-handler plumbing every server in this package
+    shares — the REST node below AND the device-free scoring router
+    (operator/router.py rides exactly this base so error shapes,
+    Retry-After semantics, and the drain-safe body discard cannot
+    drift between the front door and the replicas)."""
+
     server_version = "h2o-tpu-rest/1"
 
     def log_message(self, *a):       # quiet by default
         pass
-
-    # -- plumbing ------------------------------------------------------------
 
     def _json(self, obj, code: int = 200, headers: dict | None = None):
         # metrics can be NaN (single-class CV folds, zero-weight rmse);
@@ -1014,6 +1012,11 @@ class _Handler(BaseHTTPRequestHandler):
             if not chunk:
                 break
             n -= len(chunk)
+
+
+class _Handler(JsonHttpHandler):
+
+    # -- plumbing ------------------------------------------------------------
 
     def _unhealthy_503(self) -> bool:
         """Send 503 + the health error when the cloud is locked-

@@ -41,7 +41,32 @@ import time
 from dataclasses import dataclass
 from typing import Callable, TypeVar
 
-__all__ = ["RetryPolicy", "TransientError", "policy_from_env", "call"]
+__all__ = ["RetryPolicy", "TransientError", "policy_from_env", "call",
+           "bucket_take"]
+
+
+def bucket_take(buckets: dict, key: str, rate: float, now: float,
+                burst: float | None = None) -> float:
+    """THE token-bucket step shared by every per-key admission budget
+    (rest.py's per-tenant rate limit, the fleet router's per-tenant
+    retry budget): take one token from ``buckets[key]`` (created at
+    full burst on first touch), refilled continuously at ``rate``/s
+    and capped at ``burst`` (default: one second of traffic, min 1).
+
+    Returns 0.0 on success, else the seconds until a token accrues
+    (the Retry-After the caller should advertise). The caller owns
+    locking and the clock — ``now`` is passed in so tests can freeze
+    it. Mutates ``buckets[key] = [tokens, last]`` in place."""
+    burst = max(1.0, rate) if burst is None else burst
+    b = buckets.get(key)
+    if b is None:
+        b = buckets[key] = [burst, now]
+    tokens = min(burst, b[0] + (now - b[1]) * rate)
+    if tokens < 1.0:
+        b[0], b[1] = tokens, now
+        return (1.0 - tokens) / rate
+    b[0], b[1] = tokens - 1.0, now
+    return 0.0
 
 T = TypeVar("T")
 

@@ -1,0 +1,850 @@
+"""Tenant-sharded fleet router (ISSUE 11 tentpole): placement must be
+deterministic, popularity-replicated, and HRW-stable (adding a shard
+moves ~1/N of the tail, never a reshuffle); the ShardedPool must
+derive child pools from the plan, detect shard death, and re-place an
+orphaned tail tenant via a TARGETED registry push; the router must
+fail over inside the per-tenant retry budget, honor Retry-After,
+serve the typed degraded 503, and keep hedging behind its kill
+switch. Real-subprocess legs live in tools/chaos.py
+``router-shard-kill``."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from h2o_kubernetes_tpu.operator import (PoolStore, ScorerPoolSpec,
+                                         ShardedPool, plan_placement,
+                                         shard_preference,
+                                         start_router)
+from h2o_kubernetes_tpu.operator.autoscale import desired_replicas
+from h2o_kubernetes_tpu.operator.probe import probe_json
+
+pytestmark = pytest.mark.chaos
+
+from test_operator import FakeReplica  # noqa: E402 — the scripted
+# in-process replica (tests/ is pytest-inserted, not a package)
+
+
+# ---------------------------------------------------------------------------
+# Placement properties (satellite: placement-stability property tests)
+# ---------------------------------------------------------------------------
+
+KEYS = [f"m{i:03d}" for i in range(1000)]
+SHARDS3 = ["p-s0", "p-s1", "p-s2"]
+
+
+def test_placement_deterministic():
+    a = plan_placement(KEYS, SHARDS3, head=10)
+    b = plan_placement(KEYS, SHARDS3, head=10)
+    assert a.assignments == b.assignments
+    assert a.head_keys == b.head_keys
+    # preference order is pure HRW — independent of catalog order
+    shuffled = list(reversed(KEYS))
+    c = plan_placement(shuffled, SHARDS3, head=0)
+    for k in KEYS[10:]:
+        assert c.assignments[k] == a.assignments[k]
+
+
+def test_placement_head_replicated_tail_single():
+    plan = plan_placement(KEYS, SHARDS3, head=10, tail_replicas=1)
+    for k in KEYS[:10]:
+        assert set(plan.assignments[k]) == set(SHARDS3), k
+        # failover order still HRW — deterministic, not alphabetical
+        assert list(plan.assignments[k]) == shard_preference(k, SHARDS3)
+    for k in KEYS[10:]:
+        assert len(plan.assignments[k]) == 1, k
+        assert plan.assignments[k][0] == shard_preference(k, SHARDS3)[0]
+    # tail_replicas=2 doubles the tail footprint
+    plan2 = plan_placement(KEYS, SHARDS3, head=10, tail_replicas=2)
+    for k in KEYS[10:]:
+        assert list(plan2.assignments[k]) == \
+            shard_preference(k, SHARDS3)[:2]
+
+
+def test_placement_tail_spread_balanced():
+    """HRW spreads the tail roughly evenly: no shard holds more than
+    ~1.25x its fair share of 990 tail keys."""
+    plan = plan_placement(KEYS, SHARDS3, head=10)
+    counts = {s: 0 for s in SHARDS3}
+    for k in KEYS[10:]:
+        counts[plan.assignments[k][0]] += 1
+    fair = (len(KEYS) - 10) / len(SHARDS3)
+    for s, n in counts.items():
+        assert 0.75 * fair <= n <= 1.25 * fair, counts
+
+
+def test_placement_stability_add_and_remove_shard():
+    """The rendezvous contract: growing 3 -> 4 shards moves ~1/4 of
+    the tail (bounded well under a reshuffle), and keys that do NOT
+    move keep their exact assignment; removing a shard moves ONLY the
+    keys that lived on it."""
+    tail = KEYS[10:]
+    p3 = plan_placement(KEYS, SHARDS3, head=10)
+    p4 = plan_placement(KEYS, SHARDS3 + ["p-s3"], head=10)
+    moved = [k for k in tail if p3.assignments[k] != p4.assignments[k]]
+    n = len(SHARDS3) + 1
+    assert len(moved) <= 1.5 * len(tail) / n, \
+        f"{len(moved)}/{len(tail)} tail keys moved growing to {n}"
+    # every mover moved TO the new shard (that is the only legal move)
+    assert all(p4.assignments[k] == ("p-s3",) for k in moved)
+    # removal: only the removed shard's keys move
+    p2 = plan_placement(KEYS, SHARDS3[:2], head=10)
+    for k in tail:
+        if p3.assignments[k][0] != "p-s2":
+            assert p2.assignments[k] == p3.assignments[k], k
+
+
+def test_placement_validation():
+    with pytest.raises(ValueError, match="at least one shard"):
+        plan_placement(KEYS, [])
+    with pytest.raises(ValueError, match="duplicate shard"):
+        plan_placement(KEYS, ["a", "a"])
+    with pytest.raises(ValueError, match="duplicate model keys"):
+        plan_placement(["k", "k"], SHARDS3)
+
+
+def test_spec_shard_fields_validate():
+    base = dict(name="p", artifact="a", version=1, model_key="m")
+    with pytest.raises(ValueError, match="shards"):
+        ScorerPoolSpec(**base, shards=0).validate()
+    with pytest.raises(ValueError, match="tail_replicas"):
+        ScorerPoolSpec(**base, shards=3, tail_replicas=4).validate()
+    with pytest.raises(ValueError, match="head_models"):
+        ScorerPoolSpec(**base, shards=2, head_models=0).validate()
+    with pytest.raises(ValueError, match="head_models"):
+        ScorerPoolSpec(**base, head_models=7).validate()
+    # legacy pool untouched; sharded pool with sane fields passes
+    ScorerPoolSpec(**base).validate()
+    ScorerPoolSpec(**base, shards=3, head_models=1,
+                   tail_replicas=2).validate()
+
+
+# ---------------------------------------------------------------------------
+# ShardedPool: child derivation + shard death -> targeted re-placement
+# ---------------------------------------------------------------------------
+
+
+class StubRegistry:
+    """Records targeted pushes instead of HTTP."""
+
+    def __init__(self, fail: int = 0):
+        self.pushes = []
+        self._fail = fail
+
+    def push(self, url, name, version, model_key, warm_buckets=None,
+             timeout=300.0, inline=None, slo=None):
+        if self._fail > 0:
+            self._fail -= 1
+            raise IOError("stub push failure")
+        self.pushes.append((url, name, int(version), model_key, slo))
+        return {"model_id": {"name": model_key}}
+
+
+def _sharded_pool(shards=2, tenants=8, replicas=1, registry=None,
+                  **spec_kw):
+    store = PoolStore()
+    extra = tuple((f"a{i}", 1, f"t{i}") for i in range(1, tenants + 1))
+    store.apply(ScorerPoolSpec(
+        name="p", artifact="a0", version=1, model_key="m",
+        replicas=replicas, shards=shards, head_models=1,
+        extra_artifacts=extra, **spec_kw))
+    pool = ShardedPool(store, registry or StubRegistry(), "p",
+                       replica_factory=FakeReplica)
+    return store, pool
+
+
+def _settle(pool, passes=40):
+    for _ in range(passes):
+        pool.reconcile_once()
+        if pool.converged():
+            return True
+    return pool.converged()
+
+
+def test_sharded_pool_child_specs_partition_catalog():
+    store, pool = _sharded_pool(shards=2, tenants=8)
+    assert sorted(pool.recs) == ["p-s0", "p-s1"]
+    s0, _ = store.get("p-s0")
+    s1, _ = store.get("p-s1")
+    # primary (the head) on BOTH children; the tail partitioned
+    assert s0.artifact == s1.artifact == "a0"
+    t0 = {e[2] for e in s0.extra_artifacts}
+    t1 = {e[2] for e in s1.extra_artifacts}
+    assert t0 | t1 == {f"t{i}" for i in range(1, 9)}
+    assert not (t0 & t1), "a tail tenant landed on both shards"
+    # the child sets match the plan exactly
+    for sid, keys in ((s0.name, t0), (s1.name, t1)):
+        assert keys == set(pool.plan.keys_for(sid)) - {"m"}
+    assert _settle(pool)
+    # the routing table covers the whole catalog and every shard has
+    # endpoints; shard-aware autoscale keys wired
+    table = pool.routing_table()
+    assert set(table["keys"]) == {"m"} | t0 | t1
+    assert list(table["keys"]["m"]) == \
+        shard_preference("m", ["p-s0", "p-s1"])
+    assert pool.recs["p-s0"].autoscale_keys == t0 | {"m"}
+    st = store.get_status("p")
+    assert st["sharded"] and st["converged"]
+    assert st["degraded_count"] == 0
+
+
+def test_shard_death_replaces_tail_via_targeted_push():
+    reg = StubRegistry()
+    store, pool = _sharded_pool(shards=2, tenants=8, registry=reg)
+    assert _settle(pool)
+    # kill every replica of shard s0 (without letting the child
+    # reconciler replace it yet — the replace sweep runs first, the
+    # way a real shard loss looks while backoff/startup is pending)
+    dead_sid = "p-s0"
+    survivor = "p-s1"
+    orphans = set(pool.plan.keys_for(dead_sid)) - {"m"}
+    assert orphans, "fixture must place tail tenants on the shard"
+    for r in pool.recs[dead_sid].replicas:
+        r._alive = False
+    assert set(pool.pending_orphans()) == orphans
+    moved = pool._replace_once()
+    assert moved == len(orphans)
+    # targeted: one push per orphan per survivor replica — never the
+    # full catalog
+    pushed_keys = {p[3] for p in reg.pushes}
+    assert pushed_keys == orphans
+    surv_urls = {r.url for r in pool.recs[survivor].replicas}
+    assert {p[0] for p in reg.pushes} <= surv_urls
+    # overrides + routing table route the orphans to the survivor
+    for k in orphans:
+        assert pool.overrides[k] == (survivor,)
+        assert pool.routing_table()["keys"][k][-1] == survivor
+    # durable intent: the survivor's child spec now carries them
+    s1, _ = store.get(survivor)
+    assert orphans <= {e[2] for e in s1.extra_artifacts}
+    # events: shard_down + one tenant_replaced per orphan
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "shard_down" in kinds
+    assert kinds.count("tenant_replaced") == len(orphans)
+    assert pool.pending_orphans() == []
+    # shard-aware autoscale keys follow the tenants
+    assert orphans <= pool.recs[survivor].autoscale_keys
+    # the dead shard recovers through the normal child convergence;
+    # pool reconverges and records it
+    assert _settle(pool, passes=60)
+    assert "shard_recovered" in [e["kind"] for e in store.events("p")]
+
+
+def test_replacement_push_failure_retries_next_pass():
+    reg = StubRegistry(fail=1)
+    store, pool = _sharded_pool(shards=2, tenants=4, registry=reg)
+    assert _settle(pool)
+    dead_sid = "p-s0"
+    orphans = set(pool.plan.keys_for(dead_sid)) - {"m"}
+    for r in pool.recs[dead_sid].replicas:
+        r._alive = False
+    moved1 = pool._replace_once()      # first push fails (stub)
+    moved2 = pool._replace_once()      # level-triggered: retried
+    assert moved1 + moved2 == len(orphans)
+    kinds = [e["kind"] for e in store.events("p")]
+    assert "tenant_replace_failed" in kinds
+    assert pool.pending_orphans() == []
+
+
+def test_replacement_state_survives_controller_restart():
+    """A restarted ShardedPool resumes overrides + shard history from
+    the status it published: the survivors' extended child specs are
+    not clobbered by the re-derived plan, and a shard that died
+    BEFORE the restart still reads as LOST (not 'converging'), so its
+    tenants keep their re-placement."""
+    reg = StubRegistry()
+    store, pool = _sharded_pool(shards=2, tenants=6, registry=reg)
+    assert _settle(pool)
+    dead_sid, survivor = "p-s0", "p-s1"
+    orphans = set(pool.plan.keys_for(dead_sid)) - {"m"}
+    for r in pool.recs[dead_sid].replicas:
+        r._alive = False
+    pool._replace_once()
+    pool._publish_status()
+    assert set(pool.overrides) == orphans
+
+    # the "restarted" controller: a fresh ShardedPool over the SAME
+    # store (the durable-store restart shape)
+    pool2 = ShardedPool(store, reg, "p", replica_factory=FakeReplica)
+    assert {k: v for k, v in pool2.overrides.items()} == \
+        {k: (survivor,) for k in orphans}
+    assert pool2._ever_healthy == {"p-s0", "p-s1"}
+    # the re-derived survivor child spec KEPT the re-placed tenants
+    s1, _ = store.get(survivor)
+    assert orphans <= {e[2] for e in s1.extra_artifacts}
+    # and the routing table still routes them through the survivor
+    for k in orphans:
+        assert pool2.routing_table()["keys"][k][-1] == survivor
+    # the pre-restart-dead shard counts as LOST for the fresh
+    # controller (it served once, in the previous life) — its tenants
+    # are NOT pending re-placement because the overrides cover them
+    assert pool2.pending_orphans() == []
+
+
+def test_autoscale_model_filter_is_shard_aware():
+    """The shard whose OWN tenants shed scales; a shard whose tenants
+    are idle reads the same /3/Stats sample as no pressure."""
+    spec = ScorerPoolSpec(name="p", artifact="a", version=1,
+                          model_key="m", replicas=2, min_replicas=1,
+                          max_replicas=4)
+    sample = {"batcher": {"queue_depth": 0, "shed": 9,
+                          "requests": 500},
+              "counters": {"deadline_504": 0},
+              "models": {"t1": {"shed": 9, "deadline_504": 0,
+                                "requests": 400},
+                         "t2": {"shed": 0, "deadline_504": 0,
+                                "requests": 100}}}
+    # shard A owns t1 (the shedding tenant): pressure -> scale up
+    prev = desired_replicas(spec, [sample], model_keys={"t1"})[2]
+    bumped = {**sample, "models": {**sample["models"],
+                                   "t1": {"shed": 12, "deadline_504": 0,
+                                          "requests": 450}}}
+    n, why, _ = desired_replicas(spec, [bumped], prev,
+                                 model_keys={"t1"})
+    assert n == 3 and "shed" in why
+    # shard B owns t2 (idle): the SAME sample is no pressure for it
+    prev = desired_replicas(spec, [sample], model_keys={"t2"})[2]
+    n, why, _ = desired_replicas(spec, [bumped], prev,
+                                 model_keys={"t2"})
+    assert n != 3, f"idle shard scaled up on another shard's shed: {why}"
+    # unfiltered keeps the legacy global-counter behavior
+    prev = desired_replicas(spec, [sample])[2]
+    n, why, _ = desired_replicas(
+        spec, [{**bumped, "batcher": {"queue_depth": 0, "shed": 12,
+                                      "requests": 600}}], prev)
+    assert n == 3
+
+
+# ---------------------------------------------------------------------------
+# Router: stub replica backends over real HTTP
+# ---------------------------------------------------------------------------
+
+
+class _StubReplica:
+    """A minimal replica: /3/Stats says ready, POST behavior is
+    scripted per test."""
+
+    def __init__(self, on_post=None, ready=True, name="stub"):
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({"ready": stub.ready,
+                                   "name": stub.name}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                req_body = self.rfile.read(n) if n else b""
+                code, payload, hdrs = stub.on_post(self.path, req_body,
+                                                   dict(self.headers))
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (hdrs or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.ready = ready
+        self.name = name
+        self.posts = []
+        self._on_post = on_post
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def on_post(self, path, body, headers):
+        self.posts.append((path, body, headers))
+        if self._on_post is not None:
+            return self._on_post(path, body, headers)
+        return 200, {"predict": ["ok"], "served_by": self.name}, None
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def _post(url, payload=None, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload or {"rows": [[1.0]]}).encode(),
+        method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+@pytest.fixture
+def quiet_health(monkeypatch):
+    # tests drive sweep_health() explicitly; a fast background sweep
+    # racing a deliberate kill would re-classify mid-assertion
+    monkeypatch.setenv("H2O_TPU_ROUTER_HEALTH_INTERVAL", "30")
+
+
+def _router(table):
+    srv, router = start_router(table)
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    return srv, router, url
+
+
+def test_router_forwards_and_fails_over(quiet_health):
+    a = _StubReplica(name="a", on_post=lambda *args: (
+        500, {"msg": "boom"}, None))
+    b = _StubReplica(name="b")
+    table = {"keys": {"pm": ["s0", "s1"], "tail": ["s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        # replicated key: the 5xx from shard s0 fails over to s1
+        # under one retry token
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 200 and out["served_by"] == "b"
+        st = router.snapshot()
+        assert st["stats"]["retries"] == 1
+        assert st["retry_budget"]["granted"] == 1
+        assert st["stats"]["relayed_5xx"] == 1
+        # single-shard key forwards without touching the budget
+        code, out, _ = _post(url + "/3/Predictions/models/tail")
+        assert code == 200 and out["served_by"] == "b"
+        assert router.snapshot()["stats"]["retries"] == 1
+        # readiness reflects shard health
+        with urllib.request.urlopen(url + "/readyz", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+        b.close()
+
+
+def test_router_transport_failover_on_dead_replica(quiet_health):
+    a = _StubReplica(name="a")
+    b = _StubReplica(name="b")
+    table = {"keys": {"pm": ["s0", "s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        a.close()       # dies AFTER the health sweep marked it ready
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 200 and out["served_by"] == "b"
+        st = router.snapshot()["stats"]
+        assert st["failovers"] == 1 and st["transport_errors"] == 1
+    finally:
+        router.stop()
+        srv.shutdown()
+        b.close()
+
+
+def test_router_intra_shard_replica_failover(quiet_health,
+                                             monkeypatch):
+    """A replica that dies between health sweeps must not 503 a
+    single-shard tail tenant while a READY sibling replica exists in
+    the SAME shard — intra-shard transport failover is free (no
+    cross-shard retry token: nothing was processed, and token-gating
+    it would starve the tenant on one replica death)."""
+    monkeypatch.setenv("H2O_TPU_ROUTER_RETRY_BUDGET", "0")
+    a = _StubReplica(name="a")
+    b = _StubReplica(name="b")
+    table = {"keys": {"tail": ["s0"]},
+             "shards": {"s0": [a.url, b.url]}}
+    srv, router, url = _router(table)
+    try:
+        a.close()       # dies AFTER the sweep marked it ready
+        ok = 0
+        for _ in range(4):   # round-robin: both rotations covered
+            code, out, _ = _post(url + "/3/Predictions/models/tail")
+            assert code == 200 and out["served_by"] == "b", (code, out)
+            ok += 1
+        st = router.snapshot()["stats"]
+        assert ok == 4
+        assert st["retries"] == 0, "intra-shard failover burned tokens"
+        assert st["failovers"] >= 1
+    finally:
+        router.stop()
+        srv.shutdown()
+        b.close()
+
+
+def test_child_resize_survives_parent_reapply():
+    """A directly-resized child shard (the capacity-zero shape the
+    drill uses for a lost node pool) must survive a parent-spec
+    reapply that does not touch replicas; an explicit parent resize
+    still flows into every shard."""
+    store, pool = _sharded_pool(shards=2, tenants=4)
+    assert _settle(pool)
+    store.apply_update("p-s0", replicas=0)
+    # parent change that does NOT touch replicas: child keeps 0
+    store.apply_update("p", head_models=1)   # no-op field, gen bump
+    pool._ensure_children()
+    assert store.get("p-s0")[0].replicas == 0
+    # explicit parent resize overrides every child
+    store.apply_update("p", replicas=2)
+    pool._ensure_children()
+    assert store.get("p-s0")[0].replicas == 2
+    assert store.get("p-s1")[0].replicas == 2
+
+
+def test_router_retry_budget_denied(quiet_health, monkeypatch):
+    monkeypatch.setenv("H2O_TPU_ROUTER_RETRY_BUDGET", "0")
+    a = _StubReplica(name="a", on_post=lambda *args: (
+        502, {"msg": "dying shard"}, None))
+    b = _StubReplica(name="b")
+    table = {"keys": {"pm": ["s0", "s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        # budget 0 = no cross-shard retries: the 502 is relayed even
+        # though a healthy replica shard exists — the dying shard
+        # cannot amplify onto it
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 502
+        st = router.snapshot()
+        assert st["stats"]["retries"] == 0
+        assert st["stats"]["retry_denied"] == 1
+        assert st["retry_budget"]["denied"] >= 1
+        assert st["retry_budget"]["granted"] == 0
+        assert len(b.posts) == 0, "request leaked past a denied budget"
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+        b.close()
+
+
+def test_router_degraded_typed_503(quiet_health):
+    a = _StubReplica(name="a")
+    table = {"keys": {"lonely": ["s0"]}, "shards": {"s0": [a.url]}}
+    srv, router, url = _router(table)
+    try:
+        a.close()
+        router.sweep_health()       # observe the death
+        code, out, hdrs = _post(url + "/3/Predictions/models/lonely")
+        assert code == 503
+        assert out["hint"] == "placement_pending"
+        assert out["model"] == "lonely"
+        assert "Retry-After" in hdrs
+        assert router.snapshot()["stats"]["degraded_503"] == 1
+        # unknown tenant is a 404, not a degraded 503
+        code, out, _ = _post(url + "/3/Predictions/models/nope")
+        assert code == 404
+        # the router itself reads unready with every shard down
+        st = probe_json(url, "/readyz", retries=1)
+        assert st and st["ready"] is False
+    finally:
+        router.stop()
+        srv.shutdown()
+
+
+def test_router_relays_429_and_4xx_without_retry(quiet_health):
+    a = _StubReplica(name="a", on_post=lambda *args: (
+        429, {"msg": "rate limited"}, {"Retry-After": "7"}))
+    b = _StubReplica(name="b")
+    table = {"keys": {"pm": ["s0", "s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        code, out, hdrs = _post(url + "/3/Predictions/models/pm")
+        # a tenant's own 429 must NOT fail over — retrying a
+        # rate-limited tenant on another shard would defeat the limit
+        assert code == 429 and hdrs.get("Retry-After") == "7"
+        assert router.snapshot()["stats"]["retries"] == 0
+        assert len(b.posts) == 0
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+        b.close()
+
+
+def test_router_honors_retry_after_cooldown(quiet_health):
+    calls = {"a": 0}
+
+    def a_post(*args):
+        calls["a"] += 1
+        return 503, {"msg": "draining"}, {"Retry-After": "30"}
+
+    a = _StubReplica(name="a", on_post=a_post)
+    b = _StubReplica(name="b")
+    table = {"keys": {"pm": ["s0", "s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 200 and out["served_by"] == "b"
+        # the 503's Retry-After put the replica on cooldown: the next
+        # request goes straight to s1 without re-dispatching into the
+        # draining pod (and without burning another retry token)
+        tokens_before = router.snapshot()["retry_budget"]["granted"]
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 200 and out["served_by"] == "b"
+        assert calls["a"] == 1
+        assert router.snapshot()["retry_budget"]["granted"] == \
+            tokens_before
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+        b.close()
+
+
+def test_router_deadline_and_slo_forwarding(quiet_health):
+    seen = {}
+
+    def a_post(path, body, headers):
+        seen.update(headers)
+        return 200, {"predict": ["ok"], "served_by": "a"}, None
+
+    a = _StubReplica(name="a", on_post=a_post)
+    table = {"keys": {"pm": ["s0"]}, "shards": {"s0": [a.url]}}
+    srv, router, url = _router(table)
+    try:
+        # expired budget: 504 at the front door, zero forwards
+        code, out, _ = _post(url + "/3/Predictions/models/pm",
+                             headers={"X-H2O-Deadline-Ms": "-1"})
+        assert code == 504 and len(a.posts) == 0
+        # live budget: the REMAINING ms is forwarded (shrunk, > 0),
+        # and the SLO header passes through
+        code, out, _ = _post(
+            url + "/3/Predictions/models/pm",
+            headers={"X-H2O-Deadline-Ms": "5000",
+                     "X-H2O-SLO": "interactive"})
+        assert code == 200
+        low = {k.lower(): v for k, v in seen.items()}
+        fwd = float(low["x-h2o-deadline-ms"])
+        assert 0 < fwd <= 5000
+        assert low["x-h2o-slo"] == "interactive"
+        # bad header: 400, not a forward
+        code, out, _ = _post(url + "/3/Predictions/models/pm",
+                             headers={"X-H2O-Deadline-Ms": "soon"})
+        assert code == 400
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+
+
+def test_router_hedging_kill_switch(quiet_health, monkeypatch):
+    slow_gate = threading.Event()
+
+    def slow_post(*args):
+        slow_gate.wait(1.0)
+        return 200, {"predict": ["ok"], "served_by": "slow"}, None
+
+    a = _StubReplica(name="slow", on_post=slow_post)
+    b = _StubReplica(name="fast")
+    table = {"keys": {"pm": ["s0", "s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        # default OFF: the slow primary is simply waited out
+        slow_gate.set()
+        code, out, _ = _post(url + "/3/Predictions/models/pm",
+                             headers={"X-H2O-SLO": "interactive"})
+        assert code == 200
+        assert router.snapshot()["stats"]["hedges"] == 0
+        # armed: the hedge fires after 30ms and the fast shard wins
+        slow_gate.clear()
+        monkeypatch.setenv("H2O_TPU_ROUTER_HEDGE_MS", "30")
+        code, out, _ = _post(url + "/3/Predictions/models/pm",
+                             headers={"X-H2O-SLO": "interactive"})
+        assert code == 200 and out["served_by"] == "fast"
+        st = router.snapshot()
+        assert st["stats"]["hedges"] == 1
+        assert st["stats"]["hedge_wins"] == 1
+        # hedges consume budget tokens (they are load amplification)
+        assert st["retry_budget"]["granted"] == 1
+        # non-interactive traffic never hedges
+        slow_gate.set()
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 200
+        assert router.snapshot()["stats"]["hedges"] == 1
+    finally:
+        slow_gate.set()
+        router.stop()
+        srv.shutdown()
+        a.close()
+        b.close()
+
+
+def test_router_hedge_armed_still_fails_over_fast_5xx(quiet_health,
+                                                      monkeypatch):
+    """Arming the hedge switch must never LOSE failover: a primary
+    that answers 5xx INSIDE the hedge window takes the sequential
+    path (cooldown + budget-gated retry) and the healthy replica
+    shard absorbs the request — not a relayed 5xx."""
+    monkeypatch.setenv("H2O_TPU_ROUTER_HEDGE_MS", "200")
+    a = _StubReplica(name="a", on_post=lambda *args: (
+        503, {"msg": "draining"}, {"Retry-After": "30"}))
+    b = _StubReplica(name="b")
+    table = {"keys": {"pm": ["s0", "s1"]},
+             "shards": {"s0": [a.url], "s1": [b.url]}}
+    srv, router, url = _router(table)
+    try:
+        code, out, _ = _post(url + "/3/Predictions/models/pm",
+                             headers={"X-H2O-SLO": "interactive"})
+        assert code == 200 and out["served_by"] == "b"
+        st = router.snapshot()
+        # the fast-failing primary never counts as a hedge, the
+        # failover is a normal budget-gated retry, and the 503's
+        # Retry-After cooldown was recorded (second request skips a)
+        assert st["stats"]["hedges"] == 0
+        assert st["stats"]["retries"] == 1
+        calls_a = len(a.posts)
+        code, out, _ = _post(url + "/3/Predictions/models/pm",
+                             headers={"X-H2O-SLO": "interactive"})
+        assert code == 200 and out["served_by"] == "b"
+        assert len(a.posts) == calls_a, "cooldown not honored"
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+        b.close()
+
+
+def test_sharded_pool_run_picks_up_added_and_removed_shards():
+    """A mid-run parent-spec shard-count change must start (and stop)
+    child reconciler threads: a shard added at runtime converges and
+    serves its tenants; a removed shard's child is retired."""
+    store, pool = _sharded_pool(shards=2, tenants=8)
+    stop = threading.Event()
+    t = threading.Thread(target=pool.run, args=(stop,),
+                         kwargs={"interval": 0.02}, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and not pool.converged():
+            time.sleep(0.05)
+        assert pool.converged()
+        # grow 2 -> 3: the new shard must get a running reconciler
+        # (pods spawned) and the pool reconverges on the new plan
+        store.apply_update("p", shards=3)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if "p-s2" in pool.recs and pool.converged() and \
+                    pool.plan.shards == ("p-s0", "p-s1", "p-s2"):
+                break
+            time.sleep(0.05)
+        assert "p-s2" in pool.recs, "added shard never materialized"
+        assert pool.converged(), store.get_status("p")
+        assert pool.recs["p-s2"].replicas, \
+            "added shard's reconciler thread never spawned pods"
+        table = pool.routing_table()
+        assert any("p-s2" in v for v in table["keys"].values())
+        # shrink 3 -> 2: the removed shard's child is retired and its
+        # tenants live in the re-derived plan of the survivors
+        store.apply_update("p", shards=2)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if "p-s2" not in pool.recs and pool.converged():
+                break
+            time.sleep(0.05)
+        assert "p-s2" not in pool.recs
+        assert pool.converged()
+        assert set(pool.plan.shards) == {"p-s0", "p-s1"}
+        covered = {k for sid in ("p-s0", "p-s1")
+                   for k in pool.plan.keys_for(sid)}
+        assert covered == set(pool.plan.assignments), \
+            "a tenant fell out of the catalog on shard removal"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        pool.shutdown(timeout=10)
+
+
+def test_router_contributions_route_passthrough(quiet_health):
+    a = _StubReplica(name="a")
+    table = {"keys": {"pm": ["s0"]}, "shards": {"s0": [a.url]}}
+    srv, router, url = _router(table)
+    try:
+        code, out, _ = _post(
+            url + "/3/Predictions/models/pm/contributions")
+        assert code == 200
+        assert a.posts[-1][0] == "/3/Predictions/models/pm/contributions"
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellites: registry push retry + shared probe helper
+# ---------------------------------------------------------------------------
+
+
+def test_registry_post_retries_transient_5xx(monkeypatch):
+    """Satellite: one flaky replica answer during a rollout push must
+    be absorbed by the runtime/retry backoff layer instead of
+    surfacing as load_failed; permanent 4xx still fails fast."""
+    from h2o_kubernetes_tpu.operator.registry import ModelRegistry
+
+    monkeypatch.setenv("H2O_TPU_RETRY_BASE", "0.01")
+    calls = {"n": 0}
+
+    def flaky(path, body, headers):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            return 503, {"msg": "warming"}, {"Retry-After": "0"}
+        return 200, {"ok": True}, None
+
+    stub = _StubReplica(on_post=flaky)
+    try:
+        out = ModelRegistry._post_json(stub.url, "/3/ModelRegistry/load",
+                                       {"model_id": "x"}, timeout=10.0)
+        assert out == {"ok": True} and calls["n"] == 3
+    finally:
+        stub.close()
+    # 400 = permanent: exactly one attempt, HTTPError propagates
+    calls400 = {"n": 0}
+
+    def bad(path, body, headers):
+        calls400["n"] += 1
+        return 400, {"msg": "unservable"}, None
+
+    stub = _StubReplica(on_post=bad)
+    try:
+        with pytest.raises(urllib.error.HTTPError):
+            ModelRegistry._post_json(stub.url, "/3/ModelRegistry/load",
+                                     {"model_id": "x"}, timeout=10.0)
+        assert calls400["n"] == 1
+    finally:
+        stub.close()
+    # connection refused (dead replica): retried, then raises IOError
+    calls = {"n": 0}
+    t0 = time.monotonic()
+    with pytest.raises(IOError):
+        ModelRegistry._post_json("http://127.0.0.1:9", "/x", {},
+                                 timeout=1.0)
+    assert time.monotonic() - t0 < 30
+
+
+def test_probe_json_shared_helper():
+    stub = _StubReplica(name="probe")
+    try:
+        out = probe_json(stub.url, "/3/Stats", retries=3)
+        assert out and out["ready"] is True
+    finally:
+        stub.close()
+    # dead endpoint: classified None quickly (refused = fast), after
+    # the full retry count
+    t0 = time.monotonic()
+    assert probe_json("http://127.0.0.1:9", "/3/Stats",
+                      retries=3) is None
+    assert time.monotonic() - t0 < 10

@@ -66,7 +66,7 @@ call — what a cold user pays, XLA compile included) and ``seconds``
 (steady state, compile cached; repeated until ≥1 s of measured work
 or 3 calls on the CPU mesh, single repeat on TPU where trains are
 long and chip windows are ~20 min). One JSON line per config + a
-trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r10.json`` at the
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r11.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -514,6 +514,43 @@ def main() -> int:
                unfair_tail_slo_met=mt["storm_unfair"]["tail_slo_met"],
                scorer_cache_final=mt["scorer_cache_final"])
 
+    if _want("router_zipf_p99"):
+        # config #5d (ISSUE 11): the tenant-sharded fleet router vs
+        # the everyone-has-everything pool at EQUAL total cache
+        # budget — the same Zipf tenant storm through (a) a 3-shard
+        # fleet behind the device-free front-door router (catalog
+        # rendezvous-placed, head replicated) and (b) a direct
+        # 3-replica pool where every replica holds the full catalog
+        # under the same per-replica byte budget. Real subprocess
+        # pods both ways; acceptance: router head-decile p99 within
+        # 1.3x of the direct baseline (the routing hop must be
+        # cheap), aggregate rows/s + tail-decile p99 recorded for
+        # both. See tools/score_load.run_router_bench.
+        from tools.score_load import run_router_bench
+
+        t0 = time.perf_counter()
+        rt = run_router_bench(
+            tenants=int(os.environ.get("BENCH_ROUTER_TENANTS", 120)),
+            shards=int(os.environ.get("BENCH_ROUTER_SHARDS", 3)),
+            head=int(os.environ.get("BENCH_ROUTER_HEAD", 8)),
+            budget_bytes=int(os.environ.get("BENCH_ROUTER_BUDGET",
+                                            2_000_000)),
+            seconds=float(os.environ.get("BENCH_ROUTER_SECONDS", 15)),
+            zipf_s=float(os.environ.get("BENCH_ROUTER_ZIPF_S", 1.1)))
+        dt = time.perf_counter() - t0
+        record("router_zipf_p99",
+               rt["router"]["head_p99_ms"] or 0.0, "p99_ms", dt, 1,
+               0.0, tenants=rt["tenants"], shards=rt["shards"],
+               head=rt["head"], budget_bytes=rt["budget_bytes"],
+               zipf_s=rt["zipf_s"],
+               router_leg=rt["router"], direct_leg=rt["direct"],
+               head_p99_ratio=rt["head_p99_ratio"],
+               head_p99_within_1_3x=rt["head_p99_within_1_3x"],
+               router_rows_per_s=rt["router"]["rows_per_s"],
+               direct_rows_per_s=rt["direct"]["rows_per_s"],
+               router_tail_p99_ms=rt["router"]["tail_p99_ms"],
+               direct_tail_p99_ms=rt["direct"]["tail_p99_ms"])
+
     if _want("gbm_wide_sparse"):
         # config #8 (ISSUE 8): Exclusive Feature Bundling on a >= 1k-
         # column one-hot-dominated CTR-style frame (docs/SCALING.md
@@ -646,7 +683,7 @@ def main() -> int:
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r10{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r11{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

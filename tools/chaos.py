@@ -67,6 +67,15 @@ Scenarios:
   up: respawns are backoff-spaced (provably >= the configured
   backoff), the rollout auto-rolls-back to last-good, old replicas
   stay READY throughout, zero 5xx.
+- ``router-shard-kill``  a 3-shard tenant-sharded fleet serves a
+  1000-tenant Zipf storm through the front-door router; one whole
+  shard is SIGKILLed mid-run: zero 5xx for the replicated head
+  tenants, the victim's tail tenants degrade to TYPED
+  placement_pending 503s while the reconciler re-places each onto a
+  surviving shard (targeted pushes) and then serve through the
+  survivors, per-replica scorer-cache bytes never exceed the budget,
+  every cross-shard retry is token-backed (budget never exceeded),
+  and re-enabling the shard reconverges the pool.
 """
 
 from __future__ import annotations
@@ -1319,6 +1328,364 @@ def scenario_poison_rollback() -> None:
                 os.environ[k] = v
 
 
+class _ShardedFixture:
+    """A converged tenant-SHARDED fleet (ISSUE 11): ``shards`` shard
+    groups of ``replicas_per_shard`` subprocess pods each, a
+    ``tenants``-key catalog rendezvous-placed across them (the first
+    ``head`` keys replicated on every shard), every pod under a
+    ``budget_bytes`` scorer-cache byte budget, and (optionally) the
+    device-free front-door router over the pool's routing table. A
+    handful of distinct base GBMs rotate across the tenant keys so
+    warm-ups are persistent-cache hits, exactly like the tenant-storm
+    fixture. ``shards=1`` degenerates to the everyone-has-everything
+    baseline pool (the router bench's direct leg)."""
+
+    def __init__(self, tag: str, tenants: int = 1000, shards: int = 3,
+                 head: int = 10, replicas_per_shard: int = 1,
+                 budget_bytes: int = 2_500_000, base_variants: int = 3,
+                 with_router: bool = True,
+                 startup_deadline: float = 600.0,
+                 warm_buckets: tuple = (128,)):
+        import shutil  # noqa: F401 — close() uses it
+
+        import numpy as np
+
+        import h2o_kubernetes_tpu as h2o
+        from h2o_kubernetes_tpu.models import GBM
+        from h2o_kubernetes_tpu.operator import (ModelRegistry,
+                                                 PoolStore,
+                                                 ScorerPoolSpec,
+                                                 ShardedPool,
+                                                 start_router)
+
+        # hundreds of sequential artifact pushes per shard replica:
+        # the stock 180s startup deadline is sized for a handful
+        self._env_saved = {"H2O_TPU_POOL_STARTUP_DEADLINE":
+                           os.environ.get(
+                               "H2O_TPU_POOL_STARTUP_DEADLINE")}
+        os.environ["H2O_TPU_POOL_STARTUP_DEADLINE"] = \
+            str(startup_deadline)
+        self.td = tempfile.mkdtemp(prefix=f"chaos_{tag}_")
+        rng = np.random.default_rng(0)
+        n = 400
+        cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+                for i in range(4)}
+        cols["y"] = np.where(cols["x0"] - cols["x1"] > 0, "late",
+                             "ontime")
+        self.feature_cols = [f"x{i}" for i in range(4)]
+        fr = h2o.Frame.from_arrays(cols)
+        self.registry = ModelRegistry(os.path.join(self.td,
+                                                   "registry"))
+        nv = max(1, min(base_variants, tenants))
+        arts = []
+        for b in range(nv):
+            m = GBM(ntrees=2 + b, max_depth=2, seed=b + 1).train(
+                y="y", training_frame=fr)
+            self.registry.publish(m, f"t{b}")
+            arts.append(f"t{b}")
+        self.tenant_keys = [f"m{i:03d}" for i in range(tenants)]
+        extra = tuple((arts[i % nv], 1, k)
+                      for i, k in enumerate(self.tenant_keys)
+                      if i > 0)
+        self.budget_bytes = budget_bytes
+        self.store = PoolStore()
+        self.store.apply(ScorerPoolSpec(
+            name="pool", artifact=arts[0], version=1,
+            model_key=self.tenant_keys[0],
+            replicas=replicas_per_shard, shards=shards,
+            head_models=max(1, min(head, tenants)), tail_replicas=1,
+            warm_buckets=tuple(warm_buckets), extra_artifacts=extra,
+            env={"H2O_TPU_SCORER_CACHE_BYTES": str(budget_bytes)}))
+        self.pool = ShardedPool(self.store, self.registry, "pool",
+                                log_dir=os.path.join(self.td, "logs"))
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self.pool.run, args=(self.stop,),
+            kwargs={"interval": 0.25}, daemon=True)
+        self.thread.start()
+        self.router_srv = None
+        self.router = None
+        self.router_url = None
+        try:
+            _check(self.pool.wait_converged(
+                timeout=startup_deadline + 120),
+                f"sharded pool never converged: "
+                f"{self.store.get_status('pool')} "
+                f"(pod logs under {self.td}/logs)")
+            if with_router:
+                self.router_srv, self.router = start_router(
+                    self.pool.routing_table)
+                self.router_url = ("http://127.0.0.1:"
+                                   f"{self.router_srv.server_address[1]}")
+        except BaseException:
+            # raising out of __init__ skips the drill's try/finally —
+            # tear the pods down here (logs kept for diagnosis)
+            self.close(keep_dir=True)
+            raise
+
+    def replica_urls(self) -> list:
+        urls = []
+        for rec in self.pool.recs.values():
+            with rec._lock:
+                urls.extend(r.url for r in rec.replicas
+                            if r.state != "DEAD")
+        return urls
+
+    def event_kinds(self) -> list:
+        return [e["kind"] for e in self.store.events("pool")]
+
+    def close(self, keep_dir: bool = False) -> None:
+        import shutil
+
+        try:
+            if self.router is not None:
+                self.router.stop()
+            if self.router_srv is not None:
+                self.router_srv.shutdown()
+                self.router_srv.server_close()
+        finally:
+            # stop the loop BEFORE tearing pods down: a live
+            # _replace_once pass would read the dying fleet as a mass
+            # shard-loss and spray shard_down events into the ring
+            self.stop.set()
+            self.thread.join(timeout=15)
+            try:
+                self.pool.shutdown(timeout=90)
+            finally:
+                for k, v in self._env_saved.items():
+                    os.environ.pop(k, None)
+                    if v is not None:
+                        os.environ[k] = v
+                if not keep_dir:
+                    shutil.rmtree(self.td, ignore_errors=True)
+
+
+def _score_via_router(url: str, key: str, body: dict,
+                      attempts: int = 6, sleep: float = 0.4):
+    """POST one scoring request through the router, retrying briefly
+    (re-placement pushes may still be landing); returns the last HTTP
+    status observed."""
+    import urllib.error
+    import urllib.request
+
+    code = None
+    for _ in range(attempts):
+        req = urllib.request.Request(
+            f"{url}/3/Predictions/models/{key}",
+            data=json.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.read()
+        except Exception:  # noqa: BLE001 — transport: retry
+            code = -1
+        time.sleep(sleep)
+    return code
+
+
+def scenario_router_shard_kill() -> None:
+    """The ISSUE-11 acceptance drill: a 3-shard fleet serving a
+    1000-tenant Zipf storm through the front-door router loses one
+    whole shard mid-run (SIGKILL + its capacity scaled to zero — the
+    node pool is gone). Contracts proven:
+
+    - ZERO 5xx for the replicated head tenants across the kill (the
+      router fails over inside the retry budget);
+    - the victim's tail tenants surface as TYPED degraded 503s
+      (placement_pending) — never raw 5xx lies — while the reconciler
+      re-places each one onto a surviving shard via a targeted push,
+      and every one of them scores 200 through the router WHILE the
+      home shard is still gone;
+    - per-replica scorer-cache ``resident_bytes`` never exceeds the
+      byte budget at any sampled instant;
+    - every cross-shard retry was token-backed (``retries ==
+      retry_budget.granted`` on the router's /3/Stats) and bounded —
+      a dying shard cannot amplify load onto survivors;
+    - re-enabling the shard's capacity reconverges the pool
+      (shard_down → tenant_replaced* → shard_recovered in events)."""
+    import signal
+
+    from tools.score_load import _get_json, _make_bodies, run_load_zipf
+
+    tenants = int(os.environ.get("H2O_TPU_DRILL_ROUTER_TENANTS",
+                                 "1000"))
+    head_n = 10
+    budget = 2_500_000
+    saved = {k: os.environ.get(k) for k in
+             ("H2O_TPU_ROUTER_RETRY_BUDGET",
+              "H2O_TPU_ROUTER_HEALTH_INTERVAL")}
+    # burst sized for the in-flight failover wave at the kill instant
+    # (the budget must bound amplification, not starve legitimate
+    # failover); sweeps fast so the ring reflects the kill quickly
+    os.environ["H2O_TPU_ROUTER_RETRY_BUDGET"] = "20"
+    os.environ["H2O_TPU_ROUTER_HEALTH_INTERVAL"] = "0.25"
+    fx = _ShardedFixture("rshard", tenants=tenants, shards=3,
+                         head=head_n, budget_bytes=budget)
+    try:
+        head_keys = fx.tenant_keys[:head_n]
+
+        # live residency watcher over every pod (budget contract is
+        # "never exceeded WHILE the storm runs", sampled, not final)
+        resid = {"samples": 0, "max": 0, "exceeded": 0}
+        watch_stop = threading.Event()
+
+        def watcher():
+            while not watch_stop.is_set():
+                for u in fx.replica_urls():
+                    st = _get_json(u + "/3/Stats", timeout=2.0)
+                    sc = (st or {}).get("scorer_cache") or {}
+                    rb = int(sc.get("resident_bytes") or 0)
+                    if st:
+                        resid["samples"] += 1
+                        resid["max"] = max(resid["max"], rb)
+                        if rb > budget:
+                            resid["exceeded"] += 1
+                watch_stop.wait(0.5)
+
+        wt = threading.Thread(target=watcher, daemon=True)
+        wt.start()
+
+        storm_out: dict = {}
+        storm_stop = threading.Event()
+
+        def storm():
+            storm_out.update(run_load_zipf(
+                [fx.router_url], fx.tenant_keys, fx.feature_cols,
+                concurrency=6, rows_per_request=8, seconds=30.0,
+                zipf_s=1.1, seed=0, router=True,
+                stop_event=storm_stop))
+
+        st_thread = threading.Thread(target=storm, daemon=True)
+        st_thread.start()
+        time.sleep(6.0)                    # storm established
+
+        # victim: any shard that uniquely holds tail tenants
+        victim = next(sid for sid in fx.pool.recs
+                      if set(fx.pool.plan.keys_for(sid))
+                      - set(head_keys))
+        orphans = sorted(set(fx.pool.plan.keys_for(victim))
+                         - set(head_keys))
+        _check(len(orphans) >= max(2, (tenants - head_n) // 10),
+               f"victim shard {victim} holds only {len(orphans)} tail "
+               "tenants — fixture shape wrong")
+        vrec = fx.pool.recs[victim]
+        with vrec._lock:
+            victims = list(vrec.replicas)
+        for r in victims:
+            if r.pid():
+                try:
+                    os.kill(r.pid(), signal.SIGKILL)
+                except OSError:
+                    pass
+        # the node pool behind the shard is GONE: no capacity to
+        # respawn into until recovery is re-enabled below
+        fx.store.apply_update(victim, replicas=0)
+
+        # the event ring is BOUNDED (256): ~330 tenant_replaced
+        # events will evict the earlier shard_down entry, so the
+        # event contract is checked against an incremental union of
+        # snapshots, not one final read
+        seen_kinds: set = set()
+
+        # the reconciler re-places every orphan via targeted pushes
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            seen_kinds.update(fx.event_kinds())
+            if all(k in fx.pool.overrides for k in orphans):
+                break
+            time.sleep(0.5)
+        missing = [k for k in orphans if k not in fx.pool.overrides]
+        _check(not missing,
+               f"{len(missing)}/{len(orphans)} tail tenants never "
+               f"re-placed (sample {missing[:5]}): "
+               f"{fx.store.get_status('pool')}")
+
+        # every orphan serves through the router off a SURVIVOR while
+        # the home shard is still dead
+        _check(not fx.pool.shard_healthy(victim),
+               "victim shard resurrected before re-placement was "
+               "verified — drill invalid")
+        body = _make_bodies(fx.feature_cols, 4, seed=1, pool=1)[0]
+        failed = []
+        for k in orphans:
+            code = _score_via_router(fx.router_url, k, body)
+            if code != 200:
+                failed.append((k, code))
+        _check(not failed,
+               f"{len(failed)} re-placed tenants not serving via "
+               f"survivors (sample {failed[:5]})")
+
+        storm_stop.set()
+        st_thread.join(timeout=120)
+        watch_stop.set()
+        wt.join(timeout=10)
+
+        _check(storm_out.get("requests", 0) > 200,
+               f"Zipf storm barely ran: {storm_out}")
+        _check(storm_out["errors"] == 0,
+               f"client transport errors during the storm: "
+               f"{storm_out['error_sample']}")
+        head_5xx = sum(storm_out["by_model"][k]["fivexx"]
+                       for k in head_keys)
+        _check(head_5xx == 0,
+               f"{head_5xx} 5xx on replicated HEAD tenants across the "
+               f"shard kill: {storm_out['fivexx_sample']}")
+        _check(storm_out.get("degraded", 0) > 0,
+               "no typed degraded 503 observed — the kill window "
+               "never exercised degraded mode (storm/kill timing "
+               "broken)")
+        _check(resid["samples"] > 10, "residency watcher never ran")
+        _check(resid["exceeded"] == 0 and resid["max"] <= budget,
+               f"scorer-cache resident bytes exceeded the "
+               f"{budget}B budget: {resid}")
+
+        rst = _get_json(fx.router_url + "/3/Stats", timeout=5.0)
+        _check(rst is not None, "router /3/Stats unreachable")
+        rstats, rbudget = rst["stats"], rst["retry_budget"]
+        _check(rstats["retries"] == rbudget["granted"],
+               f"cross-shard retries not token-backed: {rstats} "
+               f"{rbudget}")
+        _check(rstats["retries"] <= 200,
+               f"retry amplification past the budget's intent: "
+               f"{rstats}")
+        _check(rstats["degraded_503"] > 0,
+               f"router never served the typed degraded 503: {rstats}")
+
+        # recovery: capacity returns, the shard reloads its catalog
+        # and the pool reconverges
+        fx.store.apply_update(victim, replicas=1)
+        _check(fx.pool.wait_converged(timeout=600),
+               f"pool never reconverged after shard recovery: "
+               f"{fx.store.get_status('pool')}")
+        code = _score_via_router(fx.router_url, orphans[0], body)
+        _check(code == 200,
+               f"native tenant not serving after shard recovery "
+               f"(HTTP {code})")
+        # the recovery event lands on the loop's NEXT replace pass —
+        # poll briefly instead of racing it
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            seen_kinds.update(fx.event_kinds())
+            if "shard_recovered" in seen_kinds:
+                break
+            time.sleep(0.25)
+        seen_kinds.update(fx.event_kinds())
+        for needed in ("shard_down", "tenant_replaced",
+                       "shard_recovered"):
+            _check(needed in seen_kinds,
+                   f"event '{needed}' missing from the pool's event "
+                   f"log: {sorted(seen_kinds)}")
+    finally:
+        fx.close()
+        for k, v in saved.items():
+            os.environ.pop(k, None)
+            if v is not None:
+                os.environ[k] = v
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -1334,6 +1701,7 @@ SCENARIOS = {
     "tenant-storm": scenario_tenant_storm,
     "operator-restart": scenario_operator_restart,
     "poison-rollback": scenario_poison_rollback,
+    "router-shard-kill": scenario_router_shard_kill,
 }
 
 
