@@ -29,6 +29,9 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_EFB_CONFLICT | 0 | allowed conflict-ROW fraction per bundle (LightGBM max_conflict_rate analog); 0 = exact exclusivity, the parity-gated default |
 | H2O_TPU_EFB_MIN_F | 64 | feature-count floor below which auto mode skips EFB planning entirely (narrow frames keep the fused no-host-sync prologue) |
 | H2O_TPU_EFB_MIN_SHRINK | 0.75 | auto mode keeps a plan only when bundled width Fb <= this fraction of F |
+| H2O_TPU_GOSS | 0 (off) | GOSS gradient-based one-side sampling for the boosted-tree growers (GBM + XGBoost-hist; DRF stays bagged): per round keep the top-TOP_A row fraction by \|gradient\| + a seeded RAND_B fraction of the rest amplified by (1-a)/b, compacted into a static buffer so histogram kernels stream ~(a+b)·rows per level; 0 restores unsampled training bit-for-bit (models/gbm.goss_params, docs/SCALING.md "Gradient-based sampling") |
+| H2O_TPU_GOSS_TOP_A | 0.1 | GOSS: fraction of rows kept outright by top \|gradient\| rank (0 <= a < 1, a + b <= 1) |
+| H2O_TPU_GOSS_RAND_B | 0.1 | GOSS: seeded random fraction of the remaining rows kept with (1-a)/b weight amplification (0 < b, a + b <= 1) |
 | H2O_TPU_OOC | auto | out-of-core tree training: 1 force, 0 never, auto = binned matrix past the budget headroom (models/gbm, docs/SCALING.md) |
 | H2O_TPU_OOC_CHUNK_ROWS | derived | rows per host-pinned binned chunk in out-of-core mode (models/tree/ooc) |
 | H2O_TPU_OOC_RESIDENT | 0 | debug: keep out-of-core chunks device-resident (the bitwise streamed-vs-resident parity harness) |

@@ -39,7 +39,7 @@ from .tree.core import (BoostParams, FlatTrees, Tree, TreeParams,
                         _grad_hess, boost_trees, boost_trees_drf,
                         boost_trees_multi, descend_tree, drf_group_size,
                         flat_margin, flatten_cover, flatten_trees,
-                        predict_tree)
+                        goss_round_keys, predict_tree)
 
 
 @dataclass
@@ -100,14 +100,37 @@ def _make_tree_params(p: "GBMParams", distribution: str) -> TreeParams:
                                  distribution in _UNIT_HESS_DISTS))
 
 
+def goss_params(p: "GBMParams", distribution: str) -> tuple[float, float]:
+    """(top_a, rand_b) of GOSS gradient-based one-side sampling
+    (arXiv:1809.04559) — (0.0, 0.0) when off. THE one env reader:
+    H2O_TPU_GOSS=1 activates it for the boosted-tree growers (GBM +
+    XGBoost-hist pointwise objectives); DRF stays bagged/unsampled and
+    the lambdarank host loop is excluded. Knobs are read at train
+    time, so AutoML plan entries and CV folds inherit them uniformly."""
+    if p._drf_mode or distribution.startswith("rank:"):
+        return 0.0, 0.0
+    if os.environ.get("H2O_TPU_GOSS", "0") != "1":
+        return 0.0, 0.0
+    a = float(os.environ.get("H2O_TPU_GOSS_TOP_A", "0.1"))
+    b = float(os.environ.get("H2O_TPU_GOSS_RAND_B", "0.1"))
+    if not (0.0 <= a < 1.0 and 0.0 < b <= 1.0 and a + b <= 1.0):
+        raise ValueError(
+            f"bad GOSS knobs: H2O_TPU_GOSS_TOP_A={a} / "
+            f"H2O_TPU_GOSS_RAND_B={b} — need 0 <= a < 1, 0 < b, "
+            "a + b <= 1")
+    return a, b
+
+
 def _make_boost_params(p: "GBMParams", distribution: str) -> BoostParams:
     """The BoostParams twin of _make_tree_params (same no-drift rule)."""
+    goss_a, goss_b = goss_params(p, distribution)
     return BoostParams(
         distribution=distribution,
         learn_rate=1.0 if p._drf_mode else p.learn_rate,
         sample_rate=p.sample_rate,
         col_sample_rate_per_tree=p.col_sample_rate_per_tree,
-        drf_mode=p._drf_mode)
+        drf_mode=p._drf_mode,
+        goss_a=goss_a, goss_b=goss_b)
 
 
 def _chunk_sizes(p: "GBMParams", padded: int, F: int, K: int,
@@ -564,6 +587,20 @@ class GBM:
         key = jax.random.key(p.seed)
         F = len(data.feature_names)
 
+        # GOSS (H2O_TPU_GOSS): validated up front so a bad knob or a
+        # conflicting sample_rate fails before any binning work; the
+        # per-round key stream is derived OUTSIDE the dispatch-chunk
+        # key schedule (goss_round_keys) so the fused in-HBM path and
+        # the ooc stream draw identical keep patterns at one seed
+        goss_a, goss_b = goss_params(p, data.distribution)
+        if goss_b > 0 and p.sample_rate < 1.0:
+            raise ValueError(
+                "H2O_TPU_GOSS replaces row subsampling — train with "
+                f"sample_rate=1.0 (got {p.sample_rate}) or disable "
+                "the GOSS knob")
+        goss_keys = goss_round_keys(key, p.ntrees) if goss_b > 0 \
+            else None
+
         # Exclusive Feature Bundling (models/tree/efb.py,
         # docs/SCALING.md "Wide sparse frames"): on wide frames
         # dominated by one-hot / near-empty columns, mutually
@@ -751,13 +788,15 @@ class GBM:
                 cks = make_chunks(training_frame, bin_spec, data.y,
                                   data.w, margin, ooc_chunk,
                                   plan=efb_plan)
-                margin_np, trees = boost_trees_chunked(
-                    cks, key, p.ntrees, tp, bp, efb=efb)
+                margin_np, trees, goss_dropped = boost_trees_chunked(
+                    cks, key, p.ntrees, tp, bp, efb=efb,
+                    goss_keys=goss_keys)
+            _warn_goss_overflow(goss_dropped)
             margin = shard_rows(margin_np)
         else:
             trees, margin, history = self._boost_in_hbm(
                 p, tp, bp, data, binned, margin, key, K, F_eff, ckpt,
-                start_t, history, efb=efb)
+                start_t, history, efb=efb, goss_keys=goss_keys)
         if isinstance(init, jax.Array):
             # read the device init back AFTER the boost chunks are
             # enqueued (async dispatch: this blocks only on the tiny
@@ -800,11 +839,15 @@ class GBM:
             validation_frame)
 
     def _boost_in_hbm(self, p, tp, bp, data, binned, margin, key, K, F,
-                      ckpt, start_t, history, efb=None):
+                      ckpt, start_t, history, efb=None, goss_keys=None):
         """The fused in-HBM boosting loop (all rows device-resident).
         ``F`` is the HISTOGRAM width (the bundled width under EFB) —
-        it sizes the dispatch-budget chunks to the actual work."""
+        it sizes the dispatch-budget chunks to the actual work.
+        ``goss_keys`` ([ntrees] rows, indexed by GLOBAL tree number)
+        is sliced per dispatch chunk so the per-round GOSS draw never
+        depends on the _DISPATCH_BUDGET chunk schedule."""
         chunks: list[Tree] = [] if ckpt is None else [ckpt.trees]
+        goss_overflow: list = []      # per-dispatch device scalars
         # cap ONE compiled dispatch's work: the TPU worker (behind
         # its RPC deadline) kills executions that run for minutes —
         # observed: 25 depth-12 trees on 1M rows crash the worker,
@@ -828,6 +871,7 @@ class GBM:
             # device error instead surfaces at the metrics/model read
             # and is escalated to the same locked-cloud failure by
             # AutoML's step_failed device-error check
+            gk = None if goss_keys is None else goss_keys[t: t + n]
             with device_dispatch("gbm boost dispatch"):
                 if K == 1 and p._drf_mode:
                     # independent forest trees grow in vmapped GROUPS
@@ -837,13 +881,19 @@ class GBM:
                         binned, data.y, data.w, margin, kc, n, tp, bp,
                         efb=efb)
                 elif K == 1:
-                    margin, tchunk = boost_trees(
+                    out = boost_trees(
                         binned, data.y, data.w, margin, kc, n, tp, bp,
-                        efb=efb)
+                        efb=efb, goss_keys=gk)
+                    margin, tchunk = out[0], out[1]
+                    if gk is not None:
+                        goss_overflow.append(out[2])
                 else:
-                    margin, tchunk = boost_trees_multi(
+                    out = boost_trees_multi(
                         binned, data.y, data.w, margin, kc, n, K, tp,
-                        bp, efb=efb)
+                        bp, efb=efb, goss_keys=gk)
+                    margin, tchunk = out[0], out[1]
+                    if gk is not None:
+                        goss_overflow.append(out[2])
                     # [n, K, ...] -> interleaved [n*K, ...] (class
                     # fastest), the layout _margins de-interleaves with
                     # a[k::K]
@@ -857,6 +907,9 @@ class GBM:
         trees = jax.tree.map(
             lambda *xs: jnp.concatenate(xs), *chunks) \
             if len(chunks) > 1 else chunks[0]
+        if goss_overflow:
+            _warn_goss_overflow(
+                int(sum(int(jax.device_get(o)) for o in goss_overflow)))
         return trees, margin, history
 
     # -- compile-ahead (runtime/scheduler.py) ---------------------------
@@ -921,7 +974,13 @@ class GBM:
             return []       # the lambdarank host loop, not this path
         K = nclasses if nclasses > 2 else 1
         tp = _make_tree_params(p, dist)
-        bp = _make_boost_params(p, dist)
+        try:
+            bp = _make_boost_params(p, dist)
+        except ValueError:
+            return []       # bad GOSS knobs: train() raises, on the
+            #                 driver thread with the real message
+        if bp.goss_b > 0 and p.sample_rate < 1.0:
+            return []       # train() rejects the combination up front
         hist_bytes = level_hist_bytes(tp, len(names))
         if K > 1 and multi_grow_vmapped(tp, len(names), K):
             hist_bytes *= K
@@ -996,17 +1055,46 @@ class GBM:
                     thunks.append(functools.partial(
                         _aot, _core._boost_drf_jit, binned_s, row_s,
                         row_s, margin_s, keys_s, None, tp, bp, G, mesh))
-                elif K == 1:
-                    keys_s = jax.ShapeDtypeStruct((nt,), keydt)
+                    continue
+                keys_s = jax.ShapeDtypeStruct((nt,), keydt)
+                if bp.goss_b > 0:
+                    # GOSS scans a (round keys, goss keys) pair —
+                    # mirror boost_trees' operand structure exactly
+                    keys_s = (keys_s,
+                              jax.ShapeDtypeStruct((nt,), keydt))
+                if K == 1:
                     thunks.append(functools.partial(
                         _aot, _core._boost_jit, binned_s, row_s, row_s,
                         margin_s, keys_s, None, tp, bp, mesh))
                 else:
-                    keys_s = jax.ShapeDtypeStruct((nt,), keydt)
                     thunks.append(functools.partial(
                         _aot, _core._boost_multi_jit, binned_s, row_s,
                         row_s, margin_s, keys_s, None, tp, bp, K, mesh))
         return thunks
+
+
+def _warn_goss_overflow(dropped: int) -> None:
+    """Loud (never silent) notice that GOSS compaction truncated: the
+    static per-shard capacity is sized for the EXPECTED a+b selected
+    fraction, but a frame whose row ORDER correlates with |gradient|
+    (sorted by target or residual) can cluster far more selected rows
+    into one shard — and the truncated rows are exactly the
+    high-gradient ones GOSS exists to keep (it also breaks the
+    in-HBM↔ooc same-seed equivalence, since the two layouts truncate
+    different segments). The model still trains; the operator should
+    shuffle the rows or raise a+b."""
+    if dropped <= 0:
+        return
+    from ..diagnostics import log
+
+    log.warning(
+        "GOSS compaction overflow: %d selected row contributions were "
+        "dropped because one or more shards selected more rows than "
+        "the static capacity (sized for the expected a+b fraction). "
+        "The row order likely correlates with |gradient| — shuffle "
+        "the training frame, or raise H2O_TPU_GOSS_TOP_A/"
+        "H2O_TPU_GOSS_RAND_B so the capacity covers the clustering.",
+        dropped)
 
 
 def _aot(jitted, *args) -> None:

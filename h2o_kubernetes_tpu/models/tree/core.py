@@ -450,6 +450,15 @@ class BoostParams(NamedTuple):
     drf_mode: bool = False
     quantile_alpha: float = 0.5     # quantile distribution's τ
     huber_alpha: float = 0.9        # huber δ = this quantile of |resid|
+    # GOSS (gradient-based one-side sampling, arXiv:1809.04559):
+    # goss_b > 0 activates it — keep the top-`goss_a` fraction of rows
+    # by |gradient| plus a seeded `goss_b` fraction of the rest,
+    # amplified by (1-a)/b so split gains stay unbiased. 0.0 = off
+    # (the H2O_TPU_GOSS kill-switch path traces byte-identically to a
+    # build without the feature). models/gbm.goss_params is the ONE
+    # env reader.
+    goss_a: float = 0.0
+    goss_b: float = 0.0
 
 
 def _boost_grad_hess(bp: BoostParams, margin, y, w):
@@ -497,6 +506,165 @@ def _round_sampling(bp: BoostParams, w, F: int, k_row, k_col):
     return w_t, col_mask
 
 
+# ---------------------------------------------------------------------------
+# GOSS — gradient-based one-side sampling (arXiv:1809.04559)
+# ---------------------------------------------------------------------------
+#
+# Per boosting round, keep the top-`a` fraction of rows by |gradient|
+# outright plus a seeded random draw of the rest, and amplify every
+# sampled small-gradient row's (g·w, h·w, w) histogram contribution by
+# (1-a)/b so split gains stay unbiased. Everything below is STATIC
+# SHAPE: the selected rows are compacted per shard into a fixed-
+# capacity buffer (goss_cap_rows) and only THAT buffer streams through
+# the per-level histogram kernels — the 3-5× row reduction is real
+# compute, not just masking; unfilled slots carry w=0 and contribute
+# nothing (the same dead-row discipline as the rel == -1 mask).
+#
+# Layout invariance (the in-HBM mesh layout and the ooc chunk grid
+# must select the SAME rows at the same seed, or the two paths would
+# train different models): every per-row decision is a pure function
+# of (a) GLOBAL ranking stats that are exactly associative — the max
+# of |g| and an int32 count histogram of |g| bins, both order-
+# independent under psum / cross-chunk adds — and (b) a per-row
+# threefry hash of (round key, GLOBAL row id). No sort, no per-shard
+# quantile, no draw whose value depends on how rows are sharded.
+#
+# Tie handling: the top set is "bins strictly above the threshold bin
+# T" plus a per-row hash draw with probability frac_T inside bin T, so
+# the kept-outright fraction hits `a` in expectation even when |g| is
+# massively tied (round-1 bernoulli has exactly two |g| values). Rows
+# that lose the bin-T draw fall through to the random-`b` rule, so
+# every row's expected weight is exactly its true weight:
+#   bin > T:   1
+#   bin == T:  frac_T·1 + (1-frac_T)·q·amp = frac_T + (1-frac_T) = 1
+#   bin < T:   q·amp = 1          (q = b/(1-a), amp = (1-a)/b = 1/q)
+
+_GOSS_BINS = 2048       # |g|-ranking histogram resolution
+_GOSS_SLACK = 1.25      # compaction capacity over the expected a+b rows
+_GOSS_KEY_TAG = 0x9055  # fold_in tag of the path-invariant key stream
+
+
+def goss_round_keys(key, n_trees: int):
+    """Per-round GOSS key stream, derived from the estimator seed key
+    OUTSIDE the per-dispatch key schedule — the fused in-HBM chunks
+    and the ooc stream index it by global tree number, so both paths
+    draw identical per-row keep patterns at the same seed."""
+    return jax.random.split(jax.random.fold_in(key, _GOSS_KEY_TAG),
+                            n_trees)
+
+
+def goss_cap_rows(rows: int, a: float, b: float) -> int:
+    """Static per-shard capacity of the compacted row buffer: the
+    expected selected fraction is exactly a+b (see the tie-handling
+    note above), so 1.25× slack + a 64-row floor absorbs the binomial
+    fluctuation at any realistic shard size. Overflow (possible only
+    far past the slack) drops the latest selected rows of the segment
+    — a documented approximation, never an error."""
+    cap = int(rows * (a + b) * _GOSS_SLACK) + 64
+    cap = -(-cap // 8) * 8
+    return min(rows, cap)
+
+
+def goss_rank_stat(g, w):
+    """Per-row |gradient| ranking stat masked to live (w>0) rows;
+    multi-output [K, rows] gradients rank by the class L1 norm."""
+    absg = jnp.abs(g) if g.ndim == 1 else jnp.sum(jnp.abs(g), axis=0)
+    return jnp.where(w > 0, absg, 0.0)
+
+
+def _goss_bin_ids(absg, m):
+    scale = _GOSS_BINS / jnp.maximum(m, 1e-30)
+    return jnp.clip((absg * scale).astype(jnp.int32), 0, _GOSS_BINS - 1)
+
+
+def goss_local_counts(absg, live, m):
+    """(int32 [GOSS_BINS] counts, int32 live count) for this segment —
+    integer sums are exactly associative, so psum over shards and adds
+    over ooc chunks give the SAME global histogram in any order."""
+    bins = _goss_bin_ids(absg, m)
+    counts = jnp.zeros(_GOSS_BINS, jnp.int32).at[bins].add(
+        live.astype(jnp.int32))
+    return counts, jnp.sum(live.astype(jnp.int32))
+
+
+def goss_threshold(counts, total, a: float):
+    """(T, frac_T) from the GLOBAL count histogram: rows in bins > T
+    are kept outright; a row in bin T is kept outright when its hash
+    draw lands under frac_T — together the top-`a` fraction in
+    expectation, whatever the tie structure."""
+    suffix = jnp.cumsum(counts[::-1])[::-1].astype(jnp.float32)
+    k_top = jnp.float32(a) * total.astype(jnp.float32)
+    T = jnp.sum((suffix >= k_top).astype(jnp.int32)) - 1
+    T = jnp.clip(T, 0, _GOSS_BINS - 1)
+    cnt_T = counts[T].astype(jnp.float32)
+    above = suffix[T] - cnt_T                  # count(bin > T)
+    frac = jnp.clip((k_top - above) / jnp.maximum(cnt_T, 1.0), 0.0, 1.0)
+    return T, frac
+
+
+def goss_row_factor(absg, live, m, T, frac_T, kg, row_ids,
+                    a: float, b: float):
+    """f32 per-row GOSS weight factor in {0, 1, (1-a)/b}. The two
+    uniforms per row come from a threefry hash of (kg, global row id)
+    — layout-invariant by construction."""
+    q = b / (1.0 - a)              # rest-row keep probability
+    amp = (1.0 - a) / b            # rest-row amplification = 1/q
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(kg, row_ids)
+    u = jax.vmap(lambda k: jax.random.uniform(k, (2,)))(keys)
+    bins = _goss_bin_ids(absg, m)
+    top = (bins > T) | ((bins == T) & (u[:, 0] < frac_T))
+    factor = jnp.where(top, jnp.float32(1.0),
+                       jnp.where(u[:, 1] < q, jnp.float32(amp),
+                                 jnp.float32(0.0)))
+    return jnp.where(live, factor, 0.0)
+
+
+def goss_amplified_w(g, w, kg, bp: BoostParams):
+    """Runs UNDER shard_map (the in-HBM fused path): global ranking
+    stats via pmax/psum over ROWS, then the per-row amplified weight
+    w·factor for this shard's rows."""
+    a, b = bp.goss_a, bp.goss_b
+    absg = goss_rank_stat(g, w)
+    live = w > 0
+    m = lax.pmax(jnp.max(absg), ROWS)
+    counts, nlive = goss_local_counts(absg, live, m)
+    counts = lax.psum(counts, ROWS)
+    total = lax.psum(nlive, ROWS)
+    T, frac = goss_threshold(counts, total, a)
+    rows_local = w.shape[0]
+    row_ids = lax.axis_index(ROWS) * rows_local + \
+        jnp.arange(rows_local, dtype=jnp.int32)
+    return w * goss_row_factor(absg, live, m, T, frac, kg, row_ids,
+                               a, b)
+
+
+def goss_compact(binned, g, h, w_amp, cap: int):
+    """Per-shard static-capacity compaction of the selected
+    (w_amp > 0) rows, in ascending row order. Unfilled slots gather
+    row 0 with w=0 — zero histogram contribution, exactly the dead-row
+    semantics of the rel == -1 mask. g may be [rows] or [K, rows].
+
+    Returns (binned, g, h, w, dropped): ``dropped`` is this segment's
+    overflow count max(nsel - cap, 0) — the cap is sized for the
+    EXPECTED a+b fraction, but the top-a set follows the data layout,
+    so a frame whose row ORDER correlates with |gradient| (sorted by
+    target/residual) can cluster far more than (a+b)·rows into one
+    shard. The count is psum'd/summed by the callers and surfaced as
+    a loud warning (models/gbm) — a silent drop of exactly the
+    highest-gradient rows must never be silent."""
+    sel = w_amp > 0
+    idx = jnp.nonzero(sel, size=cap, fill_value=0)[0].astype(jnp.int32)
+    nsel = jnp.sum(sel.astype(jnp.int32))
+    valid = jnp.arange(cap, dtype=jnp.int32) < nsel
+    wC = jnp.where(valid, w_amp[idx], 0.0)
+    if g.ndim == 1:
+        gC, hC = g[idx], h[idx]
+    else:
+        gC, hC = g[:, idx], h[:, idx]
+    dropped = jnp.maximum(nsel - cap, 0)
+    return binned[idx], gC, hC, wC, dropped
+
+
 def _boost_shard(binned, y, w, margin, keys, efb=None, *,
                  p: TreeParams, bp: BoostParams):
     """Scan over trees INSIDE one shard_map: grad/hess → grow → local
@@ -509,14 +677,33 @@ def _boost_shard(binned, y, w, margin, keys, efb=None, *,
     tree.
     """
     F = efb.feat_col.shape[0] if efb is not None else binned.shape[1]
+    goss = bp.goss_b > 0.0
 
     def body(margin, kt):
+        if goss:
+            kt, kg = kt
         k_row, k_col, k_tree = jax.random.split(kt, 3)
         w_t, col_mask = _round_sampling(bp, w, F, k_row, k_col)
         if bp.drf_mode:
             g, h = -y, jnp.ones_like(y)
         else:
             g, h = _boost_grad_hess(bp, margin, y, w)
+        if goss:
+            # GOSS: amplified weights → static-cap compaction → the
+            # grower streams only the sampled rows. The margin update
+            # re-descends the FULL binned matrix through the grown
+            # tree (the grower's leaf walk only covers sampled rows).
+            w_amp = goss_amplified_w(g, w_t, kg, bp)
+            cap = goss_cap_rows(binned.shape[0], bp.goss_a, bp.goss_b)
+            bC, gC, hC, wC, dropped = goss_compact(binned, g, h,
+                                                   w_amp, cap)
+            tree, _ = _grow_tree_shard(bC, gC, hC, wC, col_mask,
+                                       k_tree, p, efb)
+            tree = tree._replace(value=bp.learn_rate * tree.value)
+            if not bp.drf_mode:
+                margin = margin + tree.value[descend_tree(
+                    tree, binned, p.max_depth, p.n_bins, efb)]
+            return margin, (tree, lax.psum(dropped, ROWS))
         tree, leaf = _grow_tree_shard(binned, g, h, w_t, col_mask,
                                       k_tree, p, efb)
         tree = tree._replace(value=bp.learn_rate * tree.value)
@@ -526,6 +713,9 @@ def _boost_shard(binned, y, w, margin, keys, efb=None, *,
             margin = margin + tree.value[leaf]
         return margin, tree
 
+    if goss:
+        margin, (trees, dropped) = lax.scan(body, margin, keys)
+        return margin, trees, jnp.sum(dropped)
     margin, trees = lax.scan(body, margin, keys)
     return margin, trees
 
@@ -566,8 +756,11 @@ def _boost_shard_multi(binned, y, w, margin, keys, efb=None, *,
     trees of an iteration from shared softmax probs (SURVEY.md §3.4).
     """
     F = efb.feat_col.shape[0] if efb is not None else binned.shape[1]
+    goss = bp.goss_b > 0.0
 
     def body(margin, kt):
+        if goss:
+            kt, kg = kt
         k_row, k_col, k_tree = jax.random.split(kt, 3)
         # one row-sample per ROUND, shared by its K trees (the
         # reference samples per iteration, not per class tree)
@@ -582,8 +775,20 @@ def _boost_shard_multi(binned, y, w, margin, keys, efb=None, *,
             probs = jax.nn.softmax(margin, axis=1)
             g = (probs - yk).T                           # [K, rows]
             h = (probs * (1.0 - probs)).T
+        if goss:
+            # one GOSS draw per ROUND (rows ranked by the class-L1
+            # gradient norm), shared by its K class trees — the same
+            # per-iteration discipline as the row sample above
+            w_amp = goss_amplified_w(g, w_t, kg, bp)
+            cap = goss_cap_rows(binned.shape[0], bp.goss_a, bp.goss_b)
+            bC, gC, hC, wC, dropped = goss_compact(binned, g, h,
+                                                   w_amp, cap)
+        else:
+            bC, gC, hC, wC = binned, g, h, None
+
         def grow_one(gk, hk, kk):
-            return _grow_tree_shard(binned, gk, hk, w_t, col_mask, kk,
+            return _grow_tree_shard(bC, gk, hk,
+                                    wC if goss else w_t, col_mask, kk,
                                     p, efb)
 
         keys_k = jax.random.split(k_tree, K)
@@ -595,15 +800,26 @@ def _boost_shard_multi(binned, y, w, margin, keys, efb=None, *,
         # also means bundling buys back the K-vmapped growth on wide
         # sparse frames
         if multi_grow_vmapped(p, binned.shape[1], K):
-            trees, leaf = jax.vmap(grow_one)(g, h, keys_k)
+            trees, leaf = jax.vmap(grow_one)(gC, hC, keys_k)
         else:
-            trees, leaf = lax.map(lambda a: grow_one(*a), (g, h, keys_k))
+            trees, leaf = lax.map(lambda a: grow_one(*a),
+                                  (gC, hC, keys_k))
         trees = trees._replace(value=bp.learn_rate * trees.value)
         if not bp.drf_mode:
-            upd = jax.vmap(lambda v, lf: v[lf])(trees.value, leaf)
+            if goss:
+                # sampled grow → full-row leaf values by re-descent
+                upd = jax.vmap(lambda tr: tr.value[descend_tree(
+                    tr, binned, p.max_depth, p.n_bins, efb)])(trees)
+            else:
+                upd = jax.vmap(lambda v, lf: v[lf])(trees.value, leaf)
             margin = margin + upd.T
+        if goss:
+            return margin, (trees, lax.psum(dropped, ROWS))
         return margin, trees
 
+    if goss:
+        margin, (trees, dropped) = lax.scan(body, margin, keys)
+        return margin, trees, jnp.sum(dropped)
     margin, trees = lax.scan(body, margin, keys)
     return margin, trees
 
@@ -704,21 +920,29 @@ def boost_trees_drf(binned, y, w, margin, key, n_trees: int,
 @functools.partial(jax.jit, static_argnums=(6, 7, 8, 9))
 def _boost_multi_jit(binned, y, w, margin, keys, efb, p: TreeParams,
                      bp: BoostParams, K: int, mesh):
+    out_specs = (P(ROWS), P(), P()) if bp.goss_b > 0 \
+        else (P(ROWS), P())
     fn = jax.shard_map(
         functools.partial(_boost_shard_multi, p=p, bp=bp, K=K),
         mesh=mesh,
         in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
-        out_specs=(P(ROWS), P()),
+        out_specs=out_specs,
         check_vma=_resolve_impl(p.hist_impl) == "segment")
     return fn(binned, y, w, margin, keys, efb)
 
 
 def boost_trees_multi(binned, y, w, margin, key, n_trees: int, K: int,
                       p: TreeParams, bp: BoostParams, mesh=None,
-                      efb=None):
+                      efb=None, goss_keys=None):
     """Fused multinomial boosting: n_trees rounds × K class trees in ONE
-    compiled dispatch. Returns (margin [rows, K], trees [T, K, N])."""
+    compiled dispatch. Returns (margin [rows, K], trees [T, K, N]) —
+    plus the GOSS overflow scalar when sampling is active (see
+    boost_trees)."""
     keys = jax.random.split(key, n_trees)
+    if bp.goss_b > 0.0:
+        if goss_keys is None:
+            goss_keys = goss_round_keys(key, n_trees)
+        keys = (keys, goss_keys)
     return _boost_multi_jit(binned, y, w, margin, keys, efb, p, bp, K,
                             mesh or global_mesh())
 
@@ -726,22 +950,34 @@ def boost_trees_multi(binned, y, w, margin, key, n_trees: int, K: int,
 @functools.partial(jax.jit, static_argnums=(6, 7, 8))
 def _boost_jit(binned, y, w, margin, keys, efb, p: TreeParams,
                bp: BoostParams, mesh):
+    out_specs = (P(ROWS), P(), P()) if bp.goss_b > 0 \
+        else (P(ROWS), P())
     fn = jax.shard_map(
         functools.partial(_boost_shard, p=p, bp=bp),
         mesh=mesh,
         in_specs=(P(ROWS), P(ROWS), P(ROWS), P(ROWS), P(), P()),
-        out_specs=(P(ROWS), P()),
+        out_specs=out_specs,
         check_vma=_resolve_impl(p.hist_impl) == "segment")
     return fn(binned, y, w, margin, keys, efb)
 
 
 def boost_trees(binned, y, w, margin, key, n_trees: int, p: TreeParams,
-                bp: BoostParams, mesh=None, efb=None):
+                bp: BoostParams, mesh=None, efb=None, goss_keys=None):
     """Fused boosting: n_trees rounds in ONE compiled dispatch.
 
-    Returns (margin, trees) with trees a stacked Tree pytree [T, N].
+    Returns (margin, trees) with trees a stacked Tree pytree [T, N] —
+    plus a third ``overflow`` device scalar (total compaction-dropped
+    row count, see goss_compact) when GOSS is active. ``goss_keys``
+    ([n_trees] key rows of the path-invariant goss_round_keys stream)
+    rides along as a second scanned key array when GOSS is active;
+    with GOSS off the scanned operand is the plain key array,
+    byte-identical to a build without the feature.
     """
     keys = jax.random.split(key, n_trees)
+    if bp.goss_b > 0.0:
+        if goss_keys is None:
+            goss_keys = goss_round_keys(key, n_trees)
+        keys = (keys, goss_keys)
     return _boost_jit(binned, y, w, margin, keys, efb, p, bp,
                       mesh or global_mesh())
 
@@ -765,19 +1001,20 @@ def _grow_tree_jit(binned, g, h, w, col_mask, key, efb, p: TreeParams,
     return fn(binned, g, h, w, col_mask, key, efb)
 
 
-def descend_tree(tree: Tree, binned, max_depth: int, n_bins: int):
+def descend_tree(tree: Tree, binned, max_depth: int, n_bins: int,
+                 efb=None):
     """Per-row resting heap node by iterative descent (jittable) — the
     ONE implementation of split semantics at scoring time (NA bin
-    routing via na_left, `bin > split_bin` goes right)."""
+    routing via na_left, `bin > split_bin` goes right). With ``efb``
+    the binned matrix is in BUNDLED column space and per-row bins
+    decode through the shared row_orig_bins LUT gather."""
     node = jnp.zeros(binned.shape[0], dtype=jnp.int32)
     for _ in range(max_depth):
         f = tree.split_feat[node]
         b = tree.split_bin[node]
         nl = tree.na_left[node]
         sp = tree.is_split[node]
-        rowbin = jnp.take_along_axis(
-            binned, jnp.maximum(f, 0)[:, None], axis=1)[:, 0].astype(
-            jnp.int32)
+        rowbin = row_orig_bins(binned, jnp.maximum(f, 0), efb)
         is_na = rowbin == n_bins - 1
         go_right = jnp.where(is_na, ~nl, rowbin > b)
         child = 2 * node + 1 + go_right.astype(jnp.int32)
@@ -785,9 +1022,11 @@ def descend_tree(tree: Tree, binned, max_depth: int, n_bins: int):
     return node
 
 
-def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int):
+def predict_tree(tree: Tree, binned, max_depth: int, n_bins: int,
+                 efb=None):
     """Per-row leaf value (descend + gather)."""
-    return tree.value[descend_tree(tree, binned, max_depth, n_bins)]
+    return tree.value[descend_tree(tree, binned, max_depth, n_bins,
+                                   efb)]
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,12 @@ general multi-tree models agree to float tolerance.
 
 Scope: pointwise single-output boosting (GBM/XGBoost gaussian,
 bernoulli, poisson, gamma, tweedie, laplace, quantile) at
-sample_rate=1 with no scoring cadence. Multinomial (K margins), DRF
+sample_rate=1 with no scoring cadence. GOSS gradient-based sampling
+(H2O_TPU_GOSS, docs/SCALING.md "Gradient-based sampling") IS
+stream-eligible: its per-round selection is a pure function of
+exactly-associative global stats plus a per-row (key, global row id)
+hash, so the chunk grid picks the same rows the fused in-HBM path
+picks at the same seed. Multinomial (K margins), DRF
 voting, huber (needs a global residual quantile per round),
 checkpoint continuation, score_every (the stream scores once at the
 end — a requested cadence must not be dropped silently), row/column
@@ -57,7 +62,10 @@ from ...ops.histogram import expand_unit_hess as _expand_unit_hess
 from ...ops.histogram import resolve_impl as _resolve_impl
 from ...runtime.mesh import ROWS, global_mesh
 from .core import (BoostParams, Tree, TreeParams, _boost_grad_hess,
-                   _find_splits, _leaf_value, row_orig_bins)
+                   _find_splits, _leaf_value, descend_tree,
+                   goss_cap_rows, goss_compact, goss_local_counts,
+                   goss_rank_stat, goss_round_keys, goss_row_factor,
+                   goss_threshold, row_orig_bins)
 
 
 # ---------------------------------------------------------------------------
@@ -303,6 +311,84 @@ def _chunk_finish_jit(binned, rel, absn, margin, feat, bin_, nal, can,
 
 
 # ---------------------------------------------------------------------------
+# GOSS per-chunk programs (models/tree/core.py "GOSS" — the selection
+# rule is a pure function of exactly-associative GLOBAL stats plus a
+# per-row hash, so the chunk grid and the in-HBM mesh layout pick the
+# SAME rows at one seed; docs/SCALING.md "Gradient-based sampling")
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _chunk_goss_max_jit(g, w, mesh):
+    """Replicated per-chunk max |g| over live rows (pmax over shards;
+    the cross-chunk max is exact whatever the chunk order)."""
+    def body(g_, w_):
+        return lax.pmax(jnp.max(goss_rank_stat(g_, w_)), ROWS)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(ROWS), P(ROWS)),
+                       out_specs=P())
+    return fn(g, w)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _chunk_goss_counts_jit(g, w, m, mesh):
+    """Replicated per-chunk int32 |g|-bin counts + live count (int
+    sums are exactly associative — cross-chunk adds are order-free)."""
+    def body(g_, w_, m_):
+        absg = goss_rank_stat(g_, w_)
+        counts, nlive = goss_local_counts(absg, w_ > 0, m_)
+        return lax.psum(counts, ROWS), lax.psum(nlive, ROWS)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(ROWS), P(ROWS), P()),
+                       out_specs=(P(), P()))
+    return fn(g, w, m)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _goss_threshold_jit(counts, total, a: float):
+    return goss_threshold(counts, total, a)
+
+
+@functools.partial(jax.jit, static_argnums=(9, 10, 11))
+def _chunk_goss_compact_jit(binned, g, h, w, m, T, frac, kg, row0,
+                            cap_local: int, bp: BoostParams, mesh):
+    """ONE streamed read of a binned chunk per round: per-row GOSS
+    factor from the global stats + the (round key, global row id)
+    hash, then per-shard static-cap compaction — the compacted buffers
+    stay DEVICE-resident for every level of this round's tree, so the
+    stream pays one upload per ROUND instead of one per level."""
+    def body(bc, g_, h_, w_, m_, T_, f_, kg_, r0_):
+        rows_local = w_.shape[0]
+        row_ids = (r0_ + lax.axis_index(ROWS) * rows_local +
+                   jnp.arange(rows_local, dtype=jnp.int32))
+        absg = goss_rank_stat(g_, w_)
+        factor = goss_row_factor(absg, w_ > 0, m_, T_, f_, kg_,
+                                 row_ids, bp.goss_a, bp.goss_b)
+        bC, gC, hC, wC, dropped = goss_compact(bc, g_, h_,
+                                               w_ * factor, cap_local)
+        return bC, gC, hC, wC, lax.psum(dropped, ROWS)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(ROWS),) * 4 + (P(),) * 5,
+                       out_specs=(P(ROWS),) * 4 + (P(),))
+    return fn(binned, g, h, w, m, T, frac, kg, row0)
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _chunk_goss_margin_jit(binned, margin, tree: Tree, p: TreeParams,
+                           efb=None):
+    """Full re-descent margin update for one chunk: the sampled grow
+    only walked the compacted rows, so every row re-descends the grown
+    tree (shared core.descend_tree — split semantics cannot drift).
+    tree.value is already learn-rate-scaled."""
+    node = descend_tree(tree, binned, p.max_depth, p.n_bins, efb)
+    return margin + tree.value[node]
+
+
+_max_jit = jax.jit(jnp.maximum)
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
@@ -391,15 +477,75 @@ def _grow_tree_chunked(chunks: BinnedChunks, gs, hs, wts, col_key,
     return tree, (feat_d, bin_d, nal_d, can_d), rel, absn
 
 
+def _goss_round_chunked(chunks: BinnedChunks, gs, hs, wts, kg, col_key,
+                        cap_local: int, p: TreeParams, bp: BoostParams,
+                        mesh, efb=None):
+    """One GOSS boosting round over the chunk stream: global ranking
+    stats (device scalars, combined lazily — the host never blocks),
+    one compaction stream pass, grow over the device-resident
+    compacted chunks, one margin-update stream pass. Returns the
+    learn-rate-scaled host Tree + the round's compaction-overflow
+    device scalar (goss_compact)."""
+    m = None
+    for ci in range(chunks.n_chunks):
+        mc = _chunk_goss_max_jit(gs[ci], wts[ci], mesh)
+        m = mc if m is None else _max_jit(m, mc)
+    counts = total = None
+    for ci in range(chunks.n_chunks):
+        cc, nc = _chunk_goss_counts_jit(gs[ci], wts[ci], m, mesh)
+        counts = cc if counts is None else _add_jit(counts, cc)
+        total = nc if total is None else _add_jit(total, nc)
+    T, frac = _goss_threshold_jit(counts, total, bp.goss_a)
+    bufsC, gsC, hsC, wtsC = [], [], [], []
+    dropped = None
+    for ci, bc in enumerate(_stream(chunks, mesh)):
+        bC, gC, hC, wC, dc = _chunk_goss_compact_jit(
+            bc, gs[ci], hs[ci], wts[ci], m, T, frac, kg,
+            ci * chunks.chunk_rows, cap_local, bp, mesh)
+        bufsC.append(bC)
+        gsC.append(gC)
+        hsC.append(hC)
+        wtsC.append(wC)
+        dropped = dc if dropped is None else _add_jit(dropped, dc)
+    shards = mesh.shape[ROWS]
+    comp = BinnedChunks(binned=bufsC, y=[], w=[], margin=[],
+                        chunk_rows=cap_local * shards,
+                        padded_rows=chunks.padded_rows,
+                        streamed=False)
+    tree, _, _, _ = _grow_tree_chunked(comp, gsC, hsC, wtsC, col_key,
+                                       p, mesh, efb)
+    # scale leaves once (f32, same IEEE multiply as the fused core)
+    scaled = (tree.value
+              * np.float32(bp.learn_rate)).astype(np.float32)
+    tree = tree._replace(value=scaled)
+    tree_dev = Tree(*(jnp.asarray(x) for x in tree))
+    for ci, bc in enumerate(_stream(chunks, mesh)):
+        chunks.margin[ci] = _chunk_goss_margin_jit(
+            bc, chunks.margin[ci], tree_dev, p, efb)
+    return tree, dropped
+
+
 def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
                         p: TreeParams, bp: BoostParams, mesh=None,
-                        efb=None):
+                        efb=None, goss_keys=None):
     """n_trees boosting rounds over the chunk stream.
 
-    Returns (margin [padded_rows] numpy, [Tree] with host arrays) —
+    Returns (margin [padded_rows] numpy, [Tree] with host arrays,
+    goss_dropped int — total GOSS compaction-overflow contributions,
+    0 when sampling is off; models/gbm surfaces it as a warning) —
     the margin is reassembled once at the end for final metrics; it
     never leaves the device during boosting (each chunk's slice stays
-    a sharded device column)."""
+    a sharded device column).
+
+    GOSS (bp.goss_b > 0) composes with the stream WITHOUT a host
+    sync: per round, the global |g| ranking stats combine across
+    chunks as device scalars (max + int32 adds — exactly associative,
+    so they equal the in-HBM psum bit for bit), one streamed pass
+    compacts each chunk's sampled rows into a device-resident buffer,
+    every tree level then builds from the compacted buffers (no
+    per-level streaming at 1/(a+b)-ish of the rows), and a final
+    streamed pass re-descends the full chunks for the margin update —
+    2 uploads per round instead of max_depth+2."""
     assert not bp.drf_mode, "OOC mode is pointwise boosting only"
     assert bp.sample_rate >= 1.0 and \
         bp.col_sample_rate_per_tree >= 1.0 and p.mtries <= 0, \
@@ -415,6 +561,14 @@ def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
     # mtries) is gated OFF this path in models/gbm._ooc_chunk_rows —
     # the key below is plumbed only for _splits_with_mask's signature
     col_mask = jnp.ones(F, dtype=bool)
+    goss = bp.goss_b > 0.0
+    goss_dropped = None
+    if goss:
+        if goss_keys is None:       # same fallback as core.boost_trees
+            goss_keys = goss_round_keys(key, n_trees)
+        shards = mesh.shape[ROWS]
+        cap_local = goss_cap_rows(chunks.chunk_rows // shards,
+                                  bp.goss_a, bp.goss_b)
     for t in range(n_trees):
         key, k_tree = jax.random.split(key)
         gs, hs, wts = [], [], []
@@ -424,6 +578,16 @@ def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
             gs.append(g)
             hs.append(h)
             wts.append(chunks.w[ci])
+        if goss:
+            tree, dc = _goss_round_chunked(chunks, gs, hs, wts,
+                                           goss_keys[t],
+                                           (col_mask, k_tree),
+                                           cap_local, p, bp, mesh,
+                                           efb)
+            goss_dropped = dc if goss_dropped is None \
+                else _add_jit(goss_dropped, dc)
+            trees.append(tree)
+            continue
         tree, last_split, rel, absn = _grow_tree_chunked(
             chunks, gs, hs, wts, (col_mask, k_tree), p, mesh, efb)
         # scale leaves once (f32, same IEEE multiply as the fused
@@ -445,7 +609,9 @@ def boost_trees_chunked(chunks: BinnedChunks, key, n_trees: int,
                                                   value_dev)
         trees.append(tree)
     margin = np.concatenate([np.asarray(m) for m in chunks.margin])
-    return margin[: chunks.padded_rows], trees
+    dropped_total = 0 if goss_dropped is None \
+        else int(np.asarray(goss_dropped))
+    return margin[: chunks.padded_rows], trees, dropped_total
 
 
 _add_root_jit = jax.jit(lambda m, v: m + v[0])

@@ -61,12 +61,20 @@ they never clobber a full-suite artifact.
   check on the slice, and the warm-repeat recompile check.
   ``BENCH_SHAP_ROWS`` / ``BENCH_SHAP_HOST_ROWS`` size it;
 
+- config #6b ``gbm_goss_10m`` — GOSS gradient-based sampling
+  (docs/SCALING.md "Gradient-based sampling"): sampled (a=0.1,
+  b=0.1) vs unsampled GBM at the 10M airlines shape, matched tree
+  count; records histogram rows-per-level (the static compaction
+  capacity), steady per-tree train time both legs, and the AUC delta
+  with its ≤0.002 acceptance flag. ``BENCH_GOSS_ROWS`` /
+  ``BENCH_GOSS_TREES`` size it.
+
 Every config reports BOTH timings: ``compile_seconds`` (the first
 call — what a cold user pays, XLA compile included) and ``seconds``
 (steady state, compile cached; repeated until ≥1 s of measured work
 or 3 calls on the CPU mesh, single repeat on TPU where trains are
 long and chip windows are ~20 min). One JSON line per config + a
-trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r11.json`` at the
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r12.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -628,6 +636,85 @@ def main() -> int:
     # -- config #6: the 10M-row chunked-path proofs --------------------
     rows_10m = int(os.environ.get("BENCH_ROWS_10M", 10_000_000))
 
+    if _want("gbm_goss_10m"):
+        # config #6b (ISSUE 13): GOSS gradient-based one-side sampling
+        # at the 10M airlines shape (docs/SCALING.md "Gradient-based
+        # sampling") — sampled (a=0.1, b=0.1) vs unsampled legs at
+        # matched tree count. Records the histogram rows-per-level the
+        # kernel actually streams (the static compaction capacity),
+        # steady per-tree train time both ways, and the AUC delta.
+        # Acceptance: >=2.5x steady per-tree with GOSS on, |dAUC| <=
+        # 0.002. BENCH_GOSS_ROWS/TREES shrink it for partial captures;
+        # below 2M rows each leg runs cold+warm so the steady number
+        # is compile-free, at the full shape legs are single-shot.
+        import gc
+
+        from h2o_kubernetes_tpu.models.tree import core as TC
+        from h2o_kubernetes_tpu.runtime import mesh as meshlib
+
+        goss_rows = int(os.environ.get("BENCH_GOSS_ROWS", rows_10m))
+        nt_g = int(os.environ.get("BENCH_GOSS_TREES", 10))
+        a_s = os.environ.get("BENCH_GOSS_TOP_A", "0.1")
+        b_s = os.environ.get("BENCH_GOSS_RAND_B", "0.1")
+        fr_g = D.airlines_frame(goss_rows, seed=10)
+        padded_g = fr_g.vec("Year").padded_len
+        shards = meshlib.global_mesh().shape[meshlib.ROWS]
+        cap_rows = shards * TC.goss_cap_rows(
+            padded_g // shards, float(a_s), float(b_s))
+        legs = 1 if goss_rows > 2_000_000 else 2
+        _goss_prior = {k: os.environ.get(k) for k in
+                       ("H2O_TPU_GOSS", "H2O_TPU_GOSS_TOP_A",
+                        "H2O_TPU_GOSS_RAND_B")}
+
+        def _goss_leg(on: bool):
+            os.environ["H2O_TPU_GOSS"] = "1" if on else "0"
+            os.environ["H2O_TPU_GOSS_TOP_A"] = a_s
+            os.environ["H2O_TPU_GOSS_RAND_B"] = b_s
+            try:
+                walls = []
+                for _ in range(legs):
+                    t0 = time.perf_counter()
+                    mg = GBM(ntrees=nt_g, max_depth=5, learn_rate=0.2,
+                             seed=1).train(y="IsDepDelayed",
+                                           training_frame=fr_g)
+                    walls.append(time.perf_counter() - t0)
+                auc = float(mg.scoring_history[-1].get(
+                    "train_auc", float("nan")))
+                del mg
+                gc.collect()
+                return walls[0], walls[-1], auc
+            finally:
+                for k, v in _goss_prior.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        cold_off, steady_off, auc_off = _goss_leg(False)
+        cold_on, steady_on, auc_on = _goss_leg(True)
+        ratio = steady_off / max(steady_on, 1e-9)
+        record("gbm_goss_10m", ratio,
+               "x_per_tree_speedup_vs_unsampled", steady_on, legs,
+               cold_on, rows_goss=goss_rows, ntrees=nt_g, max_depth=5,
+               goss_top_a=float(a_s), goss_rand_b=float(b_s),
+               unsampled_wall_s=round(steady_off, 3),
+               sampled_wall_s=round(steady_on, 3),
+               unsampled_cold_s=round(cold_off, 3),
+               sampled_cold_s=round(cold_on, 3),
+               per_tree_s_unsampled=round(steady_off / nt_g, 4),
+               per_tree_s_sampled=round(steady_on / nt_g, 4),
+               hist_rows_per_level_unsampled=padded_g,
+               hist_rows_per_level_sampled=cap_rows,
+               hist_rows_reduction=round(padded_g / max(cap_rows, 1),
+                                         2),
+               auc_unsampled=round(auc_off, 5),
+               auc_sampled=round(auc_on, 5),
+               auc_delta=round(abs(auc_off - auc_on), 5),
+               auc_within_0_002=bool(abs(auc_off - auc_on) <= 0.002),
+               per_tree_speedup_ge_2_5x=bool(ratio >= 2.5))
+        del fr_g
+        gc.collect()
+
     if _want("ingest_airlines_csv_10m"):
         import gc
         import tempfile
@@ -683,7 +770,7 @@ def main() -> int:
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r11{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r12{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

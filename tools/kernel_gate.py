@@ -227,6 +227,39 @@ def main() -> int:
         np.asarray(m_b.predict_raw(fr_e))))
     checks.append({"check": "efb_parity", "ok": efb_ok})
 
+    # 6. GOSS sampled boost program (ISSUE 13): the static-capacity
+    # compaction (jnp.nonzero + gathers inside the shard_map scan),
+    # the hashed per-row draws and the full-row re-descent margin
+    # update must survive real lowering, not just CPU. Pinned two
+    # ways: a+b=1 keeps every row at amplification (1-a)/b = 1, so
+    # the SAMPLED program must reproduce the unsampled m2 BITWISE;
+    # and a really-sampled config must be seeded-deterministic while
+    # actually differing from unsampled.
+    def _goss_leg(a, b):
+        os.environ.update({"H2O_TPU_GOSS": "1",
+                           "H2O_TPU_GOSS_TOP_A": a,
+                           "H2O_TPU_GOSS_RAND_B": b})
+        try:
+            return GBM(ntrees=3, max_depth=4, seed=0).train(
+                y="y", training_frame=fr2)
+        finally:
+            for k in ("H2O_TPU_GOSS", "H2O_TPU_GOSS_TOP_A",
+                      "H2O_TPU_GOSS_RAND_B"):
+                os.environ.pop(k, None)
+
+    def _trees_equal(ma, mb):
+        return all(np.array_equal(np.asarray(x), np.asarray(y))
+                   for x, y in zip(jax.tree.flatten(ma.trees)[0],
+                                   jax.tree.flatten(mb.trees)[0]))
+
+    m_gid = _goss_leg("0.5", "0.5")
+    goss_ok = _trees_equal(m2, m_gid)
+    m_g1 = _goss_leg("0.2", "0.2")
+    m_g2 = _goss_leg("0.2", "0.2")
+    goss_ok &= _trees_equal(m_g1, m_g2)
+    goss_ok &= not _trees_equal(m2, m_g1)
+    checks.append({"check": "goss_parity", "ok": bool(goss_ok)})
+
     ok = all(c["ok"] for c in checks)
     print(json.dumps({"gate": "pass" if ok else "fail",
                       "platform": platform, "checks": checks}))

@@ -183,7 +183,7 @@ def capture() -> float | None:
     # the round's named evidence): the non-GBM BASELINE configs (GLM
     # iters/sec, DRF HIGGS on the unit-hess path, XGBoost hist,
     # lambdarank, DL, Word2Vec)
-    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r11.json")
+    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r12.json")
     if not os.path.exists(suite_path):
         log("running bench_suite on chip")
         ok, suite, tail = run_json(
