@@ -77,6 +77,10 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_ROUTER_HEALTH_INTERVAL | 0.5 | seconds between router health sweeps over every replica's /3/Stats; each scrape rides the shared probe helper (H2O_TPU_POOL_PROBE_TIMEOUT + 3 attempts before unhealthy, so a scoring burst can't flap a shard out of the ring) |
 | H2O_TPU_ROUTER_MAX_INFLIGHT | 256 | router admission bound on concurrently forwarded requests; past it 429 + Retry-After (<=0 unbounded) |
 | H2O_TPU_ROUTER_TIMEOUT | 30 | per-forward upstream timeout on the router, seconds; clamped under the request's remaining X-H2O-Deadline-Ms budget |
+| H2O_TPU_METRICS_TOPK | 20 | fleet telemetry: per-metric series cap for tenant-cardinality labels (`model`) — the top-K label values by traffic keep their own series, everything else rolls into `other`, so 1000 tenants cost K+1 series on GET /metrics (runtime/telemetry.py, docs/OBSERVABILITY.md) |
+| H2O_TPU_METRICS_PORT | — (off) | operator.run status listener: bind /metrics + /healthz on this port so the control plane is scrapeable like any replica (0 = ephemeral; `--status-port` overrides) |
+| H2O_TPU_TRACE | 1 | 0 disables request-span recording (trace ring + per-request phase histograms) — the tracing perf kill switch; counters and /metrics stay on (runtime/telemetry.py) |
+| H2O_TPU_TRACE_RING | 512 | per-process bound on retained trace records (GET /3/Trace/{id}); oldest-inserted evict, so a serving storm cannot grow the ring |
 | JAX_COMPILATION_CACHE_DIR | auto | persistent XLA cache dir; h2o.init() picks repo/user default when unset (keyed by host CPU feature fingerprint) |
 
 COORDINATOR/NUM_PROCESSES/PROCESS_ID are the operator's injection

@@ -33,16 +33,26 @@ class _Event:
 
 
 class TimeLine:
-    """Fixed-size event ring (water/TimeLine analog; thread-safe)."""
+    """Fixed-size event ring (water/TimeLine analog; thread-safe).
+
+    Alongside the ring it keeps CUMULATIVE per-kind counts: the ring
+    is bounded (a long ooc train's per-level phase spans would
+    otherwise evict the rare operational events' history entirely),
+    so rates and totals live in ``kind_counts()`` — registered as the
+    ``timeline`` stat group with the fleet-telemetry registry, which
+    puts event rates on ``GET /metrics`` even after the events
+    themselves aged out of ``/3/Timeline``."""
 
     def __init__(self, capacity: int = 4096):
         self._ring: collections.deque[_Event] = collections.deque(
             maxlen=capacity)
+        self._counts: collections.Counter = collections.Counter()
         self._lock = threading.Lock()
 
     def record(self, kind: str, msg: str = "", **data) -> None:
         with self._lock:
             self._ring.append(_Event(time.time(), kind, msg, data))
+            self._counts[kind] += 1
 
     def events(self, kind: str | None = None) -> list[dict[str, Any]]:
         """Snapshot, oldest first (the /3/Timeline payload)."""
@@ -51,12 +61,22 @@ class TimeLine:
         return [{"ts": e.ts, "kind": e.kind, "msg": e.msg, **e.data}
                 for e in evs if kind is None or e.kind == kind]
 
+    def kind_counts(self) -> dict[str, int]:
+        """Cumulative events per kind since process start — NOT
+        ring-bounded (the counts survive eviction)."""
+        with self._lock:
+            return dict(self._counts)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
 
 
 timeline = TimeLine()
+
+from .runtime.telemetry import register_group as _register_tel_group  # noqa: E402
+
+_register_tel_group("timeline", timeline.kind_counts)
 
 log = logging.getLogger("h2o_kubernetes_tpu")
 if not log.handlers:

@@ -304,6 +304,14 @@ def evict_scorer_cache(model=None) -> int:
     return len(victims)
 
 
+# the scorer cache registers with the process-wide metrics registry
+# where it lives: /3/Stats and GET /metrics both render this group
+# (runtime/telemetry.py — the fleet-telemetry single source of truth)
+from ..runtime.telemetry import register_group as _register_tel_group
+
+_register_tel_group("scorer_cache", scorer_cache_stats)
+
+
 def _batch_bucket(n: int) -> int:
     """Next power-of-two batch size >= max(n, _SCORE_MIN_BATCH)."""
     b = _SCORE_MIN_BATCH

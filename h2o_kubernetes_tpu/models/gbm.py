@@ -670,26 +670,36 @@ class GBM:
         ooc_chunk = _ooc_chunk_rows(p, data, K, F_eff, hist_bytes,
                                     budget, ckpt)
         binned = None
-        if efb_plan is not None:
-            # bundled training matrix [padded, Fb] (host-built during
-            # planning, device-cached on the plan); the out-of-core
-            # branch slices the same host matrix into its chunk grid
-            if ooc_chunk is None:
-                binned = efb_plan.binned_device()
-        elif bin_spec is None:
-            # fresh fit: on the in-HBM path the quantile fit and the
-            # bin apply fuse into ONE dispatch with no host sync in
-            # between (binning.fused_fit_bins; H2O_TPU_FUSED_BINNING=0
-            # restores the two-dispatch path) — the out-of-core path
-            # keeps the classic fit (its apply streams host chunks)
-            if ooc_chunk is None and fused_binning_enabled():
-                bin_spec, binned = fused_fit_bins(
-                    training_frame, data.feature_names, n_bins=p.nbins)
-            else:
-                bin_spec = fit_bins(training_frame, data.feature_names,
-                                    n_bins=p.nbins)
-        if ooc_chunk is None and binned is None:
-            binned = training_frame.binned(bin_spec)
+        # the bin phase is a telemetry span (h2o_train_phase_seconds
+        # {phase="bin"} + /3/Timeline): the prologue whose blocking
+        # quantile sync PR 5 removed stays observable in production
+        from ..runtime.telemetry import phase_span
+
+        with phase_span("bin", rows=data.y.shape[0], features=F_eff):
+            if efb_plan is not None:
+                # bundled training matrix [padded, Fb] (host-built
+                # during planning, device-cached on the plan); the
+                # out-of-core branch slices the same host matrix into
+                # its chunk grid
+                if ooc_chunk is None:
+                    binned = efb_plan.binned_device()
+            elif bin_spec is None:
+                # fresh fit: on the in-HBM path the quantile fit and
+                # the bin apply fuse into ONE dispatch with no host
+                # sync in between (binning.fused_fit_bins;
+                # H2O_TPU_FUSED_BINNING=0 restores the two-dispatch
+                # path) — the out-of-core path keeps the classic fit
+                # (its apply streams host chunks)
+                if ooc_chunk is None and fused_binning_enabled():
+                    bin_spec, binned = fused_fit_bins(
+                        training_frame, data.feature_names,
+                        n_bins=p.nbins)
+                else:
+                    bin_spec = fit_bins(training_frame,
+                                        data.feature_names,
+                                        n_bins=p.nbins)
+            if ooc_chunk is None and binned is None:
+                binned = training_frame.binned(bin_spec)
 
         off = data.offset if data.offset is not None \
             else jnp.zeros_like(data.y)
@@ -784,7 +794,8 @@ class GBM:
             from .tree.ooc import boost_trees_chunked, make_chunks
 
             require_healthy()
-            with device_dispatch("gbm out-of-core boost"):
+            with device_dispatch("gbm out-of-core boost"), \
+                    phase_span("boost", mode="ooc", trees=p.ntrees):
                 cks = make_chunks(training_frame, bin_spec, data.y,
                                   data.w, margin, ooc_chunk,
                                   plan=efb_plan)
@@ -794,9 +805,11 @@ class GBM:
             _warn_goss_overflow(goss_dropped)
             margin = shard_rows(margin_np)
         else:
-            trees, margin, history = self._boost_in_hbm(
-                p, tp, bp, data, binned, margin, key, K, F_eff, ckpt,
-                start_t, history, efb=efb, goss_keys=goss_keys)
+            with phase_span("boost", mode="in_hbm", trees=p.ntrees):
+                trees, margin, history = self._boost_in_hbm(
+                    p, tp, bp, data, binned, margin, key, K, F_eff,
+                    ckpt, start_t, history, efb=efb,
+                    goss_keys=goss_keys)
         if isinstance(init, jax.Array):
             # read the device init back AFTER the boost chunks are
             # enqueued (async dispatch: this blocks only on the tiny

@@ -60,6 +60,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...ops.histogram import build_histogram as _build_histogram_op
 from ...ops.histogram import expand_unit_hess as _expand_unit_hess
 from ...ops.histogram import resolve_impl as _resolve_impl
+from ...runtime import telemetry
 from ...runtime.mesh import ROWS, global_mesh
 from .core import (BoostParams, Tree, TreeParams, _boost_grad_hess,
                    _find_splits, _leaf_value, descend_tree,
@@ -162,17 +163,37 @@ def _stream(chunks: BinnedChunks, mesh):
     """Yield device binned chunks with one-ahead prefetch: the
     (asynchronous) ``device_put`` of chunk c+1 is issued before chunk c
     is consumed, double-buffering host→device transfer against the
-    histogram build. Resident chunks pass through untouched."""
+    histogram build. Resident chunks pass through untouched.
+
+    Each streamed pass reports its upload/compute split to the fleet
+    telemetry registry (``ooc_stream_account``): time blocked inside
+    ``device_put`` vs time the CONSUMER held the generator suspended —
+    the overlap-efficiency gauge (compute/(compute+upload) → 1.0 when
+    every upload hides under the histogram build) the SCALING docs
+    previously estimated by hand. The timestamps are host clock reads
+    around calls already on this path — no extra device syncs."""
     if not chunks.streamed:
         yield from chunks.binned
         return
+    import time
+
     sharding = NamedSharding(mesh, P(ROWS))
+    upload_s = compute_s = 0.0
+    t0 = time.monotonic()
+    t = t0
     nxt = jax.device_put(chunks.binned[0], sharding)
+    upload_s += time.monotonic() - t
     for c in range(chunks.n_chunks):
         cur = nxt
         if c + 1 < chunks.n_chunks:
+            t = time.monotonic()
             nxt = jax.device_put(chunks.binned[c + 1], sharding)
+            upload_s += time.monotonic() - t
+        t = time.monotonic()
         yield cur
+        compute_s += time.monotonic() - t
+    telemetry.ooc_stream_account(upload_s, compute_s,
+                                 time.monotonic() - t0)
 
 
 # ---------------------------------------------------------------------------
@@ -442,24 +463,37 @@ def _grow_tree_chunked(chunks: BinnedChunks, gs, hs, wts, col_key,
             val[idx] = vals_np
             cov[idx] = covs_np
             break
+        # phase spans (h2o_train_phase_seconds + /3/Timeline): the
+        # per-level chunk-accumulated histogram build vs the split
+        # search — the level-by-level attribution behind any ooc
+        # wall-clock claim (host-observable on this path because each
+        # level is a host loop over chunk programs)
         if d == 0:
             hist2 = None
-            for ci, bc in enumerate(_stream(chunks, mesh)):
-                hc = _chunk_root_hist_jit(bc, gs[ci], hs[ci], wts[ci],
-                                          rel[ci], True, p, mesh)
-                hist2 = hc if hist2 is None else _add_jit(hist2, hc)
-            hist, found = _root_logic_jit(hist2, col_key, p, d, efb)
+            with telemetry.phase_span("level_hist", depth=d):
+                for ci, bc in enumerate(_stream(chunks, mesh)):
+                    hc = _chunk_root_hist_jit(bc, gs[ci], hs[ci],
+                                              wts[ci], rel[ci], True,
+                                              p, mesh)
+                    hist2 = hc if hist2 is None \
+                        else _add_jit(hist2, hc)
+            with telemetry.phase_span("split_find", depth=d):
+                hist, found = _root_logic_jit(hist2, col_key, p, d,
+                                              efb)
         else:
             hist_l2 = None
-            for ci, bc in enumerate(_stream(chunks, mesh)):
-                rel[ci], absn[ci], hc = _chunk_desc_hist_jit(
-                    bc, rel[ci], absn[ci], gs[ci], hs[ci], wts[ci],
-                    feat_d, bin_d, nal_d, can_d, d - 1, p, mesh, efb)
-                hist_l2 = hc if hist_l2 is None else _add_jit(hist_l2,
-                                                             hc)
-            hist, found = _level_logic_jit(hist_l2, hist_prev,
-                                           can_prev, col_key, p, d,
-                                           efb)
+            with telemetry.phase_span("level_hist", depth=d):
+                for ci, bc in enumerate(_stream(chunks, mesh)):
+                    rel[ci], absn[ci], hc = _chunk_desc_hist_jit(
+                        bc, rel[ci], absn[ci], gs[ci], hs[ci],
+                        wts[ci], feat_d, bin_d, nal_d, can_d, d - 1,
+                        p, mesh, efb)
+                    hist_l2 = hc if hist_l2 is None \
+                        else _add_jit(hist_l2, hc)
+            with telemetry.phase_span("split_find", depth=d):
+                hist, found = _level_logic_jit(hist_l2, hist_prev,
+                                               can_prev, col_key, p,
+                                               d, efb)
         (feat_d, bin_d, nal_d, can_d, val_d, gain_d, cov_d,
          left_prev, right_prev) = found
         idx = off + np.arange(n_nodes)

@@ -477,6 +477,12 @@ class Reconciler:
 
     def _event(self, kind: str, msg: str = "") -> None:
         self.store.record_event(self.pool, kind, msg)
+        # re-registered through the fleet-telemetry registry too:
+        # the durable store keeps the bounded event ring, /metrics
+        # (h2o_operator_events_total{event=...}) keeps the rates
+        from ..runtime.telemetry import count_event
+
+        count_event(kind)
         from ..diagnostics import log
 
         log.warning("operator[%s]: %s %s", self.pool, kind, msg)
@@ -1177,6 +1183,12 @@ class ShardedPool:
 
     def _event(self, kind: str, msg: str = "") -> None:
         self.store.record_event(self.pool, kind, msg)
+        # re-registered through the fleet-telemetry registry too:
+        # the durable store keeps the bounded event ring, /metrics
+        # (h2o_operator_events_total{event=...}) keeps the rates
+        from ..runtime.telemetry import count_event
+
+        count_event(kind)
         from ..diagnostics import log
 
         log.warning("operator[%s]: %s %s", self.pool, kind, msg)

@@ -59,7 +59,7 @@ import urllib.error
 import urllib.request
 from http.server import ThreadingHTTPServer
 
-from ..runtime import lifecycle
+from ..runtime import lifecycle, telemetry
 from ..runtime.retry import _env_float
 from .probe import probe_json
 
@@ -139,6 +139,13 @@ class ScoringRouter:
         }
         self.retry_budget = {"granted": 0, "denied": 0}
         self.by_shard: dict[str, dict] = {}
+        # per-TENANT relayed-success counter: incremented exactly ONCE
+        # per client request, at the final relay (never at a dispatch
+        # attempt) — so a lost hedge or a failover retry can never
+        # double-count a tenant's traffic. Bounded like every tenant-
+        # labeled series: past 4*top-K keys the coldest roll into
+        # `other`.
+        self.by_model: dict[str, int] = {}
 
     # -- health ---------------------------------------------------------------
 
@@ -293,7 +300,8 @@ class ScoringRouter:
     # -- dispatch -------------------------------------------------------------
 
     def _call_one(self, url: str, path: str, body: bytes,
-                  headers: dict, deadline: float | None) -> dict:
+                  headers: dict, deadline: float | None,
+                  tid: str | None = None) -> dict:
         """One upstream POST. Returns {"code", "body", "retry_after"}
         for any HTTP answer; raises _Transport for connection-level
         failures (the failover shape)."""
@@ -302,6 +310,11 @@ class ScoringRouter:
                                             "application/json")}
         if headers.get("X-H2O-SLO"):
             hdrs["X-H2O-SLO"] = headers["X-H2O-SLO"]
+        if tid:
+            # trace propagation: the replica records its queue/batch/
+            # dispatch spans under the SAME id the router minted, so
+            # one GET /3/Trace/{id} per hop reassembles the request
+            hdrs["X-H2O-Trace-Id"] = tid
         if deadline is not None:
             # forward the REMAINING budget: the replica's admission
             # and batcher enforce the client's true deadline, minus
@@ -331,14 +344,109 @@ class ScoringRouter:
     def _bump_shard(self, sid: str, field: str) -> None:
         with self._lock:
             rec = self.by_shard.setdefault(
-                sid, {"forwarded": 0, "errors": 0})
+                sid, {"forwarded": 0, "errors": 0, "hedge_won": 0,
+                      "hedge_lost": 0, "hedge_cancelled": 0})
             rec[field] += 1
+
+    def _hedge_outcome(self, sid: str, outcome: str) -> None:
+        """Per-shard hedge-race accounting: `hedge_won` — the hedge
+        leg's answer was relayed; `hedge_lost` — the hedge answered
+        but the primary's answer won; `hedge_cancelled` — the primary
+        won while the hedge was still in flight (its eventual answer
+        is discarded unread). One of the three fires for EVERY fired
+        hedge, so won+lost+cancelled == hedges holds structurally."""
+        self._bump_shard(sid, f"hedge_{outcome}")
+        telemetry.REGISTRY.counter(
+            f"h2o_router_hedge_{outcome}_total",
+            f"hedged dispatches whose race ended {outcome}, per "
+            "shard", label="shard").inc(label_value=sid)
+
+    def _bump_model(self, model_key: str) -> None:
+        """The per-tenant relayed-success counter, bounded at
+        4x H2O_TPU_METRICS_TOPK named keys: at capacity a newcomer
+        evicts a ONE-count resident into `other` (so a flood of cold
+        one-request probes cannot permanently squat every named slot),
+        else the newcomer itself rolls into `other`. The genuinely
+        traffic-ranked top-K view is the registry counter below —
+        its series cap demotes by observed traffic."""
+        from ..runtime.telemetry import _topk
+
+        local_key = model_key
+        with self._lock:
+            cap = 4 * _topk()
+            named = [k for k in self.by_model if k != "other"]
+            if local_key not in self.by_model and len(named) >= cap:
+                coldest = min(named, key=self.by_model.get)
+                # a single prior request is all a newcomer needs to
+                # out-rank a 1-count resident; ties keep the resident
+                if self.by_model[coldest] <= 1:
+                    self.by_model["other"] = \
+                        self.by_model.get("other", 0) \
+                        + self.by_model.pop(coldest)
+                else:
+                    local_key = "other"
+            self.by_model[local_key] = \
+                self.by_model.get(local_key, 0) + 1
+        # the registry counter gets the REAL tenant key — its own
+        # traffic-ranked series cap decides the exposed label set,
+        # and it can only rank what it observes (feeding it the
+        # locally-capped 'other' would lock a late-arriving hot
+        # tenant out of a named series forever)
+        telemetry.REGISTRY.counter(
+            "h2o_router_forwarded_total",
+            "requests relayed with a non-5xx answer, per tenant "
+            "(top-K + other)", label="model").inc(label_value=model_key)
 
     def route(self, model_key: str, path: str, body: bytes,
               headers: dict, deadline: float | None,
-              slo: str | None) -> tuple[int, bytes, dict]:
+              slo: str | None, tid: str | None = None
+              ) -> tuple[int, bytes, dict]:
         """Resolve + forward with failover/hedging under the retry
-        budget; returns (status, body bytes, response headers)."""
+        budget; returns (status, body bytes, response headers).
+        ``tid`` is the request's trace id: every dispatch attempt is
+        recorded as a span under it (outcome + shard + duration), and
+        the final relay increments the tenant's forwarded counter
+        exactly once — whatever failover/hedging did in between."""
+        attempts: list[dict] = []
+        t0 = time.monotonic()
+        try:
+            code, body_out, hdrs = self._route_inner(
+                model_key, path, body, headers, deadline, slo, tid,
+                attempts)
+        except BaseException:
+            if tid:
+                telemetry.TRACER.record(tid, attempts, model=model_key,
+                                        hop="router")
+            raise
+        dur = time.monotonic() - t0
+        telemetry.REGISTRY.histogram(
+            "h2o_router_route_seconds",
+            "front-door routing latency (resolve + failover + "
+            "upstream)").observe(dur)
+        if tid:
+            telemetry.TRACER.record(
+                tid, attempts + [{"name": "route", "outcome": code,
+                                  "ms": round(dur * 1000.0, 3)}],
+                model=model_key, hop="router")
+        if code < 500 and code != 404:
+            # relayed non-5xx = the tenant's one forwarded answer
+            # (404 excluded: an unknown-model probe must not mint
+            # per-tenant series for attacker-chosen keys)
+            self._bump_model(model_key)
+        return code, body_out, hdrs
+
+    @staticmethod
+    def _attempt(attempts: list, sid: str, url: str, outcome: str,
+                 t_start: float) -> None:
+        attempts.append({
+            "name": "dispatch", "shard": sid, "url": url,
+            "outcome": outcome,
+            "ms": round((time.monotonic() - t_start) * 1000.0, 3)})
+
+    def _route_inner(self, model_key: str, path: str, body: bytes,
+                     headers: dict, deadline: float | None,
+                     slo: str | None, tid: str | None,
+                     attempts: list) -> tuple[int, bytes, dict]:
         with self._lock:
             self.stats["requests"] += 1
         known, cands = self.candidates(model_key)
@@ -369,7 +477,7 @@ class ScoringRouter:
         last: dict | None = None
         if hedge_s > 0 and slo == "interactive" and len(cands) >= 2:
             h = self._route_hedged(model_key, path, body, headers,
-                                   deadline, cands)
+                                   deadline, cands, tid, attempts)
             if h.get("expired"):
                 return self._expired_504(model_key)
             if "relay" in h:
@@ -401,13 +509,18 @@ class ScoringRouter:
                     break
             res = None
             for j, url in enumerate(urls):
+                t_call = time.monotonic()
                 try:
                     res = self._call_one(url, path, body, headers,
-                                         deadline)
+                                         deadline, tid)
                     break
                 except _BudgetExpired:
+                    self._attempt(attempts, sid, url,
+                                  "budget_expired", t_call)
                     return self._expired_504(model_key)
                 except _Transport:
+                    self._attempt(attempts, sid, url,
+                                  "transport_error", t_call)
                     # INTRA-shard failover on a connection-level
                     # failure is free (nothing was processed, no
                     # duplicated work — and token-gating it would
@@ -433,6 +546,8 @@ class ScoringRouter:
                 with self._lock:
                     self.stats["relayed_5xx"] += 1
                 self._bump_shard(sid, "errors")
+                self._attempt(attempts, sid, url, "answered_5xx",
+                              t_call)
                 last = res
                 continue
             # 2xx and 4xx (including a tenant's own 429 rate limit —
@@ -441,6 +556,7 @@ class ScoringRouter:
             with self._lock:
                 self.stats["forwarded"] += 1
             self._bump_shard(sid, "forwarded")
+            self._attempt(attempts, sid, url, "forwarded", t_call)
             return self._relay(res)
         if last is not None:
             return self._relay(last)
@@ -466,14 +582,15 @@ class ScoringRouter:
                 max(1, int(float(res["retry_after"]) + 0.999)))
         return res["code"], res["body"], hdrs
 
-    def _leg_failed(self, result, more_candidates: bool):
+    def _leg_failed(self, result, more_candidates: bool,
+                    attempts: list):
         """Sequential-path bookkeeping for one failed hedge leg: a
         5xx answer records its Retry-After cooldown + relayed_5xx (so
         arming the hedge switch never skips the cooldown the
         sequential path applies), a transport failure counts like any
         other. Returns the answered response (for relay-of-last-
         resort) or None."""
-        kind, sid, url, res = result
+        kind, sid, url, res, dur_ms = result
         if kind == "ok":
             if res["retry_after"]:
                 with self._lock:
@@ -482,16 +599,21 @@ class ScoringRouter:
             with self._lock:
                 self.stats["relayed_5xx"] += 1
             self._bump_shard(sid, "errors")
+            attempts.append({"name": "dispatch", "shard": sid,
+                             "url": url, "outcome": "answered_5xx",
+                             "ms": dur_ms})
             return res
         with self._lock:
             self.stats["transport_errors"] += 1
             if more_candidates:
                 self.stats["failovers"] += 1
         self._bump_shard(sid, "errors")
+        attempts.append({"name": "dispatch", "shard": sid, "url": url,
+                         "outcome": "transport_error", "ms": dur_ms})
         return None
 
     def _route_hedged(self, model_key, path, body, headers, deadline,
-                      cands) -> dict:
+                      cands, tid=None, attempts=None) -> dict:
         """Speculative dual-dispatch for interactive traffic: primary
         first; if it has not answered inside the hedge window AND the
         tenant's budget grants a token, fire the next shard and take
@@ -501,26 +623,54 @@ class ScoringRouter:
         with the best answered (5xx) response kept for relay, so a
         fast-failing primary gets exactly the sequential semantics
         (cooldown, budget-gated failover), never a relayed 5xx that
-        a healthy replica shard could have absorbed."""
+        a healthy replica shard could have absorbed.
+
+        Race accounting: every fired hedge resolves to exactly one of
+        hedge_won / hedge_lost / hedge_cancelled on the HEDGE shard's
+        counters (see _hedge_outcome) — and the tenant's forwarded
+        counter is untouched here (the route() wrapper increments it
+        once on the final relay), so a lost hedge can never
+        double-count a request."""
+        if attempts is None:
+            attempts = []
         results: list = [None, None]
         done = threading.Event()
+        hedged = [False]
 
         def leg(i: int, target) -> None:
             sid, urls = target
             url = urls[0]
+            t_call = time.monotonic()
+
+            def dur():
+                return round((time.monotonic() - t_call) * 1000.0, 3)
+
             try:
                 results[i] = ("ok", sid, url,
                               self._call_one(url, path, body, headers,
-                                             deadline))
+                                             deadline, tid), dur())
             except _BudgetExpired as e:
-                results[i] = ("expired", sid, url, e)
+                results[i] = ("expired", sid, url, e, dur())
             except _Transport as e:
-                results[i] = ("transport", sid, url, e)
+                results[i] = ("transport", sid, url, e, dur())
             done.set()
+
+        def settle_hedge(winner: int) -> None:
+            """The race ended with a relayed answer from ``winner``:
+            file the hedge leg's outcome (won / lost / cancelled)."""
+            if not hedged[0]:
+                return
+            sid1 = cands[1][0]
+            if winner == 1:
+                self._hedge_outcome(sid1, "won")
+            elif results[1] is not None:
+                self._hedge_outcome(sid1, "lost")
+            else:
+                self._hedge_outcome(sid1, "cancelled")
 
         def won(i: int):
             """Relay dict when leg i holds a success."""
-            kind, sid, url, res = results[i]
+            kind, sid, url, res, dur_ms = results[i]
             if kind != "ok" or res["code"] >= 500:
                 return None
             with self._lock:
@@ -528,6 +678,12 @@ class ScoringRouter:
                 if i == 1:
                     self.stats["hedge_wins"] += 1
             self._bump_shard(sid, "forwarded")
+            attempts.append({"name": "dispatch", "shard": sid,
+                             "url": url, "outcome": "forwarded",
+                             "ms": dur_ms,
+                             **({"hedge_leg": i} if hedged[0]
+                                else {})})
+            settle_hedge(i)
             return {"relay": self._relay(res)}
 
         threading.Thread(target=leg, args=(0, cands[0]),
@@ -545,13 +701,15 @@ class ScoringRouter:
             out = won(0)
             if out is not None:
                 return out
-            last = self._leg_failed(results[0], len(cands) > 1)
+            last = self._leg_failed(results[0], len(cands) > 1,
+                                    attempts)
             return {"resume": 1, "last": last}
         # primary slow: fire the hedge (it is load amplification, so
         # it is budget-gated like any retry)
         if self._retry_token(model_key):
             with self._lock:
                 self.stats["hedges"] += 1
+            hedged[0] = True
             threading.Thread(target=leg, args=(1, cands[1]),
                              daemon=True).start()
             fired_legs = (0, 1)
@@ -568,19 +726,36 @@ class ScoringRouter:
                 if results[i] is None or i in handled:
                     continue
                 if results[i][0] == "expired":
+                    if hedged[0]:
+                        # the race ends here too: settle the hedge
+                        # leg so won+lost+cancelled == hedges holds
+                        # even when the deadline dies mid-race
+                        self._hedge_outcome(
+                            cands[1][0],
+                            "lost" if results[1] is not None
+                            else "cancelled")
                     return {"expired": True}
                 out = won(i)
                 if out is not None:
                     return out
                 handled.add(i)
                 res = self._leg_failed(results[i],
-                                       len(cands) > len(fired_legs))
+                                       len(cands) > len(fired_legs),
+                                       attempts)
                 if res is not None:
                     last = res
             if len(handled) == len(fired_legs):
                 break
             done.wait(0.01)
             done.clear()
+        if hedged[0]:
+            # no leg relayed: the race had no winner — count the
+            # hedge leg by what it DID (answered-and-failed = lost,
+            # still in flight when we gave up = cancelled) so
+            # won+lost+cancelled == hedges stays structural
+            self._hedge_outcome(cands[1][0],
+                                "lost" if results[1] is not None
+                                else "cancelled")
         return {"resume": len(fired_legs), "last": last}
 
     # -- admission ------------------------------------------------------------
@@ -602,13 +777,16 @@ class ScoringRouter:
             stats = dict(self.stats)
             budget = dict(self.retry_budget)
             by_shard = {k: dict(v) for k, v in self.by_shard.items()}
+            by_model = dict(self.by_model)
             inflight = self._inflight
         return {"router": True, "stats": stats,
                 "retry_budget": {**budget,
                                  "rate_per_s": _retry_budget_rate()},
-                "by_shard": by_shard, "inflight": inflight,
+                "by_shard": by_shard, "by_model": by_model,
+                "inflight": inflight,
                 "hedge_ms": _hedge_ms(),
-                "shards": self.shard_health()}
+                "shards": self.shard_health(),
+                "build": telemetry.build_info()}
 
 
 def _make_handler(router: ScoringRouter):
@@ -640,6 +818,32 @@ def _make_handler(router: ScoringRouter):
                 return self._json({"ready":
                                    router.any_shard_healthy(),
                                    **router.snapshot()})
+            if path == "/metrics":
+                # Prometheus exposition at the front door: the
+                # process-wide registry (hedge outcome + forwarded
+                # counters, route-latency histogram, build info) plus
+                # this router instance's snapshot flattened in.
+                # by_model/shards are excluded from the flatten —
+                # tenant keys and replica URLs must never become
+                # metric NAMES (the capped first-class counters carry
+                # them as labels instead).
+                snap = router.snapshot()
+                extra = {"router": {
+                    k: v for k, v in snap.items()
+                    if k not in ("by_model", "shards", "build")}}
+                telemetry.write_metrics(self, extra)
+                return None
+            if path.startswith("/3/Trace/"):
+                # the router's half of a request trace: one span per
+                # dispatch attempt (shard, outcome, duration) + the
+                # route total — pair it with the replica's
+                # /3/Trace/{id} for the full hop decomposition
+                tid = urllib.parse.unquote(path[len("/3/Trace/"):])
+                rec = telemetry.TRACER.get(tid)
+                if rec is None:
+                    return self._error(
+                        404, f"trace '{tid}' not in the router's ring")
+                return self._json(rec)
             return self._error(404, f"no route for GET {path}")
 
         def do_POST(self):
@@ -664,6 +868,10 @@ def _make_handler(router: ScoringRouter):
                 if rest_part.endswith("/contributions"):
                     mkey = rest_part[: -len("/contributions")]
                 mkey = urllib.parse.unquote(mkey)
+                # the router MINTS the trace id when the client sent
+                # none — from here every hop (forward headers, replica
+                # span records, hedge legs) carries the same id
+                tid = telemetry.trace_id_from(self.headers)
                 try:
                     deadline = _request_deadline(self.headers)
                     slo = _request_slo(self.headers)
@@ -691,12 +899,13 @@ def _make_handler(router: ScoringRouter):
                     # have re-capitalized X-H2O-SLO
                     code, out, hdrs = router.route(
                         mkey, path, body, self.headers,
-                        deadline, slo)
+                        deadline, slo, tid=tid)
                 finally:
                     router.release()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(out)))
+                self.send_header("X-H2O-Trace-Id", tid)
                 for k, v in hdrs.items():
                     self.send_header(k, v)
                 self.end_headers()

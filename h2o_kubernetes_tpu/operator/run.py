@@ -46,6 +46,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--leave-pods", action="store_true",
                     help="on SIGTERM, exit WITHOUT draining replicas "
                     "(handoff to a successor operator)")
+    ap.add_argument("--status-port", type=int, default=None,
+                    help="bind a tiny /metrics + /healthz listener on "
+                    "this port (0 = ephemeral; default: "
+                    "H2O_TPU_METRICS_PORT, unset/empty = no listener) "
+                    "— the operator's Prometheus scrape surface")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -57,6 +62,35 @@ def main(argv: list[str] | None = None) -> int:
     rec = Reconciler(store, ModelRegistry(args.registry), args.pool,
                      workdir=args.workdir)
     stop = threading.Event()
+
+    # status listener: the operator's own /metrics scrape surface
+    # (reconcile event counters, build info) — the control plane is a
+    # fleet member too, and fleet_top scrapes it like any replica
+    status_port = args.status_port
+    if status_port is None:
+        raw = os.environ.get("H2O_TPU_METRICS_PORT")
+        if raw:
+            try:
+                status_port = int(raw)
+            except ValueError:
+                print(f"OPERATOR_BAD_METRICS_PORT {raw!r} (ignored)",
+                      flush=True)
+    status_srv = None
+    if status_port is not None:
+        from ..runtime.telemetry import start_status_listener
+
+        def _operator_groups():
+            try:
+                return {"operator": {
+                    "pool": args.pool,
+                    "status": store.get_status(args.pool) or {}}}
+            except Exception:  # noqa: BLE001 — scrape must survive
+                return None
+
+        status_srv = start_status_listener(
+            status_port, extra_groups=_operator_groups)
+        print(f"OPERATOR_METRICS port="
+              f"{status_srv.server_address[1]}", flush=True)
 
     def _sigterm(signum, frame):
         stop.set()
@@ -83,6 +117,9 @@ def main(argv: list[str] | None = None) -> int:
     rec.run(stop, interval=args.interval)
     if not args.leave_pods:
         rec.shutdown()
+    if status_srv is not None:
+        status_srv.shutdown()
+        status_srv.server_close()
     print("OPERATOR_DOWN", flush=True)
     return 0
 

@@ -31,7 +31,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
-from .runtime import lifecycle
+from .runtime import lifecycle, telemetry
 from .runtime.health import ClusterHealthError
 from .runtime.lifecycle import CircuitOpenError, NodeDrainingError
 from .runtime.retry import _env_float
@@ -377,10 +377,10 @@ def _contrib_row_cap() -> int:
 
 class _ScoreJob:
     __slots__ = ("model", "X", "offset", "event", "out", "err",
-                 "deadline", "key", "slo", "kind")
+                 "deadline", "key", "slo", "kind", "span")
 
     def __init__(self, model, X, offset, key=None, slo=None,
-                 kind="score"):
+                 kind="score", span=None):
         self.model = model
         self.X = X
         self.offset = offset
@@ -391,6 +391,13 @@ class _ScoreJob:
         self.key = key          # model key (per-tenant accounting)
         self.slo = slo          # SLO class name (fairness + priority)
         self.kind = kind        # "score" | "contrib" (dispatch target)
+        self.span = span        # trace marks dict (telemetry) or None
+
+    def mark(self, name: str) -> None:
+        """Record a monotonic phase timestamp for the request trace —
+        no-op when the request carries no span sink."""
+        if self.span is not None:
+            self.span[name] = time.monotonic()
 
 
 class ScoreBatcher:
@@ -435,7 +442,8 @@ class ScoreBatcher:
                deadline: float | None = None,
                model_key: str | None = None,
                slo: str | None = None,
-               kind: str = "score") -> np.ndarray:
+               kind: str = "score",
+               span: dict | None = None) -> np.ndarray:
         """Enqueue one scoring request; blocks until its slice of the
         batched result (or raises: health/breaker/drain fail-fast,
         queue-full load shed, timeout).
@@ -446,8 +454,14 @@ class ScoreBatcher:
         only reaches it afterwards. ``model_key``/``slo`` drive the
         per-tenant fairness cap + accounting; a deadline-less request
         in a latency SLO class inherits the class's implicit
-        deadline."""
+        deadline. ``span`` is an optional dict the batcher fills with
+        monotonic phase marks (admit/enqueue/pop/dispatch_start/
+        dispatch_end) — the request-trace contract: the route turns
+        them into queue-vs-device spans after the result lands."""
         from .runtime import health
+
+        if span is not None:
+            span["admit"] = time.monotonic()
 
         if self._stopped or not lifecycle.accepting():
             raise NodeDrainingError(
@@ -480,7 +494,7 @@ class ScoreBatcher:
         if timeout is None:
             timeout = _env_float("H2O_TPU_SCORE_TIMEOUT", 60.0)
         job = _ScoreJob(model, X, offset, key=model_key, slo=slo,
-                        kind=kind)
+                        kind=kind, span=span)
         # the dispatcher drops jobs whose waiter has already timed out
         # (503'd and gone) instead of burning device time on them
         job.deadline = time.monotonic() + timeout
@@ -537,6 +551,7 @@ class ScoreBatcher:
                     self._pending_by_key.get(model_key, 0) + 1
             self._ensure_thread()
             self._pending.append(job)
+            job.mark("enqueue")
             self.stats["requests"] += 1
             _bump_model_stat(
                 model_key,
@@ -653,6 +668,7 @@ class ScoreBatcher:
                                        "(client wait expired)")
                 job.event.set()
             else:
+                job.mark("pop")    # left the admission queue
                 live.append(job)
         groups: dict[tuple, list[_ScoreJob]] = {}
         for job in live:
@@ -709,9 +725,15 @@ class ScoreBatcher:
                                  sum(j.X.shape[0] for j in jobs))
 
             def dispatch(X, offset=None):
-                if contrib:
-                    return model.contrib_numpy(X)
-                return model.score_numpy(X, offset=offset)
+                for j in jobs:
+                    j.mark("dispatch_start")
+                try:
+                    if contrib:
+                        return model.contrib_numpy(X)
+                    return model.score_numpy(X, offset=offset)
+                finally:
+                    for j in jobs:
+                        j.mark("dispatch_end")
 
             if len(jobs) == 1:
                 jobs[0].out = dispatch(jobs[0].X,
@@ -739,8 +761,95 @@ class ScoreBatcher:
 BATCHER = ScoreBatcher()
 
 
+# -- telemetry registration -------------------------------------------------
+#
+# Every serving surface this module owns registers as a STAT GROUP in
+# the process-wide metrics registry (runtime/telemetry.py): the dicts
+# above stay the storage their hot paths mutate, but /3/Stats is
+# assembled from the registry snapshot and GET /metrics flattens the
+# same groups into Prometheus text — one source of truth, two renders,
+# and a fleet scraper sees every counter /3/Stats ever reported.
+# (scorer_cache registers in models/base.py, compiles in
+# runtime/backend.py, lifecycle in runtime/lifecycle.py — each group
+# lives with its owner.)
+
+
+def _counters_snapshot() -> dict:
+    with _STATS_LOCK:
+        return dict(STATS)
+
+
+def _model_stats_snapshot() -> dict:
+    with _STATS_LOCK:
+        return {k: dict(v) for k, v in MODEL_STATS.items()}
+
+
+def _batcher_snapshot() -> dict:
+    return {**BATCHER.stats, "queue_depth": BATCHER.queue_depth()}
+
+
+def _registry_snapshot() -> dict:
+    """Per-artifact registry state incl. the eviction-aware
+    warm_cache_misses contract (see /3/Stats docstring history)."""
+    from .models.base import model_scorer_counters
+
+    reg = {}
+    for mid, info in list(REGISTRY_MODELS.items()):
+        model = MODELS.get(mid)
+        wcm = None
+        if model is not None:
+            ctr = model_scorer_counters(model)
+            wcm = max(0, ctr["misses"] - ctr["promotions"]
+                      - info.get("warm_baseline", 0))
+        reg[mid] = {
+            "name": info.get("name"),
+            "version": info.get("version"),
+            "algo": info.get("algo"),
+            "slo": info.get("slo"),
+            "warmed_buckets": info.get("warmed_buckets"),
+            "contributions": info.get("contributions"),
+            "warm_cache_misses": wcm,
+        }
+    return reg
+
+
+telemetry.register_group("counters", _counters_snapshot)
+telemetry.register_group("batcher", _batcher_snapshot)
+telemetry.register_group("models", _model_stats_snapshot,
+                         labeled="model")
+telemetry.register_group("registry", _registry_snapshot,
+                         labeled="model")
+telemetry.register_group("identity", lambda: dict(IDENTITY))
+telemetry.register_group("build", telemetry.build_info)
+
+
+def _traced_submit(model, X, *, tid, t0, model_key, slo,
+                   kind="score", offset=None, deadline=None):
+    """BATCHER.submit with the request-trace contract on BOTH exits:
+    a request that dies in the queue (shed / deadline 504 / breaker /
+    timeout) still lands in the trace ring and the latency
+    histograms with its error name as the outcome — the slow requests
+    tracing exists to debug are exactly the failed ones, and a
+    success-only histogram would bias the exported p99 low."""
+    marks: dict = {}
+    try:
+        out = BATCHER.submit(model, X, offset=offset,
+                             deadline=deadline, model_key=model_key,
+                             slo=slo, kind=kind, span=marks)
+    except BaseException as e:
+        telemetry.record_request_phases(
+            tid, marks, t0 if t0 is not None else marks.get("admit"),
+            time.monotonic(), model=model_key, slo=slo, kind=kind,
+            outcome=type(e).__name__)
+        raise
+    telemetry.record_request_phases(
+        tid, marks, t0 if t0 is not None else marks.get("admit"),
+        time.monotonic(), model=model_key, slo=slo, kind=kind)
+    return out
+
+
 def _predict_via_batcher(model, frame, deadline=None, model_key=None,
-                         slo=None):
+                         slo=None, tid=None, t0=None):
     """Frame prediction through the micro-batcher: design matrix ->
     one (possibly coalesced) scoring dispatch -> prediction Frame.
     Models outside the jitted serving set keep the classic path."""
@@ -759,8 +868,9 @@ def _predict_via_batcher(model, frame, deadline=None, model_key=None,
         off = model._frame_offset(frame)   # the predict_raw contract
         if off is not None:
             off = np.asarray(off)[: frame.nrows]
-    out = BATCHER.submit(model, X, offset=off, deadline=deadline,
-                         model_key=model_key, slo=slo)
+    out = _traced_submit(model, X, tid=tid, t0=t0,
+                         model_key=model_key, slo=slo, offset=off,
+                         deadline=deadline)
     return model._prediction_frame(out)
 
 
@@ -1073,54 +1183,62 @@ class _Handler(JsonHttpHandler):
                 return self._json({"ready": False,
                                    "reasons": reasons, **st}, 503)
             if path == "/3/Stats":
-                # ONE scrape for operators + the autoscale signal:
-                # process-local serving counters that were previously
-                # invisible over REST (scorer cache incl. resident
-                # bytes vs budget, admission queue depth/shed, breaker,
-                # deadline 504s, per-MODEL fairness counters, registry
-                # warm state, XLA compile watch). Device-free: safe to
-                # poll on a wedged node.
-                from .models.base import (model_scorer_counters,
-                                          scorer_cache_stats)
-                from .runtime.backend import compile_watch_snapshot
+                # ONE scrape for operators + the autoscale signal —
+                # now assembled from the process-wide metrics registry
+                # (runtime/telemetry.py): every section below is a
+                # registered stat group, so this JSON and the
+                # Prometheus exposition at GET /metrics render the
+                # SAME snapshot (the inventory-diff test pins that).
+                # The dict shape is byte-compatible with the
+                # pre-registry payload; `build` is the one sanctioned
+                # addition (which build produced this scrape).
+                # Device-free: safe to poll on a wedged node.
+                from .models import base as _base  # noqa: F401 —
+                # importing registers the scorer_cache group
+                from .runtime.backend import start_compile_watch
 
+                start_compile_watch()   # idempotent: registers the
+                # compiles group even when start_server never ran
                 ready, reasons, st = _ready_state()
-                sc = scorer_cache_stats()
-                reg = {}
-                for mid, info in list(REGISTRY_MODELS.items()):
-                    # warm_cache_misses is PER MODEL and eviction-
-                    # aware: (misses - promotions) since the warm-up
-                    # baseline — a byte-budget eviction's re-trace is
-                    # a promotion, not an SLO-violating compile
-                    model = MODELS.get(mid)
-                    wcm = None
-                    if model is not None:
-                        ctr = model_scorer_counters(model)
-                        wcm = max(0, ctr["misses"] - ctr["promotions"]
-                                  - info.get("warm_baseline", 0))
-                    reg[mid] = {
-                        "name": info.get("name"),
-                        "version": info.get("version"),
-                        "algo": info.get("algo"),
-                        "slo": info.get("slo"),
-                        "warmed_buckets": info.get("warmed_buckets"),
-                        "contributions": info.get("contributions"),
-                        "warm_cache_misses": wcm,
-                    }
-                with _STATS_LOCK:
-                    per_model = {k: dict(v)
-                                 for k, v in MODEL_STATS.items()}
+                snap = telemetry.group_snapshot((
+                    "scorer_cache", "batcher", "counters", "models",
+                    "compiles", "registry"))
                 return self._json({
                     "ready": ready, "reasons": reasons, **st,
                     "identity": dict(IDENTITY),
-                    "scorer_cache": sc,
-                    "batcher": {**BATCHER.stats,
-                                "queue_depth": BATCHER.queue_depth()},
-                    "counters": dict(STATS),
-                    "models": per_model,
+                    "scorer_cache": snap.get("scorer_cache", {}),
+                    "batcher": snap.get("batcher", {}),
+                    "counters": snap.get("counters", {}),
+                    "models": snap.get("models", {}),
                     "fairness": _fairness_on(),
-                    "compiles": compile_watch_snapshot(),
-                    "registry": reg})
+                    "compiles": snap.get("compiles", {}),
+                    "registry": snap.get("registry", {}),
+                    "build": telemetry.build_info()})
+            if path == "/metrics":
+                # Prometheus text exposition: every first-class metric
+                # (latency/phase histograms, hedge/event counters) plus
+                # every registered stat group's numeric leaves — one
+                # scrape sees everything /3/Stats reports
+                from .models import base as _base  # noqa: F401
+                from .runtime.backend import start_compile_watch
+
+                start_compile_watch()
+                telemetry.write_metrics(self)
+                return None
+            if path.startswith("/3/Trace/"):
+                # per-request span record from the bounded trace ring:
+                # the "why was this p99 slow" decomposition (admission
+                # wait / batcher queue / batch assembly / device
+                # dispatch) for a request that carried (or was minted)
+                # an X-H2O-Trace-Id
+                tid = urllib.parse.unquote(path[len("/3/Trace/"):])
+                rec = telemetry.TRACER.get(tid)
+                if rec is None:
+                    return self._error(
+                        404, f"trace '{tid}' not in the ring (bounded "
+                        "at H2O_TPU_TRACE_RING entries — old traces "
+                        "age out)")
+                return self._json(rec)
             if path == "/3/ModelRegistry":
                 return self._json({
                     "models": {
@@ -1280,6 +1398,7 @@ class _Handler(JsonHttpHandler):
 
     def do_POST(self):
         try:
+            t0 = time.monotonic()   # request-trace total-span anchor
             path = urllib.parse.urlparse(self.path).path.rstrip("/")
             # drain admission gate BEFORE parsing the body: a draining
             # node admits no new work of any kind (in-flight requests
@@ -1298,6 +1417,10 @@ class _Handler(JsonHttpHandler):
                 # budget is rejected before any queue slot or dispatch
                 deadline = _request_deadline(self.headers)
                 slo = _request_slo(self.headers)
+                # trace propagation: take the router's X-H2O-Trace-Id
+                # (or mint one for direct requests) — scoring routes
+                # record their span decomposition under it and echo it
+                tid = telemetry.trace_id_from(self.headers)
             except ValueError as e:
                 # bad request envelope only: malformed JSON body or an
                 # unparseable X-H2O-Deadline-Ms — a ValueError from a
@@ -1388,7 +1511,7 @@ class _Handler(JsonHttpHandler):
                                            f"model '{mkey}' not found")
                     return self._contrib_rows(MODELS[mkey], mkey,
                                               params, deadline=deadline,
-                                              slo=slo)
+                                              slo=slo, tid=tid, t0=t0)
                 mkey, sep, fpart = rest.partition("/frames/")
                 mkey = urllib.parse.unquote(mkey)
                 fpart = urllib.parse.unquote(fpart)
@@ -1400,17 +1523,19 @@ class _Handler(JsonHttpHandler):
                     # micro-batcher + jitted-scorer cache
                     return self._score_rows(MODELS[mkey], mkey, params,
                                             deadline=deadline,
-                                            slo=slo)
+                                            slo=slo, tid=tid, t0=t0)
                 if fpart not in FRAMES:
                     return self._error(404, f"frame '{fpart}' not found")
                 pred = _predict_via_batcher(MODELS[mkey], FRAMES[fpart],
                                             deadline=deadline,
                                             model_key=mkey,
-                                            slo=_resolve_slo(mkey, slo))
+                                            slo=_resolve_slo(mkey, slo),
+                                            tid=tid, t0=t0)
                 key = f"prediction_{mkey}_{fpart}"
                 FRAMES[key] = pred
                 return self._json({"predictions_frame": {"name": key},
-                                   **_frame_schema(key, pred)})
+                                   **_frame_schema(key, pred)},
+                                  headers={"X-H2O-Trace-Id": tid})
             return self._error(404, f"no route for POST {path}")
         except _DeadlineExpired as e:
             # the client's budget ran out before we dispatched: 504,
@@ -1565,7 +1690,9 @@ class _Handler(JsonHttpHandler):
 
     def _score_rows(self, model, mkey: str, params: dict,
                     deadline: float | None = None,
-                    slo: str | None = None):
+                    slo: str | None = None,
+                    tid: str | None = None,
+                    t0: float | None = None):
         """POST /3/Predictions/models/{key} — serving-shaped scoring:
         JSON rows in, predictions out, one micro-batched dispatch
         under the model's SLO class (header > registry default >
@@ -1608,9 +1735,9 @@ class _Handler(JsonHttpHandler):
                      for r in rows], dtype=np.float32)
         except (ValueError, TypeError, KeyError, IndexError) as e:
             return self._error(400, f"bad scoring payload: {e!r}")
-        out = BATCHER.submit(model, X, offset=off, deadline=deadline,
-                             model_key=mkey,
-                             slo=_resolve_slo(mkey, slo))
+        out = _traced_submit(model, X, tid=tid, t0=t0, model_key=mkey,
+                             slo=_resolve_slo(mkey, slo), offset=off,
+                             deadline=deadline)
         resp: dict = {"model_id": {"name": mkey}, "rows": len(rows)}
         if getattr(model, "nclasses", 1) > 1:
             dom = model.response_domain or \
@@ -1626,11 +1753,14 @@ class _Handler(JsonHttpHandler):
                                    for row in out]
             else:
                 resp["predict"] = [float(v) for v in out]
-        return self._json(resp)
+        return self._json(resp, headers={"X-H2O-Trace-Id": tid}
+                          if tid else None)
 
     def _contrib_rows(self, model, mkey: str, params: dict,
                       deadline: float | None = None,
-                      slo: str | None = None):
+                      slo: str | None = None,
+                      tid: str | None = None,
+                      t0: float | None = None):
         """POST /3/Predictions/models/{key}/contributions — per-row
         TreeSHAP contributions over the serving stack: JSON rows in,
         one [rows, F+1] device TreeSHAP dispatch (coalesced by the
@@ -1662,15 +1792,15 @@ class _Handler(JsonHttpHandler):
             X = _rows_to_matrix(model, rows, params.get("columns"))
         except (ValueError, TypeError, KeyError, IndexError) as e:
             return self._error(400, f"bad contributions payload: {e!r}")
-        out = BATCHER.submit(model, X, deadline=deadline,
-                             model_key=mkey,
+        out = _traced_submit(model, X, tid=tid, t0=t0, model_key=mkey,
                              slo=_resolve_contrib_slo(slo),
-                             kind="contrib")
+                             kind="contrib", deadline=deadline)
         cols = list(model.feature_names) + ["BiasTerm"]
         return self._json({
             "model_id": {"name": mkey}, "rows": len(rows),
             "columns": cols,
-            "contributions": [[float(v) for v in row] for row in out]})
+            "contributions": [[float(v) for v in row] for row in out]},
+            headers={"X-H2O-Trace-Id": tid} if tid else None)
 
     def _run_job(self, job, fn, sync_timeout: float):
         """Run fn on a worker thread under `job`, waiting up to

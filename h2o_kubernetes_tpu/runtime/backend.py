@@ -251,6 +251,15 @@ def start_compile_watch() -> None:
             return
         _watch_installed = True
     try:
+        # the compile watch registers with the fleet-telemetry
+        # registry where it lives (lazy import: telemetry itself
+        # lazily imports this module for the host fingerprint)
+        from .telemetry import register_group
+
+        register_group("compiles", compile_watch_snapshot)
+    except Exception:   # noqa: BLE001 — accounting only, never fatal
+        pass
+    try:
         from jax import monitoring
 
         monitoring.register_event_duration_secs_listener(

@@ -644,3 +644,12 @@ def status() -> dict:
             "breaker": BREAKER.status(),
             "cordoned": LIFECYCLE.cordoned(),
             "drain_budget_s": LIFECYCLE.remaining_drain_budget()}
+
+
+# node state + breaker re-registered through the fleet-telemetry
+# registry: GET /metrics flattens this group (breaker trip counters,
+# consecutive-failure gauge, state as an info sample) so a scraper
+# sees breaker pressure without parsing /3/Stats JSON
+from .telemetry import register_group as _register_tel_group  # noqa: E402
+
+_register_tel_group("lifecycle", status)

@@ -353,7 +353,15 @@ class CompileStream:
                 thunks = builder() or []
                 for thunk in thunks:
                     pre = compile_watch_snapshot(ident)
-                    thunk()
+                    # each pre-lowered program is a compile-ahead-fill
+                    # phase span (h2o_train_phase_seconds + timeline):
+                    # the overlapped compile work the scheduler_stats
+                    # totals previously reported only in aggregate
+                    from .telemetry import phase_span
+
+                    with phase_span("compile_ahead_fill",
+                                    label=label or None):
+                        thunk()
                     post = compile_watch_snapshot(ident)
                     with self._cond:
                         self.stats["programs"] += 1

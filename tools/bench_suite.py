@@ -74,7 +74,7 @@ call — what a cold user pays, XLA compile included) and ``seconds``
 (steady state, compile cached; repeated until ≥1 s of measured work
 or 3 calls on the CPU mesh, single repeat on TPU where trains are
 long and chip windows are ~20 min). One JSON line per config + a
-trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r12.json`` at the
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r13.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -520,7 +520,19 @@ def main() -> int:
                storm_unfair=mt["storm_unfair"],
                fair_tail_slo_met=mt["storm_fair"]["tail_slo_met"],
                unfair_tail_slo_met=mt["storm_unfair"]["tail_slo_met"],
-               scorer_cache_final=mt["scorer_cache_final"])
+               scorer_cache_final=mt["scorer_cache_final"],
+               # exposition-cost hygiene (ISSUE 14): one /metrics
+               # scrape timed before + after the sweep; acceptance
+               # note = the post-sweep scrape (full tenant series
+               # resident) costs < 1% of the storm-shape p99, so
+               # Prometheus polling cannot move the serving tail
+               metrics_scrape=mt.get("metrics_scrape"),
+               metrics_scrape_under_1pct_p99=bool(
+                   mt.get("metrics_scrape", {}).get(
+                       "after", {}).get("ok")
+                   and (sweep["p99_ms"] or 0) > 0
+                   and mt["metrics_scrape"]["after"]["ms"]
+                   < 0.01 * sweep["p99_ms"]))
 
     if _want("router_zipf_p99"):
         # config #5d (ISSUE 11): the tenant-sharded fleet router vs
@@ -557,7 +569,11 @@ def main() -> int:
                router_rows_per_s=rt["router"]["rows_per_s"],
                direct_rows_per_s=rt["direct"]["rows_per_s"],
                router_tail_p99_ms=rt["router"]["tail_p99_ms"],
-               direct_tail_p99_ms=rt["direct"]["tail_p99_ms"])
+               direct_tail_p99_ms=rt["direct"]["tail_p99_ms"],
+               router_metrics_scrape=rt["router"].get(
+                   "metrics_scrape"),
+               direct_metrics_scrape=rt["direct"].get(
+                   "metrics_scrape"))
 
     if _want("gbm_wide_sparse"):
         # config #8 (ISSUE 8): Exclusive Feature Bundling on a >= 1k-
@@ -765,12 +781,15 @@ def main() -> int:
         del fr10, m10
         gc.collect()
 
+    from h2o_kubernetes_tpu.runtime.telemetry import build_info
+
     out = {"suite": results, "captured_at":
-           time.strftime("%Y-%m-%dT%H:%M:%S")}
+           time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "build": build_info()}
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r12{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r13{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

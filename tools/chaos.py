@@ -1686,6 +1686,156 @@ def scenario_router_shard_kill() -> None:
                 os.environ[k] = v
 
 
+def scenario_trace_failover() -> None:
+    """Fleet-telemetry acceptance drill (ISSUE 14): kill a replica
+    under the router, then send one traced scoring request whose
+    round-robin primary is the corpse. Asserts the trace id survives
+    the intra-shard failover retry (router span record: >=1
+    transport_error attempt, exactly ONE terminal `forwarded`
+    dispatch), the survivor's /3/Trace/{id} carries the full
+    queue/batch/dispatch span decomposition with exactly one dispatch,
+    and /metrics on the router + survivor expose the failover/request
+    counters plus the build-info block — the end-to-end proof that one
+    scrape + one trace id explain a request that crossed a dying
+    fleet."""
+    import signal
+    import urllib.request
+
+    from h2o_kubernetes_tpu.operator.router import start_router
+    from h2o_kubernetes_tpu.runtime import telemetry
+
+    fx = _PoolFixture("tracefail")
+    saved_hi = os.environ.get("H2O_TPU_ROUTER_HEALTH_INTERVAL")
+    # freeze the health ring after the initial sweep: the drill needs
+    # the dead replica still listed READY so the REQUEST performs the
+    # failover (not the sweep quietly removing the corpse first)
+    os.environ["H2O_TPU_ROUTER_HEALTH_INTERVAL"] = "3600"
+    srv = router = None
+    try:
+        victim, survivor = fx.rec.replicas[0], fx.rec.replicas[1]
+        # victim FIRST in the shard's replica list: round-robin starts
+        # at 0, so the first routed request's primary is the corpse
+        table = {"keys": {"pm": ["s0"]},
+                 "shards": {"s0": [victim.url, survivor.url]}}
+        srv, router = start_router(table)
+        rurl = f"http://127.0.0.1:{srv.server_address[1]}"
+        _check(router.any_shard_healthy(),
+               "router never saw a healthy shard")
+        os.kill(victim.pid(), signal.SIGKILL)
+        deadline = time.monotonic() + 10
+        while victim.alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        _check(not victim.alive(), "SIGKILL did not kill the victim")
+
+        body = json.dumps({"rows": [
+            {c: 0.25 for c in fx.feature_cols}] * 4}).encode()
+        req = urllib.request.Request(
+            f"{rurl}/3/Predictions/models/pm", data=body,
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            _check(r.status == 200,
+                   f"routed request not 200: {r.status}")
+            tid = r.headers.get("X-H2O-Trace-Id")
+            payload = json.loads(r.read())
+        _check(bool(tid), "router response carries no X-H2O-Trace-Id")
+        _check("predict" in payload or "pontime" in payload,
+               f"unexpected scoring payload keys: "
+               f"{sorted(payload)[:6]}")
+
+        # router half of the trace: the failover is VISIBLE — the dead
+        # primary's attempt recorded, exactly one terminal dispatch
+        with urllib.request.urlopen(f"{rurl}/3/Trace/{tid}",
+                                    timeout=30) as r:
+            rtrace = json.loads(r.read())
+        disp = [s for s in rtrace["spans"] if s["name"] == "dispatch"]
+        fwd = [s for s in disp if s["outcome"] == "forwarded"]
+        terr = [s for s in disp
+                if s["outcome"] == "transport_error"]
+        _check(len(fwd) == 1,
+               f"want exactly 1 terminal forwarded dispatch, got "
+               f"{len(fwd)}: {rtrace['spans']}")
+        _check(len(terr) >= 1,
+               f"dead-primary attempt not recorded: {rtrace['spans']}")
+
+        # survivor half: same trace id, full per-hop decomposition,
+        # exactly one device dispatch for the whole failover story
+        with urllib.request.urlopen(
+                f"{survivor.url}/3/Trace/{tid}", timeout=30) as r:
+            strace = json.loads(r.read())
+        names = [s["name"] for s in strace["spans"]]
+        for want in ("admission", "queue", "assemble", "dispatch",
+                     "total"):
+            _check(want in names,
+                   f"survivor trace missing span '{want}': {names}")
+        _check(names.count("dispatch") == 1,
+               f"survivor recorded {names.count('dispatch')} device "
+               f"dispatches for one request: {names}")
+
+        # /metrics on both hops: failover counters + build identity
+        with urllib.request.urlopen(f"{rurl}/metrics",
+                                    timeout=30) as r:
+            rmet = telemetry.parse_prometheus_text(r.read().decode())
+
+        def rv(name, **lbls):
+            return rmet.get((name, tuple(sorted(lbls.items()))), 0.0)
+
+        _check(rv("h2o_stats_router_stats_transport_errors") >= 1,
+               "router /metrics missing the transport-error count")
+        _check(rv("h2o_stats_router_stats_failovers") >= 1,
+               "router /metrics missing the failover count")
+        # per-tenant no-double-count: asserted on THIS router's own
+        # counters (snapshot + /3/Stats by_model), NOT the global
+        # registry label — earlier drills in the same process (the
+        # 1000-tenant shard-kill) legitimately fill the capped
+        # top-K label set, rolling a one-request tenant into `other`
+        snap = router.snapshot()
+        _check(snap["by_model"].get("pm") == 1
+               and snap["stats"]["forwarded"] == 1,
+               "tenant forwarded counter != 1 after one request "
+               f"(by_model={snap['by_model']}, "
+               f"forwarded={snap['stats']['forwarded']})")
+        _check(any(k[0] == "h2o_build_info" for k in rmet),
+               "router /metrics missing h2o_build_info")
+        with urllib.request.urlopen(f"{survivor.url}/metrics",
+                                    timeout=30) as r:
+            smet = telemetry.parse_prometheus_text(r.read().decode())
+        sm = {k[0] for k in smet}
+        for want in ("h2o_stats_batcher_requests", "h2o_build_info",
+                     "h2o_request_phase_seconds_bucket"):
+            _check(want in sm, f"survivor /metrics missing {want}")
+        with urllib.request.urlopen(f"{survivor.url}/3/Stats",
+                                    timeout=30) as r:
+            st = json.loads(r.read())
+        _check(isinstance(st.get("build"), dict)
+               and st["build"].get("version")
+               and st["build"].get("pid"),
+               f"survivor /3/Stats missing the build block: "
+               f"{st.get('build')}")
+
+        # the operator's one-screen aggregator reads both hops
+        from tools.fleet_top import scrape as ft_scrape
+
+        row_r = ft_scrape(rurl)
+        row_s = ft_scrape(survivor.url)
+        _check(row_r["up"] and row_r["kind"] == "router",
+               f"fleet_top cannot read the router: {row_r}")
+        _check(row_s["up"] and row_s["kind"] == "replica"
+               and row_s["requests"] >= 1,
+               f"fleet_top cannot read the survivor: {row_s}")
+    finally:
+        if saved_hi is None:
+            os.environ.pop("H2O_TPU_ROUTER_HEALTH_INTERVAL", None)
+        else:
+            os.environ["H2O_TPU_ROUTER_HEALTH_INTERVAL"] = saved_hi
+        if router is not None:
+            router.stop()
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        fx.close()
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -1702,6 +1852,7 @@ SCENARIOS = {
     "operator-restart": scenario_operator_restart,
     "poison-rollback": scenario_poison_rollback,
     "router-shard-kill": scenario_router_shard_kill,
+    "trace-failover": scenario_trace_failover,
 }
 
 
@@ -1715,7 +1866,10 @@ def main(argv: list[str]) -> int:
               f"{', '.join(SCENARIOS)} or 'all'", file=sys.stderr)
         return 2
     from h2o_kubernetes_tpu.runtime import make_mesh, set_global_mesh
+    from h2o_kubernetes_tpu.runtime.telemetry import build_info
 
+    # every drill artifact states which build produced it
+    print(f"[chaos] build={json.dumps(build_info())}")
     set_global_mesh(make_mesh())
     for name in names:
         t0 = time.monotonic()

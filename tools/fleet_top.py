@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""fleet_top — one-screen fleet telemetry aggregator (`kubectl top`
+analog for scorer pools).
+
+Scrapes ``GET /metrics`` (falling back to ``/3/Stats`` JSON) from every
+target — pool replicas discovered through the durable store's endpoint
+manifests, an explicitly listed router front door, ad-hoc ``--url``
+targets — and renders fleet-wide request rates, queue/shed pressure,
+scorer-cache residency vs budget, breaker state, and per-target p99
+(interpolated from the ``h2o_request_phase_seconds{phase="total"}``
+histogram the replicas export).
+
+Usage::
+
+    python tools/fleet_top.py --url http://127.0.0.1:54321 \
+        [--url http://router:8080] [--interval 2] [--once] [--json]
+
+    python tools/fleet_top.py --store /var/h2o/poolstore --pool churn \
+        --workdir /var/h2o/pools/churn
+
+``--once`` prints a single snapshot and exits (the scriptable mode the
+drills and docs use); without it the screen redraws every
+``--interval`` seconds until Ctrl-C. Device-free: scraping never
+touches jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from h2o_kubernetes_tpu.runtime import telemetry  # noqa: E402
+
+
+def _get(url: str, path: str, timeout: float = 3.0):
+    with urllib.request.urlopen(url.rstrip("/") + path,
+                                timeout=timeout) as r:
+        return r.read().decode()
+
+
+def discover_store_endpoints(store_root: str, pool: str,
+                             workdir: str | None) -> list[str]:
+    """Replica endpoints via the operator's own machinery: the durable
+    store's status (routable endpoints the reconciler published) plus
+    any pod manifests under the workdir (covers an operator that died
+    before publishing)."""
+    from h2o_kubernetes_tpu.operator.store import DurablePoolStore
+
+    urls: list[str] = []
+    try:
+        st = DurablePoolStore(store_root).get_status(pool) or {}
+        for ep in st.get("endpoints") or ():
+            urls.append(str(ep))
+    except Exception:  # noqa: BLE001 — discovery is best-effort
+        pass
+    if workdir:
+        pods = os.path.join(workdir, "pods")
+        if os.path.isdir(pods):
+            for name in sorted(os.listdir(pods)):
+                try:
+                    with open(os.path.join(pods, name)) as f:
+                        man = json.load(f)
+                    port = man.get("port")
+                    if port:
+                        urls.append(f"http://127.0.0.1:{port}")
+                except Exception:  # noqa: BLE001
+                    continue
+    seen, out = set(), []
+    for u in urls:
+        u = u.rstrip("/")
+        if u not in seen:
+            seen.add(u)
+            out.append(u)
+    return out
+
+
+def _metric(parsed: dict, name: str, **labels) -> float | None:
+    want = tuple(sorted(labels.items()))
+    for (n, lbls), v in parsed.items():
+        if n == name and (not want or lbls == want):
+            return v
+    return None
+
+
+def _metric_sum(parsed: dict, name: str) -> float:
+    return sum(v for (n, _l), v in parsed.items() if n == name)
+
+
+def _hist_p99(parsed: dict, name: str, **labels) -> float | None:
+    """p99 off the cumulative buckets of a Prometheus histogram in
+    ``parsed`` (linear interpolation — same math as
+    Histogram.quantile)."""
+    want = tuple(sorted(labels.items()))
+    buckets = []
+    for (n, lbls), v in parsed.items():
+        if n != name + "_bucket":
+            continue
+        d = dict(lbls)
+        le = d.pop("le", None)
+        if tuple(sorted(d.items())) != want or le is None:
+            continue
+        buckets.append((float("inf") if le == "+Inf" else float(le), v))
+    if not buckets:
+        return None
+    buckets.sort()
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    prev_b, prev_c = 0.0, 0.0
+    for b, c in buckets:
+        if c >= target:
+            if b == float("inf"):
+                return prev_b
+            span = c - prev_c
+            frac = (target - prev_c) / span if span else 1.0
+            return prev_b + (b - prev_b) * frac
+        prev_b, prev_c = b, c
+    return buckets[-2][0] if len(buckets) > 1 else buckets[0][0]
+
+
+def scrape(url: str) -> dict:
+    """One target's summarized row. Prefers /metrics; a target that
+    only speaks JSON (older build) falls back to /3/Stats."""
+    row = {"url": url, "up": False}
+    t0 = time.monotonic()
+    try:
+        text = _get(url, "/metrics")
+        row["scrape_ms"] = round((time.monotonic() - t0) * 1000.0, 2)
+        row["scrape_bytes"] = len(text)
+        p = telemetry.parse_prometheus_text(text)
+        row["up"] = True
+        is_router = _metric(p, "h2o_stats_router_router") is not None
+        is_operator = any(k[0].startswith("h2o_stats_operator_")
+                          for k in p)
+        row["kind"] = "router" if is_router else \
+            ("operator" if is_operator else "replica")
+        if is_router:
+            row["requests"] = _metric(
+                p, "h2o_stats_router_stats_requests") or 0
+            row["errors"] = (_metric(
+                p, "h2o_stats_router_stats_relayed_5xx") or 0) + (
+                _metric(p, "h2o_stats_router_stats_transport_errors")
+                or 0)
+            row["retries"] = _metric(
+                p, "h2o_stats_router_stats_retries") or 0
+            row["hedges"] = _metric(
+                p, "h2o_stats_router_stats_hedges") or 0
+            row["degraded"] = _metric(
+                p, "h2o_stats_router_stats_degraded_503") or 0
+            row["p99_ms"] = _ms(_hist_p99(p, "h2o_router_route_seconds"))
+        else:
+            row["requests"] = _metric(
+                p, "h2o_stats_batcher_requests") or 0
+            row["queue"] = _metric(
+                p, "h2o_stats_batcher_queue_depth") or 0
+            row["shed"] = (_metric(p, "h2o_stats_batcher_shed") or 0) \
+                + (_metric(p, "h2o_stats_batcher_fairness_shed") or 0)
+            row["deadline_504"] = _metric(
+                p, "h2o_stats_counters_deadline_504") or 0
+            row["cache_bytes"] = _metric(
+                p, "h2o_stats_scorer_cache_resident_bytes") or 0
+            row["cache_budget"] = _metric(
+                p, "h2o_stats_scorer_cache_budget_bytes") or 0
+            row["resident"] = _metric(
+                p, "h2o_stats_scorer_cache_resident") or 0
+            # breaker column only when the target EXPORTS the
+            # lifecycle group (the operator status listener doesn't —
+            # absence must render '-', never a false OPEN alarm)
+            if any(k[0] == "h2o_stats_lifecycle_breaker_state"
+                   for k in p):
+                row["breaker_open"] = 0.0 if _metric(
+                    p, "h2o_stats_lifecycle_breaker_state",
+                    value="closed") else 1.0
+            row["p99_ms"] = _ms(_hist_p99(
+                p, "h2o_request_phase_seconds", phase="total"))
+        return row
+    except Exception:  # noqa: BLE001 — fall back to JSON
+        pass
+    try:
+        st = json.loads(_get(url, "/3/Stats"))
+        row["scrape_ms"] = round((time.monotonic() - t0) * 1000.0, 2)
+        row["up"] = True
+        if st.get("router"):
+            row["kind"] = "router"
+            row["requests"] = st["stats"]["requests"]
+            row["retries"] = st["stats"]["retries"]
+        else:
+            row["kind"] = "replica"
+            row["requests"] = st["batcher"]["requests"]
+            row["queue"] = st["batcher"]["queue_depth"]
+            row["shed"] = st["batcher"]["shed"]
+    except Exception as e:  # noqa: BLE001
+        row["error"] = repr(e)[:120]
+    return row
+
+
+def _ms(v: float | None) -> float | None:
+    return None if v is None else round(v * 1000.0, 2)
+
+
+def _fmt(v, width: int, suffix: str = "") -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float) and not v.is_integer():
+        return f"{v:.1f}{suffix}".rjust(width)
+    return f"{int(v)}{suffix}".rjust(width)
+
+
+def render(rows: list[dict], prev: dict | None,
+           interval: float) -> str:
+    """The one screen: per-target rows + fleet totals. ``prev`` maps
+    url -> last requests counter for the rate column."""
+    out = []
+    b = telemetry.build_info()
+    out.append(f"fleet_top  {time.strftime('%H:%M:%S')}  "
+               f"build={b.get('version')} jax={b.get('jax')} "
+               f"host={b.get('hostfp')}")
+    hdr = (f"{'TARGET':<28}{'KIND':>8}{'UP':>4}{'REQS':>10}"
+           f"{'RATE/S':>8}{'QUEUE':>7}{'SHED':>7}{'P99MS':>8}"
+           f"{'CACHE':>12}{'BRKR':>6}")
+    out.append(hdr)
+    tot_reqs = tot_rate = 0.0
+    for r in rows:
+        url = r["url"].replace("http://", "")
+        reqs = r.get("requests")
+        rate = None
+        if reqs is not None and prev is not None and \
+                r["url"] in prev and interval > 0:
+            rate = max(0.0, (reqs - prev[r["url"]]) / interval)
+            tot_rate += rate
+        tot_reqs += reqs or 0
+        cache = None
+        if r.get("cache_budget"):
+            cache = (f"{r.get('cache_bytes', 0) / 2**20:.1f}/"
+                     f"{r['cache_budget'] / 2**20:.0f}M")
+        brkr = None
+        if r.get("breaker_open") is not None:
+            brkr = "OPEN" if r["breaker_open"] else "ok"
+        out.append(
+            f"{url:<28}{r.get('kind', '?'):>8}"
+            f"{('y' if r['up'] else 'N'):>4}"
+            f"{_fmt(reqs, 10)}{_fmt(rate, 8)}"
+            f"{_fmt(r.get('queue'), 7)}{_fmt(r.get('shed'), 7)}"
+            f"{_fmt(r.get('p99_ms'), 8)}"
+            f"{(cache or '-'):>12}{(brkr or '-'):>6}")
+    up = sum(1 for r in rows if r["up"])
+    out.append(f"targets {up}/{len(rows)} up   fleet reqs "
+               f"{int(tot_reqs)}   rate {tot_rate:.1f}/s   "
+               f"scrape "
+               f"{sum(r.get('scrape_ms') or 0 for r in rows):.1f}ms")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", action="append", default=[],
+                    help="target base URL (repeatable): replicas, "
+                    "the router front door, an operator status "
+                    "listener")
+    ap.add_argument("--store", help="DurablePoolStore root — discover "
+                    "replica endpoints from the pool status")
+    ap.add_argument("--pool")
+    ap.add_argument("--workdir", help="pool workdir (pod manifests) "
+                    "for discovery when the status has no endpoints")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="emit raw row dicts as JSON instead of the "
+                    "screen (scripting)")
+    args = ap.parse_args(argv)
+
+    targets = list(args.url)
+    if args.store and args.pool:
+        targets += discover_store_endpoints(args.store, args.pool,
+                                            args.workdir)
+    if not targets:
+        ap.error("no targets: pass --url or --store/--pool")
+
+    prev: dict | None = None
+    while True:
+        rows = [scrape(u) for u in targets]
+        if args.json:
+            print(json.dumps(rows))
+        else:
+            screen = render(rows, prev, args.interval)
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")   # clear + home
+            print(screen, flush=True)
+        if args.once:
+            return 0 if any(r["up"] for r in rows) else 1
+        prev = {r["url"]: r.get("requests") or 0 for r in rows}
+        try:
+            time.sleep(max(0.2, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

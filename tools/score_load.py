@@ -767,6 +767,27 @@ def _storm_leg(url: str, hot_key: str, tail_key: str,
             "tail_slo_met": slo_met}
 
 
+def _metrics_scrape(url: str) -> dict:
+    """Time one GET /metrics against a serving target: the bench
+    records exposition cost alongside the serving p99 so the artifact
+    can state what a Prometheus scrape adds at the measured shape
+    (acceptance note: < 1% of the storm-shape p99)."""
+    import time as _time
+    import urllib.request
+
+    t0 = _time.monotonic()
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/metrics",
+                                    timeout=10) as r:
+            body = r.read()
+        return {"ok": True, "ms": round(
+            (_time.monotonic() - t0) * 1000.0, 3),
+            "bytes": len(body)}
+    except Exception as e:  # noqa: BLE001 — the bench must not die
+        return {"ok": False, "error": repr(e)[:120],
+                "ms": round((_time.monotonic() - t0) * 1000.0, 3)}
+
+
 def run_zipf_bench(n_models: int = 100, seconds: float = 15.0,
                    zipf_s: float = 1.1, budget_mb: float = 4.0,
                    concurrency: int = 6, rows_per_request: int = 16,
@@ -795,10 +816,15 @@ def run_zipf_bench(n_models: int = 100, seconds: float = 15.0,
     try:
         srv, url, keys, columns = _self_server_tenants(
             n_models, seed=seed)
+        scrape_before = _metrics_scrape(url)
         sweep = run_load_zipf(
             url, keys, columns, concurrency=concurrency,
             rows_per_request=rows_per_request, seconds=seconds,
             zipf_s=zipf_s, seed=seed)
+        # /metrics AFTER the sweep: the exposition now carries the
+        # full tenant series set — this is the scrape cost a live
+        # fleet pays per Prometheus interval
+        scrape_after = _metrics_scrape(url)
 
         # 2. evict→promote bitwise parity on a live tenant
         from h2o_kubernetes_tpu import rest
@@ -832,6 +858,8 @@ def run_zipf_bench(n_models: int = 100, seconds: float = 15.0,
             "storm_unfair": storm_unfair,
             "scorer_cache_final": final.get("scorer_cache"),
             "compiles_final": final.get("compiles"),
+            "metrics_scrape": {"before": scrape_before,
+                               "after": scrape_after},
         }
     finally:
         for k, v in saved.items():
@@ -877,13 +905,20 @@ def run_router_bench(tenants: int = 120, shards: int = 3,
         try:
             targets = [fx.router_url] if use_router else \
                 fx.pool.endpoints
+            scrape_target = fx.router_url if use_router else \
+                (fx.pool.endpoints()[0] if callable(fx.pool.endpoints)
+                 else fx.pool.endpoints[0])
+            scrape_before = _metrics_scrape(scrape_target)
             out = run_load_zipf(
                 targets, fx.tenant_keys, fx.feature_cols,
                 concurrency=concurrency,
                 rows_per_request=rows_per_request, seconds=seconds,
                 zipf_s=zipf_s, seed=seed, router=use_router)
+            scrape_after = _metrics_scrape(scrape_target)
             deciles = out.get("deciles") or []
             return {
+                "metrics_scrape": {"before": scrape_before,
+                                   "after": scrape_after},
                 "rows_per_s": out["value"],
                 "requests": out["requests"],
                 "p50_ms": out["p50_ms"],

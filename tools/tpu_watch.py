@@ -81,6 +81,17 @@ def run_json(cmd, timeout, env=None):
     return r.returncode == 0, obj, tail
 
 
+def _build_block() -> dict:
+    """Which build produced this artifact (ISSUE 14: every capture
+    states its package/jax versions, pid, host fingerprint)."""
+    try:
+        from h2o_kubernetes_tpu.runtime.telemetry import build_info
+
+        return build_info()
+    except Exception as e:  # noqa: BLE001 — the watch must not die
+        return {"error": repr(e)[:120]}
+
+
 def capture() -> float | None:
     """Gate + bench on the live chip. Returns bench value or None."""
     log("chip is live — running kernel gate")
@@ -89,6 +100,7 @@ def capture() -> float | None:
         GATE_TIMEOUT)
     if gate is not None:
         gate["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        gate["build"] = _build_block()
         with open(os.path.join(REPO, "TPU_GATE_r05.json"), "w") as f:
             json.dump(gate, f, indent=1)
     log(f"gate ok={ok} result={json.dumps(gate)[:300] if gate else tail}")
@@ -99,6 +111,7 @@ def capture() -> float | None:
         log(f"bench produced no JSON: {tail}")
         return None
     bench["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    bench["build"] = _build_block()
     log(f"bench ok={ok} result={json.dumps(bench)[:300]}")
     if bench.get("platform") != "tpu":
         log("bench fell back to CPU despite live probe — not recording")
@@ -183,7 +196,7 @@ def capture() -> float | None:
     # the round's named evidence): the non-GBM BASELINE configs (GLM
     # iters/sec, DRF HIGGS on the unit-hess path, XGBoost hist,
     # lambdarank, DL, Word2Vec)
-    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r12.json")
+    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r13.json")
     if not os.path.exists(suite_path):
         log("running bench_suite on chip")
         ok, suite, tail = run_json(
