@@ -77,6 +77,14 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_ROUTER_HEALTH_INTERVAL | 0.5 | seconds between router health sweeps over every replica's /3/Stats; each scrape rides the shared probe helper (H2O_TPU_POOL_PROBE_TIMEOUT + 3 attempts before unhealthy, so a scoring burst can't flap a shard out of the ring) |
 | H2O_TPU_ROUTER_MAX_INFLIGHT | 256 | router admission bound on concurrently forwarded requests; past it 429 + Retry-After (<=0 unbounded) |
 | H2O_TPU_ROUTER_TIMEOUT | 30 | per-forward upstream timeout on the router, seconds; clamped under the request's remaining X-H2O-Deadline-Ms budget |
+| H2O_TPU_ROUTER_TABLE_INTERVAL | 0 | extra throttle, seconds, between STORE reads of the published routing table by a stateless router (`StoreRoutingTable`); 0 = refresh on every health sweep (operator/router.py, docs/OPERATOR.md "Router HA & rebalancing") |
+| H2O_TPU_LEASE_TTL | 5 | controller-lease TTL, seconds: an `operator.run --ha` replica that misses renewals this long is structurally deposed (epoch bump fences its routing writes) and a standby takes over (operator/spec.py, docs/OPERATOR.md) |
+| H2O_TPU_LEASE_HEARTBEAT | ttl/3 | seconds between the lease holder's renew heartbeats (operator/run.py) |
+| H2O_TPU_REBALANCE | 0 (off) | live hot-shard rebalancing: 1 lets the controller MOVE a sustained-pressure tenant to the next healthy shard in its HRW preference, make-before-break (operator/reconcile.py, docs/OPERATOR.md "Router HA & rebalancing") |
+| H2O_TPU_REBALANCE_SUSTAIN | 3 | consecutive reconcile passes a tenant's shed/504 delta must stay positive before it counts as hot — one blip never moves anyone |
+| H2O_TPU_REBALANCE_COOLDOWN | 30 | seconds between moves, fleet-wide: rebalancing converges one tenant at a time instead of thrashing |
+| H2O_TPU_REBALANCE_RETIRE_S | 5 | make-before-break dwell: seconds the move's SOURCE keeps serving after the destination took routing-preference position 0, and only while the destination stays healthy |
+| H2O_TPU_REBALANCE_FAILBACK_S | 30 | failback hygiene for loss-driven re-placements: once every home shard of an overridden tenant has been healthy this long, the override copies age out of the survivor's child spec and the routing table |
 | H2O_TPU_METRICS_TOPK | 20 | fleet telemetry: per-metric series cap for tenant-cardinality labels (`model`) — the top-K label values by traffic keep their own series, everything else rolls into `other`, so 1000 tenants cost K+1 series on GET /metrics (runtime/telemetry.py, docs/OBSERVABILITY.md) |
 | H2O_TPU_METRICS_PORT | — (off) | operator.run status listener: bind /metrics + /healthz on this port so the control plane is scrapeable like any replica (0 = ephemeral; `--status-port` overrides) |
 | H2O_TPU_TRACE | 1 | 0 disables request-span recording (trace ring + per-request phase histograms) — the tracing perf kill switch; counters and /metrics stay on (runtime/telemetry.py) |

@@ -53,11 +53,12 @@ rolling-update contract, and the autoscale signal; tools/chaos.py's
 stack end to end.
 """
 
-from .placement import PlacementPlan, plan_placement, shard_preference
+from .placement import (PlacementPlan, move_destination,
+                        plan_placement, shard_preference)
 from .registry import FlatTreeScorer, ModelRegistry, load_artifact
 from .reconcile import (AdoptedReplica, Reconciler, ScorerReplica,
                         ShardedPool)
-from .router import ScoringRouter, start_router
+from .router import ScoringRouter, StoreRoutingTable, start_router
 from .spec import PoolStore, ScorerPoolSpec, StaleGenerationError
 from .store import DurablePoolStore
 
@@ -65,5 +66,5 @@ __all__ = ["ScorerPoolSpec", "PoolStore", "DurablePoolStore",
            "StaleGenerationError", "ModelRegistry", "FlatTreeScorer",
            "load_artifact", "Reconciler", "ScorerReplica",
            "AdoptedReplica", "ShardedPool", "PlacementPlan",
-           "plan_placement", "shard_preference", "ScoringRouter",
-           "start_router"]
+           "plan_placement", "shard_preference", "move_destination",
+           "ScoringRouter", "StoreRoutingTable", "start_router"]

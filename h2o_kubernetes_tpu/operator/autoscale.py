@@ -34,7 +34,26 @@ from __future__ import annotations
 from ..runtime.retry import _env_float
 from .spec import ScorerPoolSpec
 
-__all__ = ["desired_replicas"]
+__all__ = ["desired_replicas", "pressure_by_model"]
+
+
+def pressure_by_model(samples: list[dict],
+                      model_keys: "set | None" = None) -> dict:
+    """Cumulative shed + deadline-504 count PER TENANT across replicas
+    (/3/Stats ``models``) — the hot-shard rebalance attribution
+    signal: the same per-model counters the shard-aware autoscale
+    reads, but kept per key so the controller can name WHICH tenant's
+    pressure is sustained and move that one, not guess. ``model_keys``
+    restricts to the shard's own placed tenants."""
+    out: dict = {}
+    for s in samples:
+        for key, m in (s.get("models") or {}).items():
+            if model_keys is not None and key not in model_keys:
+                continue
+            out[key] = out.get(key, 0) \
+                + int(m.get("shed") or 0) \
+                + int(m.get("deadline_504") or 0)
+    return out
 
 
 def _totals(samples: list[dict],

@@ -32,7 +32,7 @@ from dataclasses import dataclass
 from typing import Iterable, Sequence
 
 __all__ = ["PlacementPlan", "plan_placement", "shard_preference",
-           "hrw_score"]
+           "hrw_score", "move_destination"]
 
 
 def hrw_score(key: str, shard: str) -> int:
@@ -77,6 +77,25 @@ class PlacementPlan:
 
     def tail_keys(self) -> list:
         return [k for k in self.assignments if k not in self.head_keys]
+
+
+def move_destination(key: str, shards: Iterable[str],
+                     exclude: Iterable[str] = (),
+                     healthy: dict | None = None) -> str | None:
+    """The make-before-break move target for ``key``: the first shard
+    in its rendezvous preference order that is not already placed
+    (``exclude``) and — when a health map is given — currently serving.
+    Deterministic like everything else here, so a restarted controller
+    re-derives the same destination for the same fleet state. None
+    when every candidate is excluded or down (the move waits)."""
+    exclude = set(exclude)
+    for sid in shard_preference(key, shards):
+        if sid in exclude:
+            continue
+        if healthy is not None and not healthy.get(sid):
+            continue
+        return sid
+    return None
 
 
 def plan_placement(keys: Sequence[str], shards: Sequence[str],

@@ -47,10 +47,11 @@ import urllib.request
 from dataclasses import replace as _dc_replace
 
 from ..runtime.retry import _env_float
-from .placement import PlacementPlan, plan_placement, shard_preference
+from .placement import (PlacementPlan, move_destination,
+                        plan_placement, shard_preference)
 from .probe import probe_json
 from .registry import ModelRegistry
-from .spec import PoolStore, ScorerPoolSpec
+from .spec import PoolStore, ScorerPoolSpec, StaleGenerationError
 
 __all__ = ["Reconciler", "ScorerReplica", "AdoptedReplica",
            "ShardedPool", "PENDING", "STARTING", "LOADING", "READY",
@@ -107,6 +108,37 @@ def _backoff_window() -> float:
 
 def _rollout_retries() -> int:
     return max(1, int(_env_float("H2O_TPU_POOL_ROLLOUT_RETRIES", 3)))
+
+
+def _rebalance_enabled() -> bool:
+    """Hot-shard rebalancing kill switch (default OFF: moving tenants
+    under load is an operator policy, not a default behavior)."""
+    return _env_float("H2O_TPU_REBALANCE", 0.0) > 0
+
+
+def _rebalance_sustain() -> int:
+    """Consecutive pressure passes before a move fires — one shed
+    burst must not trigger a tenant migration."""
+    return max(1, int(_env_float("H2O_TPU_REBALANCE_SUSTAIN", 3)))
+
+
+def _rebalance_cooldown() -> float:
+    """Seconds between moves, fleet-wide: rebalancing converges one
+    tenant at a time, never a thundering migration."""
+    return max(0.0, _env_float("H2O_TPU_REBALANCE_COOLDOWN", 30.0))
+
+
+def _rebalance_retire_s() -> float:
+    """make-before-break dwell: how long the SOURCE keeps serving a
+    moved tenant after the destination went live (routers refresh
+    their table within a health sweep; this must outlast one)."""
+    return max(0.0, _env_float("H2O_TPU_REBALANCE_RETIRE_S", 5.0))
+
+
+def _rebalance_failback_s() -> float:
+    """How long a re-placed tenant's home shard must stay healthy
+    before the override copies age out (failback hygiene)."""
+    return max(0.0, _env_float("H2O_TPU_REBALANCE_FAILBACK_S", 30.0))
 
 
 def _log_max_bytes() -> int:
@@ -1172,11 +1204,35 @@ class ShardedPool:
         # and a shard that died BEFORE the restart would read as
         # "still converging" forever, leaving its tenants degraded
         # with no recovery path
+        # HA: the lease epoch this controller reconciles under (None =
+        # not lease-managed, the single-controller mode). Every routing
+        # publish is fenced on it; a fence rejection marks the
+        # controller DEPOSED — it stops reconciling and leaves its pods
+        # for the new holder to adopt (split-brain ends with exactly
+        # one writer, and no pod is ever killed by the loser).
+        self.lease_epoch: int | None = None
+        self.deposed = False
+        # hot-shard rebalancing state: key -> {"src", "dst", "t",
+        # "state": serving|retired, "retired": [aged-out sources]}.
+        # Deliberately SEPARATE from `overrides`: overrides are
+        # loss-driven copies that failback removes once the home shard
+        # recovers; moves are load-driven placements that persist (a
+        # reverse move is the same primitive, not a failback).
+        self.moves: dict[str, dict] = {}
+        self._tenant_prev: dict[str, dict] = {}   # sid -> per-key totals
+        self._pressure_hits: dict[str, int] = {}  # sid -> consecutive
+        self._healthy_since: dict[str, float] = {}
+        self._last_move_t = 0.0
         st = store.get_status(pool)
         pl = st.get("placement") or {}
         self.overrides = {k: tuple(v) for k, v in
                           (pl.get("overrides") or {}).items()}
         self._ever_healthy = set(pl.get("ever_healthy") or ())
+        # moves resume like overrides do: a restarted (or takeover)
+        # controller must keep serving moved tenants from their
+        # destination, not snap placement back to the plan
+        self.moves = {k: dict(v) for k, v in
+                      (pl.get("moves") or {}).items()}
         self._ensure_children()
 
     # -- derivation -----------------------------------------------------------
@@ -1217,6 +1273,15 @@ class ShardedPool:
         for key, extra_sids in self.overrides.items():
             if sid in extra_sids and key not in keys and key in catalog:
                 keys.append(key)
+        for key, mv in self.moves.items():
+            if key not in catalog:
+                continue
+            if mv.get("dst") == sid and key not in keys:
+                keys.append(key)
+            if sid in (mv.get("retired") or ()) and key in keys:
+                # retired move source: future spawns of this shard no
+                # longer carry the tenant — the destination owns it
+                keys.remove(key)
         extra = tuple(catalog[k] for k in keys if k != spec.model_key)
         replicas = spec.replicas
         try:
@@ -1315,6 +1380,11 @@ class ShardedPool:
         keys = set(self.plan.keys_for(sid)) if self.plan else set()
         keys.update(k for k, sids in self.overrides.items()
                     if sid in sids)
+        for k, mv in self.moves.items():
+            if mv.get("dst") == sid:
+                keys.add(k)
+            if sid in (mv.get("retired") or ()):
+                keys.discard(k)
         self.recs[sid].autoscale_keys = keys
 
     # -- health + re-placement ------------------------------------------------
@@ -1331,8 +1401,25 @@ class ShardedPool:
         return any(r.state == READY and r.alive() for r in reps)
 
     def _placed_shards(self, key: str) -> tuple:
-        return (self.plan.assignments.get(key, ())
+        base = (self.plan.assignments.get(key, ())
                 + self.overrides.get(key, ()))
+        mv = self.moves.get(key)
+        if mv:
+            gone = set(mv.get("retired") or ())
+            base = tuple(s for s in base if s not in gone)
+            if mv.get("state") == "serving":
+                # make-before-break window: the source MUST keep
+                # serving (even a source that itself entered via an
+                # earlier move and is not in the plan)
+                src = mv.get("src")
+                if src and src not in base:
+                    base = base + (src,)
+            dst = mv.get("dst")
+            if dst:
+                # destination first: preference position 0 is what
+                # actually moves the traffic off the hot shard
+                base = (dst,) + tuple(s for s in base if s != dst)
+        return base
 
     def _health_maps(self) -> tuple[dict, dict]:
         """(actual, effective) shard health. ``actual`` is the live
@@ -1454,6 +1541,204 @@ class ShardedPool:
                 break
         return moved
 
+    # -- hot-shard rebalancing (make-before-break moves) ----------------------
+
+    def _move_tenant(self, key: str, src: str, dst: str,
+                     spec: ScorerPoolSpec) -> bool:
+        """Make-before-break move of one tenant: the destination's
+        live replicas get the artifact FIRST (``registry.push``
+        returns only once loaded AND warmed — that IS the destination
+        READY-verification), then the move lands in the routing table
+        with the destination in preference position 0 while the source
+        still serves, and ``_retire_moves`` drops the source only
+        after ``H2O_TPU_REBALANCE_RETIRE_S``. Reversible: a later move
+        in the opposite direction is the same primitive."""
+        if not self._push_tenant(key, dst, spec):
+            return False
+        old = self.moves.get(key) or {}
+        self.moves[key] = {"src": src, "dst": dst, "t": time.time(),
+                           "state": "serving",
+                           "retired": list(old.get("retired") or ())}
+        self._event("tenant_move",
+                    f"'{key}' moving {src} -> {dst} (sustained "
+                    "pressure); source keeps serving until retire")
+        # durable intent for the destination: future spawns carry the
+        # tenant (same artifact version — no rollout rides on a move)
+        try:
+            self.store.apply(self._child_spec(spec, dst, self.plan))
+        except Exception as e:  # noqa: BLE001 — level-triggered retry
+            self._event("tenant_move_spec_error", repr(e)[:200])
+        self._set_autoscale_keys(dst)
+        return True
+
+    def _retire_moves(self) -> int:
+        """Deferred break half: a serving move whose dwell elapsed —
+        and whose destination still serves — retires its source. The
+        source's child spec and autoscale attribution drop the tenant;
+        the next routing publish drops it from the table."""
+        retired = 0
+        spec = None
+        for key, mv in list(self.moves.items()):
+            if mv.get("state") != "serving":
+                continue
+            if time.time() - float(mv.get("t") or 0.0) < \
+                    _rebalance_retire_s():
+                continue
+            if not self.shard_healthy(mv.get("dst", "")):
+                continue        # never break before make held
+            src = mv.get("src")
+            mv["state"] = "retired"
+            mv["retired"] = list(mv.get("retired") or ()) + [src]
+            retired += 1
+            self._event("tenant_move_retired",
+                        f"'{key}' source {src} retired — "
+                        f"{mv['dst']} is the tenant's home now")
+            if src in self.recs:
+                if spec is None:
+                    spec, _ = self.store.get(self.pool)
+                try:
+                    self.store.apply(
+                        self._child_spec(spec, src, self.plan))
+                except Exception as e:  # noqa: BLE001
+                    self._event("tenant_move_spec_error",
+                                repr(e)[:200])
+                self._set_autoscale_keys(src)
+        return retired
+
+    def _failback_once(self) -> int:
+        """Failback hygiene for LOSS-driven re-placements: once every
+        home shard of an overridden tenant has been provably healthy
+        for ``H2O_TPU_REBALANCE_FAILBACK_S``, the override copies age
+        out of the survivor's child spec and the routing table —
+        instead of lingering until the next plan rebuild. (Load-driven
+        ``moves`` are exempt: they ARE the intended placement.)"""
+        if self.plan is None:
+            return 0
+        now = time.monotonic()
+        actual, _ = self._health_maps()
+        for sid, ok in actual.items():
+            if ok:
+                self._healthy_since.setdefault(sid, now)
+            else:
+                self._healthy_since.pop(sid, None)
+        if not self.overrides:
+            return 0
+        wait = _rebalance_failback_s()
+        spec = None
+        dropped = 0
+        for key in list(self.overrides):
+            home = self.plan.assignments.get(key, ())
+            if not home or not all(
+                    self._healthy_since.get(s) is not None
+                    and now - self._healthy_since[s] >= wait
+                    for s in home):
+                continue
+            extras = self.overrides.pop(key)
+            dropped += 1
+            self._event("tenant_failback",
+                        f"'{key}' home shard(s) {list(home)} healthy "
+                        f">= {wait:g}s — override copies on "
+                        f"{list(extras)} age out")
+            if spec is None:
+                spec, _ = self.store.get(self.pool)
+            for sid in extras:
+                if sid in self.recs:
+                    try:
+                        self.store.apply(
+                            self._child_spec(spec, sid, self.plan))
+                    except Exception as e:  # noqa: BLE001
+                        self._event("tenant_failback_spec_error",
+                                    repr(e)[:200])
+                    self._set_autoscale_keys(sid)
+        return dropped
+
+    def _rebalance_once(self) -> int:
+        """Sustained-pressure move trigger (``H2O_TPU_REBALANCE``, off
+        by default): per shard, the per-tenant shed/504 deltas of its
+        OWN placed tenants (the shard-aware autoscale counters) must
+        show pressure for ``H2O_TPU_REBALANCE_SUSTAIN`` consecutive
+        passes; then the hottest movable tenant on that shard moves to
+        the first healthy non-placed shard in its rendezvous
+        preference. One move per cooldown window, fleet-wide."""
+        if self.plan is None or not _rebalance_enabled():
+            return 0
+        from .autoscale import pressure_by_model
+
+        spec, _ = self.store.get(self.pool)
+        actual, _ = self._health_maps()
+        now = time.monotonic()
+        head = set(self.plan.head_keys)
+        moved = 0
+        for sid, rec in self._recs_snapshot().items():
+            with rec._lock:
+                ready = [r for r in rec.replicas if r.state == READY]
+            samples = [s for s in (r.stats() for r in ready) if s]
+            per = pressure_by_model(samples, rec.autoscale_keys)
+            prev = self._tenant_prev.get(sid)
+            self._tenant_prev[sid] = per
+            if prev is None:
+                continue
+            delta = {k: v - prev.get(k, 0) for k, v in per.items()}
+            if any(v < 0 for v in delta.values()):
+                continue     # counter reset (replica restart) — hold
+            delta = {k: v for k, v in delta.items() if v > 0}
+            if not delta:
+                self._pressure_hits[sid] = 0
+                continue
+            hits = self._pressure_hits.get(sid, 0) + 1
+            self._pressure_hits[sid] = hits
+            if hits < _rebalance_sustain():
+                continue
+            if now - self._last_move_t < _rebalance_cooldown() and \
+                    self._last_move_t > 0.0:
+                continue
+            for key in sorted(delta, key=delta.get, reverse=True):
+                if key in head:
+                    continue     # the head is everywhere already
+                if self.moves.get(key, {}).get("state") == "serving":
+                    continue     # one move at a time per tenant
+                placed = self._placed_shards(key)
+                if sid not in placed:
+                    continue
+                dst = move_destination(key, self.plan.shards,
+                                       exclude=placed, healthy=actual)
+                if dst is None:
+                    continue     # nowhere better to go — hold
+                if self._move_tenant(key, sid, dst, spec):
+                    self._last_move_t = time.monotonic()
+                    self._pressure_hits[sid] = 0
+                    moved += 1
+                break
+        return moved
+
+    # -- routing publication (the N-router contract) --------------------------
+
+    def _publish_routing(self) -> None:
+        """Publish the routing table through the store, fenced on this
+        controller's lease epoch. A fence rejection means a newer
+        holder took over: this controller is DEPOSED — it stops
+        reconciling and leaves its pods for the new holder to adopt
+        (split-brain resolves to exactly one writer; no pod dies)."""
+        if self.deposed:
+            return
+        table = self.routing_table()
+        try:
+            gen = self.store.publish_routing(self.pool, table,
+                                             epoch=self.lease_epoch)
+        except StaleGenerationError as e:
+            self.deposed = True
+            self._event("controller_deposed", repr(e)[:200])
+            return
+        except Exception as e:  # noqa: BLE001 — publish retries
+            self._event("routing_publish_error", repr(e)[:200])
+            return
+        from ..runtime.telemetry import REGISTRY
+
+        REGISTRY.gauge(
+            "h2o_operator_table_generation",
+            "routing-table generation last published by this "
+            "controller").set(float(gen))
+
     # -- the loop -------------------------------------------------------------
 
     def reconcile_once(self) -> None:
@@ -1471,7 +1756,11 @@ class ShardedPool:
             rec.reconcile_once()
             rec.autoscale_once()
         self._replace_once()
+        self._rebalance_once()
+        self._retire_moves()
+        self._failback_once()
         self._publish_status()
+        self._publish_routing()
 
     def _sync_child_threads(self, interval: float | None) -> None:
         """Every shard in the child map gets a running reconciler
@@ -1506,9 +1795,18 @@ class ShardedPool:
                 self._ensure_children()
                 self._sync_child_threads(interval)
                 self._replace_once()
+                self._rebalance_once()
+                self._retire_moves()
+                self._failback_once()
                 self._publish_status()
+                self._publish_routing()
             except Exception as e:  # noqa: BLE001 — the loop survives
                 self._event("shard_loop_error", repr(e)[:300])
+            if self.deposed:
+                # a newer lease holder owns the fleet: stop
+                # reconciling, leave every pod running — the new
+                # holder adopts them off their manifests
+                break
             stop.wait(interval if interval is not None else _interval())
         for ev in list(self._child_stops.values()):
             ev.set()
@@ -1588,7 +1886,9 @@ class ShardedPool:
                 "overrides": {k: list(v)
                               for k, v in self.overrides.items()},
                 "ever_healthy": sorted(self._ever_healthy),
+                "moves": {k: dict(v) for k, v in self.moves.items()},
             },
+            "lease_epoch": self.lease_epoch,
             "degraded_tenants": orphans[:64],
             "degraded_count": len(orphans),
         }

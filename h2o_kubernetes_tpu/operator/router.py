@@ -63,7 +63,7 @@ from ..runtime import lifecycle, telemetry
 from ..runtime.retry import _env_float
 from .probe import probe_json
 
-__all__ = ["ScoringRouter", "start_router"]
+__all__ = ["ScoringRouter", "StoreRoutingTable", "start_router"]
 
 
 def _retry_budget_rate() -> float:
@@ -93,6 +93,78 @@ def _max_inflight() -> int:
 
 def _router_timeout() -> float:
     return max(0.1, _env_float("H2O_TPU_ROUTER_TIMEOUT", 30.0))
+
+
+def _table_interval() -> float:
+    """Extra throttle between STORE reads of the routing table; 0 =
+    refresh on every health sweep (the default cadence)."""
+    return max(0.0, _env_float("H2O_TPU_ROUTER_TABLE_INTERVAL", 0.0))
+
+
+class StoreRoutingTable:
+    """Store-backed routing-table provider: a zero-arg callable over
+    the controller-published ``<pool>.routing.json`` that makes N
+    ``start_router`` processes interchangeable — none of them holds
+    the table, they all read the one the lease-holding controller
+    writes.
+
+    Invariants the front door depends on:
+
+    - **monotonic**: a document whose ``table_generation`` is LOWER
+      than the last one served is rejected (``stale_rejected``) — a
+      deposed controller's file, or a lagging replica of the store,
+      can never roll a router back to an older placement.
+    - **last-good**: a store read failure (or a vanished document)
+      serves the previous snapshot unchanged (``refresh_errors``) —
+      store unavailability degrades table FRESHNESS, never request
+      serving.
+    - **cold**: before the first document ever lands, the provider
+      returns an empty table marked ``cold`` so the router can answer
+      a typed degraded 503 instead of 404 — it cannot know the
+      catalog yet, so it must not claim a tenant does not exist.
+    """
+
+    def __init__(self, store, pool: str):
+        self.store = store
+        self.pool = pool
+        self.generation = 0
+        self.stats = {"refreshes": 0, "refresh_errors": 0,
+                      "stale_rejected": 0}
+        self._lock = threading.Lock()
+        self._last: dict | None = None
+        self._last_read = 0.0
+
+    def __call__(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            iv = _table_interval()
+            if self._last is not None and iv > 0.0 and \
+                    now - self._last_read < iv:
+                return self._last
+        try:
+            doc = self.store.get_routing(self.pool)
+        except Exception:  # noqa: BLE001 — store down: serve last-good
+            doc = None
+            with self._lock:
+                self.stats["refresh_errors"] += 1
+        with self._lock:
+            self._last_read = now
+            if doc is not None:
+                gen = int(doc.get("table_generation", 0))
+                if gen >= self.generation:
+                    self.generation = gen
+                    self._last = doc
+                    self.stats["refreshes"] += 1
+                else:
+                    self.stats["stale_rejected"] += 1
+            if self._last is not None:
+                return self._last
+            return {"keys": {}, "shards": {}, "cold": True,
+                    "table_generation": 0}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"generation": self.generation, **self.stats}
 
 
 class _Transport(Exception):
@@ -156,6 +228,13 @@ class ScoringRouter:
         t = self.get_table()
         with self._lock:
             self._table = t
+        gen = t.get("table_generation") if isinstance(t, dict) else None
+        if gen is not None:
+            telemetry.REGISTRY.gauge(
+                "h2o_router_table_generation",
+                "routing-table generation this router serves from "
+                "(store-backed providers only bump it forward)"
+            ).set(float(gen))
         return t
 
     def table(self) -> dict:
@@ -451,6 +530,21 @@ class ScoringRouter:
             self.stats["requests"] += 1
         known, cands = self.candidates(model_key)
         if not known:
+            if self.table().get("cold"):
+                # a store-backed router that has never seen a table
+                # cannot distinguish "unknown tenant" from "table not
+                # yet published" — a typed degraded 503 keeps the
+                # client retrying instead of a 404 that lies about
+                # the catalog
+                with self._lock:
+                    self.stats["degraded_503"] += 1
+                return 503, json.dumps(
+                    {"__schema": "H2OErrorV3", "http_status": 503,
+                     "msg": "router has no routing table yet (store "
+                     "cold or controller not elected); retry shortly",
+                     "hint": "table_pending",
+                     "model": model_key}).encode(), \
+                    {"Retry-After": "1"}
             with self._lock:
                 self.stats["unknown_model_404"] += 1
             return 404, json.dumps(
@@ -779,14 +873,22 @@ class ScoringRouter:
             by_shard = {k: dict(v) for k, v in self.by_shard.items()}
             by_model = dict(self.by_model)
             inflight = self._inflight
-        return {"router": True, "stats": stats,
-                "retry_budget": {**budget,
-                                 "rate_per_s": _retry_budget_rate()},
-                "by_shard": by_shard, "by_model": by_model,
-                "inflight": inflight,
-                "hedge_ms": _hedge_ms(),
-                "shards": self.shard_health(),
-                "build": telemetry.build_info()}
+        tbl = self.table()
+        gen = tbl.get("table_generation") if isinstance(tbl, dict) \
+            else None
+        out = {"router": True, "stats": stats,
+               "retry_budget": {**budget,
+                                "rate_per_s": _retry_budget_rate()},
+               "by_shard": by_shard, "by_model": by_model,
+               "inflight": inflight,
+               "hedge_ms": _hedge_ms(),
+               "table_generation": gen,
+               "shards": self.shard_health(),
+               "build": telemetry.build_info()}
+        prov = getattr(self.get_table, "snapshot", None)
+        if callable(prov):
+            out["table_provider"] = prov()
+        return out
 
 
 def _make_handler(router: ScoringRouter):
@@ -935,3 +1037,45 @@ def start_router(table, port: int = 0, host: str = "127.0.0.1"
                          name="h2o-tpu-router", daemon=True)
     t.start()
     return srv, router
+
+
+def main(argv=None) -> int:
+    """``python -m h2o_kubernetes_tpu.operator.router --store ROOT
+    --pool NAME [--port P]`` — one stateless router process over a
+    durable store root. Start N of them behind any TCP balancer: they
+    share nothing but the store, so killing any one of them loses
+    nothing but its in-flight sockets."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(
+        description="store-backed front-door scoring router")
+    ap.add_argument("--store", required=True,
+                    help="DurablePoolStore root (dir or mem://)")
+    ap.add_argument("--pool", required=True, help="pool name")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--host", default="127.0.0.1")
+    args = ap.parse_args(argv)
+
+    from .store import DurablePoolStore
+
+    provider = StoreRoutingTable(DurablePoolStore(args.store),
+                                 args.pool)
+    srv, router = start_router(provider, port=args.port,
+                               host=args.host)
+    print(f"ROUTER_UP port={srv.server_address[1]} "
+          f"pool={args.pool}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    finally:
+        router.stop()
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

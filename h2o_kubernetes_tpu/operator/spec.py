@@ -12,6 +12,8 @@ kubeconfig-backed store can implement later without changing it.
 from __future__ import annotations
 
 import collections
+import contextlib
+import json
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -172,6 +174,8 @@ class PoolStore:
         self._gens: dict[str, int] = {}
         self._status: dict[str, dict] = {}
         self._events: dict[str, collections.deque] = {}
+        self._routing: dict[str, dict] = {}
+        self._leases: dict[str, dict] = {}
 
     # -- durability hooks (no-ops on the in-memory store) ---------------------
     #
@@ -193,6 +197,22 @@ class PoolStore:
 
     def _forget(self, name: str) -> None:
         """Called under the lock after `name` is deleted."""
+
+    def _persist_routing(self, name: str) -> None:
+        """Called under the lock after a routing-table publish."""
+
+    def _persist_lease(self, name: str) -> None:
+        """Called under the lock after a lease mutation (a cleared
+        lease persists as 'gone', not as a stale document)."""
+
+    def _lease_guard(self, name: str):
+        """Context manager serializing lease read-decide-write cycles
+        ACROSS store instances. The in-memory store has exactly one
+        instance per universe, so ``self._lock`` already suffices; a
+        durable subclass shared by N processes must override this with
+        a cross-process lock (flock) or the read-then-bump in
+        ``acquire_lease`` races between two expired-lease claimants."""
+        return contextlib.nullcontext()
 
     def _check_fence(self, name: str, fence: int | None) -> None:
         if fence is not None and fence != self._gens.get(name, 0):
@@ -247,6 +267,8 @@ class PoolStore:
             self._gens.pop(name, None)
             self._status.pop(name, None)
             self._events.pop(name, None)
+            self._routing.pop(name, None)
+            self._leases.pop(name, None)
             self._forget(name)
 
     # -- status + events (the observed side) ----------------------------------
@@ -285,3 +307,138 @@ class PoolStore:
         with self._lock:
             self._refresh(name)
             return list(self._events.get(name, ()))
+
+    # -- routing table (the front-door side) ----------------------------------
+    #
+    # The sharded controller publishes its routing table through the
+    # store so N stateless routers can serve from one source of truth
+    # (<pool>.routing.json on a durable root — controller-written,
+    # router-read, same single-writer discipline as the state file).
+    # ``table_generation`` is store-owned and monotonic, and bumps
+    # ONLY when the table content changes: routers reject regressions
+    # (a stale controller can never roll a newer table back), and an
+    # unchanged republish every reconcile pass costs no churn.
+
+    def publish_routing(self, name: str, table: dict,
+                        epoch: int | None = None) -> int:
+        """Publish the controller's routing table; returns the
+        ``table_generation`` now current. ``epoch`` is the writer's
+        lease epoch: when the pool's lease has moved past it, the
+        writer was deposed and the publish raises
+        :class:`StaleGenerationError` (split-brain fence — a new
+        holder's takeover bumps the epoch, so the old holder's queued
+        tables lose deterministically, never merge)."""
+        # JSON-normalize so the content compare is stable across the
+        # durable round-trip (tuples become lists, key order sorts)
+        body = json.loads(json.dumps(
+            {k: v for k, v in dict(table).items()
+             if k != "table_generation"}, sort_keys=True))
+        with self._lock:
+            self._refresh(name)
+            if epoch is not None:
+                lease = self._leases.get(name)
+                if lease is not None and \
+                        int(lease.get("epoch", 0)) > int(epoch):
+                    raise StaleGenerationError(
+                        f"pool '{name}': routing publish fenced at "
+                        f"lease epoch {epoch} but the lease is at "
+                        f"epoch {lease.get('epoch')} — deposed "
+                        "controller write rejected")
+            cur = self._routing.get(name)
+            if cur is not None:
+                if {k: v for k, v in cur.items()
+                        if k != "table_generation"} == body:
+                    return int(cur["table_generation"])
+            gen = (int(cur["table_generation"]) + 1) if cur else 1
+            self._routing[name] = {"table_generation": gen, **body}
+            self._persist_routing(name)
+            return gen
+
+    def get_routing(self, name: str) -> dict | None:
+        """The last published routing doc (with ``table_generation``),
+        or None if nothing was ever published."""
+        with self._lock:
+            self._refresh(name)
+            doc = self._routing.get(name)
+            return json.loads(json.dumps(doc)) if doc is not None \
+                else None
+
+    # -- controller lease (the HA side) ---------------------------------------
+    #
+    # A wall-clock TTL lease elects exactly one reconciling controller
+    # out of N ``operator.run`` replicas. The epoch bumps on every
+    # ownership change (takeover OR expired re-acquire), and doubles
+    # as the write fence for publish_routing above: holding the lease
+    # file is advisory, holding a CURRENT epoch is what lets writes
+    # land — so a paused/partitioned holder that misses its heartbeat
+    # window is structurally deposed, not just presumed dead.
+
+    @staticmethod
+    def _lease_expired(lease: dict, now: float) -> bool:
+        return now - float(lease.get("renewed", 0.0)) > \
+            float(lease.get("ttl", 0.0))
+
+    def acquire_lease(self, name: str, holder: str,
+                      ttl: float) -> int | None:
+        """Try to take (or keep) the controller lease. Returns the
+        lease epoch on success; None while another holder's lease is
+        still live. Re-acquiring one's own live lease renews it
+        without an epoch bump; claiming an expired lease bumps it."""
+        now = time.time()
+        with self._lease_guard(name):
+            with self._lock:
+                self._refresh(name)
+                cur = self._leases.get(name)
+                if cur is not None and not self._lease_expired(cur, now):
+                    if cur.get("holder") != holder:
+                        return None
+                    self._leases[name] = dict(cur, renewed=now,
+                                              ttl=float(ttl))
+                    self._persist_lease(name)
+                    return int(cur["epoch"])
+                epoch = (int(cur.get("epoch", 0)) + 1) if cur else 1
+                self._leases[name] = {
+                    "holder": holder, "epoch": epoch,
+                    "ttl": float(ttl), "renewed": now, "acquired": now}
+                self._persist_lease(name)
+                return epoch
+
+    def renew_lease(self, name: str, holder: str, epoch: int) -> bool:
+        """Heartbeat. Strict: False when the lease expired, changed
+        hands, or the epoch moved — the caller must stop reconciling
+        immediately (its routing writes are already fenced off)."""
+        now = time.time()
+        with self._lease_guard(name):
+            with self._lock:
+                self._refresh(name)
+                cur = self._leases.get(name)
+                if (cur is None or cur.get("holder") != holder
+                        or int(cur.get("epoch", 0)) != int(epoch)
+                        or self._lease_expired(cur, now)):
+                    return False
+                self._leases[name] = dict(cur, renewed=now)
+                self._persist_lease(name)
+                return True
+
+    def get_lease(self, name: str) -> dict | None:
+        with self._lock:
+            self._refresh(name)
+            doc = self._leases.get(name)
+            return dict(doc) if doc is not None else None
+
+    def release_lease(self, name: str, holder: str) -> None:
+        """Voluntary handoff (clean shutdown): clears the lease so a
+        standby takes over on its next poll instead of waiting out the
+        TTL. Only the current holder's release does anything."""
+        with self._lease_guard(name):
+            with self._lock:
+                self._refresh(name)
+                cur = self._leases.get(name)
+                if cur is not None and cur.get("holder") == holder:
+                    # keep the epoch (monotonic forever): dropping it
+                    # would reset the fence and let a long-deposed
+                    # holder's writes land again after a release
+                    self._leases[name] = {
+                        "epoch": int(cur.get("epoch", 0)),
+                        "released": True, "ttl": 0.0, "renewed": 0.0}
+                    self._persist_lease(name)

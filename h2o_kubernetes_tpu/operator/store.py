@@ -10,6 +10,8 @@ subresources:
 
     <root>/<pool>.spec.json    {"generation", "spec"}      — client-written
     <root>/<pool>.state.json   {"status", "events"}        — controller-written
+    <root>/<pool>.routing.json {"table_generation", ...}   — controller-written
+    <root>/<pool>.lease.json   {"holder", "epoch", ...}    — lease-holder-written
 
 so a drill (or a human) applying a spec bump from ONE process and the
 operator writing status from ANOTHER can share a root without either
@@ -36,12 +38,51 @@ from __future__ import annotations
 import collections
 import json
 import os
+import threading
 from dataclasses import asdict
 
 from .. import persist
 from .spec import _EVENT_CAP, PoolStore, ScorerPoolSpec
 
+try:                               # not on Windows; lease guard degrades
+    import fcntl
+except ImportError:                # pragma: no cover
+    fcntl = None
+
 __all__ = ["DurablePoolStore"]
+
+
+# mem:// roots live inside one process, so a module-level lock is a
+# real cross-instance guard there (two DurablePoolStores over the same
+# mem:// root are two threads, never two processes)
+_MEM_LEASE_LOCKS: dict[str, threading.Lock] = {}
+_MEM_LEASE_LOCKS_GUARD = threading.Lock()
+
+
+class _FlockGuard:
+    """Cross-process critical section for lease mutations on a
+    directory root: N operator replicas share the root but not a
+    process lock, and ``acquire_lease``'s read-decide-write must be
+    atomic or two standbys racing an expired lease both claim it."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def __enter__(self):
+        self._f = open(self.path, "a+")
+        if fcntl is not None:
+            fcntl.flock(self._f.fileno(), fcntl.LOCK_EX)
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            if fcntl is not None:
+                fcntl.flock(self._f.fileno(), fcntl.LOCK_UN)
+        finally:
+            self._f.close()
+            self._f = None
+        return False
 
 
 def _spec_from_doc(doc: dict) -> ScorerPoolSpec:
@@ -68,6 +109,12 @@ class DurablePoolStore(PoolStore):
 
     def _state_path(self, name: str) -> str:
         return persist.join_path(self.root, f"{name}.state.json")
+
+    def _routing_path(self, name: str) -> str:
+        return persist.join_path(self.root, f"{name}.routing.json")
+
+    def _lease_path(self, name: str) -> str:
+        return persist.join_path(self.root, f"{name}.lease.json")
 
     @staticmethod
     def _read_doc(path: str) -> dict | None:
@@ -104,6 +151,37 @@ class DurablePoolStore(PoolStore):
                         "events": list(self._events.get(name, ()))},
                        indent=1).encode())
 
+    def _persist_routing(self, name: str) -> None:
+        doc = self._routing.get(name)
+        if doc is None or name not in self._specs:
+            return                      # same no-resurrect rule as state
+        persist.write_bytes_atomic(
+            self._routing_path(name),
+            json.dumps(doc, indent=1).encode())
+
+    def _persist_lease(self, name: str) -> None:
+        doc = self._leases.get(name)
+        path = self._lease_path(name)
+        if doc is None:                 # released → file reads as gone
+            try:
+                if "://" in path:
+                    persist.write_bytes(path, b"{}")
+                else:
+                    os.remove(path)
+            except (FileNotFoundError, OSError):
+                pass
+            return
+        persist.write_bytes_atomic(path, json.dumps(doc, indent=1).encode())
+
+    def _lease_guard(self, name: str):
+        if "://" in self.root:
+            with _MEM_LEASE_LOCKS_GUARD:
+                return _MEM_LEASE_LOCKS.setdefault(
+                    f"{self.root}|{name}", threading.Lock())
+        os.makedirs(self.root, exist_ok=True)
+        return _FlockGuard(os.path.join(self.root,
+                                        f"{name}.lease.lock"))
+
     def _refresh(self, name: str) -> None:
         """Re-read `name` from disk into the in-memory cache: the
         writer of a file re-reads its own last (atomic) write, and
@@ -125,9 +203,21 @@ class DurablePoolStore(PoolStore):
             self._status[name] = dict(tdoc.get("status") or {})
             self._events[name] = collections.deque(
                 tdoc.get("events") or (), maxlen=_EVENT_CAP)
+        rdoc = self._read_doc(self._routing_path(name))
+        if rdoc is not None and "table_generation" in rdoc:
+            self._routing[name] = rdoc
+        ldoc = self._read_doc(self._lease_path(name))
+        if ldoc is None:
+            self._leases.pop(name, None)
+        elif "epoch" in ldoc:
+            self._leases[name] = ldoc
 
     def _forget(self, name: str) -> None:
-        for path in (self._spec_path(name), self._state_path(name)):
+        paths = [self._spec_path(name), self._state_path(name),
+                 self._routing_path(name), self._lease_path(name)]
+        if "://" not in self.root:
+            paths.append(os.path.join(self.root, f"{name}.lease.lock"))
+        for path in paths:
             try:
                 if "://" in path:
                     # mem:// has no delete verb; tombstone (skipped by

@@ -729,6 +729,83 @@ def test_atomic_write_and_listing(tmp_path, mesh8):
 
 
 # ---------------------------------------------------------------------------
+# Controller lease + fenced routing publication (ISSUE 16: the HA
+# control plane; real-subprocess leg in tools/chaos.py router-ha-kill)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_lifecycle_exclusive_renew_takeover(tmp_path, mesh8):
+    import time
+
+    root = str(tmp_path / "store")
+    a = DurablePoolStore(root)
+    b = DurablePoolStore(root)           # a second operator replica
+    # first acquire wins epoch 1; the standby polls None
+    assert a.acquire_lease("p", "op-a", ttl=30.0) == 1
+    assert b.acquire_lease("p", "op-b", ttl=30.0) is None
+    # the holder re-acquiring / renewing does NOT bump the epoch —
+    # the fence must only move on ownership CHANGE
+    assert a.acquire_lease("p", "op-a", ttl=30.0) == 1
+    assert a.renew_lease("p", "op-a", 1) is True
+    # a non-holder's renew is strictly refused
+    assert b.renew_lease("p", "op-b", 1) is False
+    doc = b.get_lease("p")
+    assert doc["holder"] == "op-a" and doc["epoch"] == 1
+    # expiry: the holder misses its heartbeat window, the standby's
+    # claim succeeds WITH an epoch bump, and the old holder's next
+    # heartbeat fails (it must stop reconciling immediately)
+    assert a.acquire_lease("p", "op-a", ttl=0.05) == 1
+    time.sleep(0.08)
+    assert b.acquire_lease("p", "op-b", ttl=30.0) == 2
+    assert a.renew_lease("p", "op-a", 1) is False
+    # voluntary release keeps the epoch monotonic: the released
+    # marker still carries epoch 2 so the next claim bumps to 3 — a
+    # long-deposed holder can never slide back under an old fence
+    b.release_lease("p", "op-b")
+    doc = a.get_lease("p")
+    assert doc.get("released") and doc["epoch"] == 2
+    assert a.acquire_lease("p", "op-a", ttl=30.0) == 3
+
+
+def test_publish_routing_generation_and_epoch_fence(tmp_path, mesh8):
+    import time
+
+    store = DurablePoolStore(str(tmp_path / "store"))
+    # routing persists only for pools that exist (the no-resurrect
+    # rule shared with status): the controller always owns a spec
+    store.apply(ScorerPoolSpec(name="p", artifact="a", version=1,
+                               model_key="m"))
+    t1 = {"keys": {"m": ("s0", "s1")}, "shards": {"s0": ["u0"]}}
+    assert store.publish_routing("p", t1) == 1
+    # content-identical republish (tuples vs lists, key order, an
+    # embedded stale generation) does NOT bump: N routers comparing
+    # generations must not see churn from idle reconcile passes
+    t1b = {"shards": {"s0": ["u0"]}, "table_generation": 99,
+           "keys": {"m": ["s0", "s1"]}}
+    assert store.publish_routing("p", t1b) == 1
+    doc = store.get_routing("p")
+    assert doc["table_generation"] == 1
+    assert doc["keys"]["m"] == ["s0", "s1"]
+    # a real change bumps — and survives a fresh store instance
+    t2 = {"keys": {"m": ["s1"]}, "shards": {"s0": ["u0"]}}
+    assert store.publish_routing("p", t2) == 2
+    assert DurablePoolStore(
+        str(tmp_path / "store")).get_routing("p")["table_generation"] == 2
+    # the split-brain fence: the epoch-1 holder is deposed by a
+    # takeover to epoch 2 — its queued publish raises instead of
+    # landing, even when the table content is unchanged
+    assert store.acquire_lease("p", "op-a", ttl=0.05) == 1
+    time.sleep(0.08)
+    assert store.acquire_lease("p", "op-b", ttl=30.0) == 2
+    with pytest.raises(StaleGenerationError):
+        store.publish_routing("p", t2, epoch=1)
+    assert store.get_routing("p")["table_generation"] == 2
+    # the current holder's writes land normally
+    assert store.publish_routing("p", {"keys": {}, "shards": {}},
+                                 epoch=2) == 3
+
+
+# ---------------------------------------------------------------------------
 # Pod adoption on operator restart (fake replicas; real-subprocess leg
 # in tools/chaos.py operator-restart)
 # ---------------------------------------------------------------------------

@@ -848,3 +848,308 @@ def test_probe_json_shared_helper():
     assert probe_json("http://127.0.0.1:9", "/3/Stats",
                       retries=3) is None
     assert time.monotonic() - t0 < 10
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: hot-shard rebalancing (make-before-break) + failback
+# hygiene + the store-backed N-router table. Real-subprocess leg in
+# tools/chaos.py ``router-ha-kill``.
+# ---------------------------------------------------------------------------
+
+
+def test_pressure_by_model_attribution():
+    from h2o_kubernetes_tpu.operator.autoscale import pressure_by_model
+
+    samples = [
+        {"models": {"t1": {"shed": 3, "deadline_504": 2},
+                    "t2": {"shed": 0, "deadline_504": 0}}},
+        {"models": {"t1": {"shed": 1}, "t3": {"deadline_504": 5}}},
+    ]
+    assert pressure_by_model(samples) == {"t1": 6, "t2": 0, "t3": 5}
+    # restricted to the shard's OWN placed tenants — the attribution
+    # that lets the controller name WHICH tenant to move
+    assert pressure_by_model(samples, {"t1"}) == {"t1": 6}
+
+
+def test_move_destination_skips_placed_and_down():
+    from h2o_kubernetes_tpu.operator import move_destination
+
+    pref = shard_preference("t9", SHARDS3)
+    # first non-placed shard in the tenant's own HRW order
+    assert move_destination("t9", SHARDS3,
+                            exclude=[pref[0]]) == pref[1]
+    # a down candidate is skipped — make-before-break can only make
+    # on a shard that can actually verify READY
+    healthy = {s: s != pref[1] for s in SHARDS3}
+    assert move_destination("t9", SHARDS3, exclude=[pref[0]],
+                            healthy=healthy) == pref[2]
+    # everywhere excluded or down: the move waits (None), it never
+    # picks an arbitrary shard
+    assert move_destination("t9", SHARDS3, exclude=SHARDS3) is None
+    assert move_destination(
+        "t9", SHARDS3, healthy={s: False for s in SHARDS3}) is None
+
+
+def _pressurize(pool, sid, key, total):
+    """Scripted /3/Stats: the hot tenant's CUMULATIVE shed counter on
+    every replica of the shard (rebalance works on deltas)."""
+    for r in pool.recs[sid].replicas:
+        r.stats_payload = {"models": {key: {"shed": total,
+                                            "deadline_504": 0}}}
+
+
+def test_rebalance_moves_hot_tenant_make_before_break(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_REBALANCE", "1")
+    monkeypatch.setenv("H2O_TPU_REBALANCE_SUSTAIN", "3")
+    monkeypatch.setenv("H2O_TPU_REBALANCE_COOLDOWN", "0")
+    reg = StubRegistry()
+    store, pool = _sharded_pool(shards=3, tenants=9, registry=reg)
+    assert _settle(pool)
+    # a singly-placed tail tenant is the hot key
+    hot = next(k for k in pool.plan.assignments
+               if k != "m" and len(pool.plan.assignments[k]) == 1)
+    src = pool.plan.assignments[hot][0]
+    base = len(reg.pushes)
+    # the settle passes took the (idle) baseline snapshot; the three
+    # passes below are consecutive positive deltas — the move fires
+    # on the SUSTAIN'th hit, not on the first blip
+    for i, total in enumerate((5, 11, 19)):
+        _pressurize(pool, src, hot, total)
+        pool._rebalance_once()
+        if i < 2:
+            assert not pool.moves, "moved before pressure sustained"
+    mv = pool.moves.get(hot)
+    assert mv and mv["state"] == "serving" and mv["src"] == src
+    dst = mv["dst"]
+    assert dst != src and dst in pool.plan.shards
+    # make-before-break: the destination's replicas got the targeted
+    # artifact push (push returns only once loaded+warmed — that IS
+    # the READY verification), and only then did routing change: the
+    # destination takes preference position 0 while the source STILL
+    # serves
+    pushed = [p for p in reg.pushes[base:] if p[3] == hot]
+    dst_urls = {r.url for r in pool.recs[dst].replicas}
+    assert pushed and {p[0] for p in pushed} <= dst_urls
+    pref = pool.routing_table()["keys"][hot]
+    assert pref[0] == dst and src in pref
+    # load-driven moves are NOT loss-driven overrides (failback must
+    # never undo them)
+    assert hot not in pool.overrides
+    # durable intent: the destination's child spec + autoscale
+    # attribution carry the tenant for future spawns
+    sdst, _ = store.get(dst)
+    assert hot in {e[2] for e in sdst.extra_artifacts}
+    assert hot in pool.recs[dst].autoscale_keys
+    assert "tenant_move" in [e["kind"] for e in store.events("p")]
+    # the break half is DEFERRED: dwell not elapsed -> source stays
+    assert pool._retire_moves() == 0
+    assert pool.moves[hot]["state"] == "serving"
+    # dwell elapsed -> the source retires out of the table, the
+    # source child spec, and the autoscale attribution
+    monkeypatch.setenv("H2O_TPU_REBALANCE_RETIRE_S", "0")
+    assert pool._retire_moves() == 1
+    assert pool.moves[hot]["state"] == "retired"
+    pref = pool.routing_table()["keys"][hot]
+    assert pref[0] == dst and src not in pref
+    ssrc, _ = store.get(src)
+    assert hot not in {e[2] for e in ssrc.extra_artifacts}
+    assert hot not in pool.recs[src].autoscale_keys
+    assert "tenant_move_retired" in \
+        [e["kind"] for e in store.events("p")]
+
+
+def test_rebalance_never_breaks_before_make_holds(monkeypatch):
+    """A move whose destination dies inside the dwell window must NOT
+    retire its source — the tenant would go dark. The retire waits
+    until the destination serves again."""
+    monkeypatch.setenv("H2O_TPU_REBALANCE", "1")
+    monkeypatch.setenv("H2O_TPU_REBALANCE_SUSTAIN", "2")
+    monkeypatch.setenv("H2O_TPU_REBALANCE_COOLDOWN", "0")
+    monkeypatch.setenv("H2O_TPU_REBALANCE_RETIRE_S", "0")
+    store, pool = _sharded_pool(shards=3, tenants=9)
+    assert _settle(pool)
+    hot = next(k for k in pool.plan.assignments
+               if k != "m" and len(pool.plan.assignments[k]) == 1)
+    src = pool.plan.assignments[hot][0]
+    for i, total in enumerate((5, 11, 19)):
+        _pressurize(pool, src, hot, total)
+        pool._rebalance_once()
+    dst = pool.moves[hot]["dst"]
+    for r in pool.recs[dst].replicas:
+        r._alive = False
+    assert pool._retire_moves() == 0
+    assert pool.moves[hot]["state"] == "serving"
+    # the source is still in the routing preference (serving window)
+    assert src in pool.routing_table()["keys"][hot]
+    # destination recovers -> the deferred break completes
+    assert _settle(pool, passes=60)
+    assert pool.moves[hot]["state"] == "retired"
+
+
+def test_failback_ages_out_overrides_when_home_recovers(monkeypatch):
+    monkeypatch.setenv("H2O_TPU_REBALANCE_FAILBACK_S", "60")
+    reg = StubRegistry()
+    store, pool = _sharded_pool(shards=2, tenants=6, registry=reg)
+    assert _settle(pool)
+    dead_sid, survivor = "p-s0", "p-s1"
+    orphans = set(pool.plan.keys_for(dead_sid)) - {"m"}
+    for r in pool.recs[dead_sid].replicas:
+        r._alive = False
+    assert pool._replace_once() == len(orphans)
+    assert set(pool.overrides) == orphans
+    # home still down: the copies stay (failback needs PROVEN health)
+    assert pool._failback_once() == 0
+    # the shard revives through normal child convergence, but the
+    # 60 s dwell keeps the copies — a flapping shard must not bounce
+    # its tenants back and forth
+    assert _settle(pool, passes=60)
+    assert pool._failback_once() == 0
+    assert set(pool.overrides) == orphans
+    # dwell satisfied (wait -> 0): the override copies age out of
+    # routing, the survivor's child spec, and autoscale attribution —
+    # without waiting for the next full plan rebuild
+    monkeypatch.setenv("H2O_TPU_REBALANCE_FAILBACK_S", "0")
+    assert pool._failback_once() == len(orphans)
+    assert pool.overrides == {}
+    for k in orphans:
+        assert list(pool.routing_table()["keys"][k]) == \
+            list(pool.plan.assignments[k])
+    s1, _ = store.get(survivor)
+    assert not (orphans & {e[2] for e in s1.extra_artifacts})
+    assert not (orphans & pool.recs[survivor].autoscale_keys)
+    kinds = [e["kind"] for e in store.events("p")]
+    assert kinds.count("tenant_failback") == len(orphans)
+
+
+def test_store_routing_table_monotonic_and_last_good(monkeypatch):
+    from h2o_kubernetes_tpu.operator import StoreRoutingTable
+
+    monkeypatch.setenv("H2O_TPU_ROUTER_TABLE_INTERVAL", "0")
+    store = PoolStore()
+    provider = StoreRoutingTable(store, "p")
+    # cold: an EMPTY table marked cold (the router's typed-503 input)
+    # — never a crash, never a guessed catalog
+    t = provider()
+    assert t.get("cold") and t["keys"] == {}
+    assert provider.generation == 0
+    store.publish_routing("p", {"keys": {"m": ["s0"]},
+                                "shards": {"s0": ["u0"]}})
+    t = provider()
+    assert t["table_generation"] == 1 and not t.get("cold")
+    # last-good: a store outage serves the previous snapshot —
+    # store unavailability degrades freshness, never serving
+    real = store.get_routing
+
+    def _boom(name):
+        raise IOError("store down")
+
+    monkeypatch.setattr(store, "get_routing", _boom)
+    assert provider()["table_generation"] == 1
+    assert provider.snapshot()["refresh_errors"] == 1
+    # monotonic: a lagging replica's OLDER document is rejected — a
+    # deposed controller's file can never roll a router back
+    monkeypatch.setattr(store, "get_routing", lambda name: {
+        "table_generation": 0, "keys": {}, "shards": {}})
+    assert provider()["table_generation"] == 1
+    assert provider.snapshot()["stale_rejected"] == 1
+    # recovery: newer documents flow again
+    monkeypatch.setattr(store, "get_routing", real)
+    store.publish_routing("p", {"keys": {"m": ["s1"]},
+                                "shards": {"s1": ["u1"]}})
+    assert provider()["table_generation"] == 2
+    assert provider.snapshot()["generation"] == 2
+    assert provider.snapshot()["refreshes"] == 2
+
+
+def test_router_cold_table_typed_503_then_serves(quiet_health,
+                                                 monkeypatch):
+    from h2o_kubernetes_tpu.operator import StoreRoutingTable
+
+    monkeypatch.setenv("H2O_TPU_ROUTER_TABLE_INTERVAL", "0")
+    store = PoolStore()
+    a = _StubReplica(name="a")
+    srv, router, url = _router(StoreRoutingTable(store, "p"))
+    try:
+        # before any controller ever published: typed degraded 503
+        # (the router cannot know the catalog, so it must not 404)
+        code, out, hdrs = _post(url + "/3/Predictions/models/pm")
+        assert code == 503 and out["hint"] == "table_pending"
+        assert hdrs.get("Retry-After") == "1"
+        # the elected controller publishes; the SAME router serves on
+        # its next sweep without a restart — routers are stateless
+        store.publish_routing("p", {"keys": {"pm": ["s0"]},
+                                    "shards": {"s0": [a.url]}})
+        router.sweep_health()
+        code, out, _ = _post(url + "/3/Predictions/models/pm")
+        assert code == 200 and out["served_by"] == "a"
+        assert router.snapshot()["table_provider"]["generation"] == 1
+    finally:
+        router.stop()
+        srv.shutdown()
+        a.close()
+
+
+def test_two_routers_read_same_generation_after_replacement():
+    from h2o_kubernetes_tpu.operator import StoreRoutingTable
+
+    reg = StubRegistry()
+    store, pool = _sharded_pool(shards=2, tenants=6, registry=reg)
+    assert _settle(pool)
+    pool._publish_routing()
+    p1 = StoreRoutingTable(store, "p")
+    p2 = StoreRoutingTable(store, "p")
+    g1 = p1()["table_generation"]
+    assert g1 >= 1 and p2()["table_generation"] == g1
+    assert p1() == p2()
+    # a shard loss + re-placement republishes the table exactly once;
+    # BOTH stateless providers observe the same new generation — the
+    # N-router front door needs no router-to-router coordination
+    dead = "p-s0"
+    for r in pool.recs[dead].replicas:
+        r._alive = False
+    pool._replace_once()
+    pool._publish_routing()
+    g2 = p1()["table_generation"]
+    assert g2 > g1
+    assert p2()["table_generation"] == g2
+    assert p1() == p2()
+
+
+def test_deposed_controller_stops_new_holder_publishes():
+    import time as _time
+
+    from h2o_kubernetes_tpu.operator import StaleGenerationError
+
+    reg = StubRegistry()
+    store, pool = _sharded_pool(shards=2, tenants=4, registry=reg)
+    assert _settle(pool)
+    # this controller reconciles under lease epoch 1
+    assert store.acquire_lease("p", "op-a", ttl=0.05) == 1
+    pool.lease_epoch = 1
+    pool._publish_routing()
+    assert not pool.deposed
+    pool._publish_status()
+    assert store.get_status("p")["lease_epoch"] == 1
+    # the lease expires; a standby takes over at epoch 2. The old
+    # holder's next publish is FENCED: it marks itself deposed and
+    # stops writing (split-brain ends with exactly one writer)
+    _time.sleep(0.08)
+    assert store.acquire_lease("p", "op-b", ttl=30.0) == 2
+    gen = store.get_routing("p")["table_generation"]
+    pool._publish_routing()
+    assert pool.deposed
+    assert store.get_routing("p")["table_generation"] == gen
+    assert "controller_deposed" in \
+        [e["kind"] for e in store.events("p")]
+    # deposed is sticky: further publishes are no-ops, and even a
+    # direct store write under the old epoch stays fenced
+    pool._publish_routing()
+    with pytest.raises(StaleGenerationError):
+        store.publish_routing("p", {"keys": {}, "shards": {}},
+                              epoch=1)
+    # the new holder (a fresh controller over the same store — the
+    # takeover shape) publishes under epoch 2 and the table moves on
+    pool2 = ShardedPool(store, reg, "p", replica_factory=FakeReplica)
+    pool2.lease_epoch = 2
+    pool2._publish_routing()
+    assert not pool2.deposed

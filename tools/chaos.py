@@ -76,6 +76,19 @@ Scenarios:
   survivors, per-replica scorer-cache bytes never exceed the budget,
   every cross-shard retry is token-backed (budget never exceeded),
   and re-enabling the shard reconverges the pool.
+- ``router-ha-kill``  the highly-available front door end to end
+  (ISSUE 16): two lease-fenced ``operator.run --ha`` replicas + two
+  stateless store-backed routers under a live Zipf storm. Sustained
+  per-tenant 504 pressure triggers a make-before-break rebalance
+  (destination bitwise-identical before the source retires); one
+  router AND the lease holder are SIGKILLed together: zero client
+  errors (transport failover to the surviving router), standby
+  takeover within TTL + heartbeat with epoch+1, pods adopted (same
+  pids), a stale-epoch routing publish provably rejected, the move
+  retired by the NEW holder; then a whole shard dies and recovers —
+  loss-driven overrides re-place its tenants and failback EMPTIES
+  them once the home shard is provably healthy. Zero 5xx on head
+  tenants, ``retries == granted`` on the surviving router.
 """
 
 from __future__ import annotations
@@ -1836,6 +1849,474 @@ def scenario_trace_failover() -> None:
         fx.close()
 
 
+def _post_raw(url: str, key: str, body: dict,
+              headers: dict | None = None,
+              timeout: float = 30.0) -> tuple:
+    """POST one scoring request directly; returns (status, bytes) —
+    the bitwise-comparison primitive (same artifact + same rows must
+    produce byte-identical predictions on any replica serving them)."""
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{url}/3/Predictions/models/{key}",
+        data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+    except Exception:  # noqa: BLE001 — transport
+        return -1, b""
+
+
+def scenario_router_ha_kill() -> None:
+    """The ISSUE-16 acceptance drill: the whole FRONT DOOR goes highly
+    available. A 3-shard fleet is run by TWO ``operator.run --ha``
+    replicas (lease-fenced: exactly one reconciles) and fronted by TWO
+    stateless router processes reading the store-backed routing table.
+    Under a live Zipf storm the drill:
+
+    - floods one tail tenant with 1 ms-deadline requests until its
+      per-tenant 504 pressure sustains and the holder REBALANCES it
+      (make-before-break: the destination serves bitwise-identical
+      predictions while the source still serves);
+    - SIGKILLs one router AND the lease holder simultaneously: the
+      storm fails over to the surviving router with zero client
+      errors, the standby takes the lease (epoch+1) within TTL +
+      heartbeat of the dead holder's last renewal, adopts every pod
+      (same pids — zero respawns), RESUMES the in-flight move, and a
+      routing publish fenced on the dead holder's epoch is provably
+      rejected (StaleGenerationError);
+    - after the move's dwell the NEW holder retires the source (the
+      move record survived takeover through the status doc);
+    - then loses a whole shard (loss-driven overrides re-place its
+      tenants onto survivors) and recovers it: failback EMPTIES the
+      overrides once the home shard is provably healthy again;
+    - end to end: zero 5xx on the replicated head tenants, zero
+      client transport errors, and ``retries == granted`` on the
+      surviving router."""
+    import re
+    import shutil
+    import signal
+    import subprocess
+
+    import numpy as np
+
+    import h2o_kubernetes_tpu as h2o
+    from h2o_kubernetes_tpu.models import GBM
+    from h2o_kubernetes_tpu.operator import (DurablePoolStore,
+                                             ModelRegistry,
+                                             ScorerPoolSpec,
+                                             StaleGenerationError)
+    from tools.score_load import _get_json, _make_bodies, run_load_zipf
+
+    tenants = int(os.environ.get("H2O_TPU_DRILL_HA_TENANTS", "60"))
+    head_n = 6
+    ttl, hb = 4.0, 0.5
+    retire_s, failback_s = 8.0, 4.0
+    td = tempfile.mkdtemp(prefix="chaos_rhakill_")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    storedir = os.path.join(td, "store")
+    workdir = os.path.join(td, "work")
+    regdir = os.path.join(td, "registry")
+    procs: dict = {}
+    # subprocess-only env: the drill process itself keeps its own
+    ha_env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        H2O_TPU_LEASE_TTL=str(ttl), H2O_TPU_LEASE_HEARTBEAT=str(hb),
+        H2O_TPU_REBALANCE="1", H2O_TPU_REBALANCE_SUSTAIN="2",
+        H2O_TPU_REBALANCE_COOLDOWN="2",
+        H2O_TPU_REBALANCE_RETIRE_S=str(retire_s),
+        H2O_TPU_REBALANCE_FAILBACK_S=str(failback_s),
+        H2O_TPU_POOL_STARTUP_DEADLINE="600",
+        H2O_TPU_ROUTER_RETRY_BUDGET="20",
+        H2O_TPU_ROUTER_HEALTH_INTERVAL="0.25",
+        H2O_TPU_ROUTER_TABLE_INTERVAL="0.25")
+    try:
+        rng = np.random.default_rng(0)
+        n = 400
+        cols = {f"x{i}": rng.normal(size=n).astype(np.float32)
+                for i in range(4)}
+        cols["y"] = np.where(cols["x0"] - cols["x1"] > 0, "late",
+                             "ontime")
+        feature_cols = [f"x{i}" for i in range(4)]
+        fr = h2o.Frame.from_arrays(cols)
+        registry = ModelRegistry(regdir)
+        arts = []
+        for b in range(2):
+            m = GBM(ntrees=2 + b, max_depth=2, seed=b + 1).train(
+                y="y", training_frame=fr)
+            registry.publish(m, f"t{b}")
+            arts.append(f"t{b}")
+        keys = [f"m{i:03d}" for i in range(tenants)]
+        head_keys = keys[:head_n]
+        extra = tuple((arts[i % 2], 1, k)
+                      for i, k in enumerate(keys) if i > 0)
+        store = DurablePoolStore(storedir)
+        store.apply(ScorerPoolSpec(
+            name="pool", artifact=arts[0], version=1,
+            model_key=keys[0], replicas=1, shards=3,
+            head_models=head_n, tail_replicas=1, warm_buckets=(128,),
+            extra_artifacts=extra))
+
+        def spawn_operator(tag: str):
+            log = open(os.path.join(td, f"operator_{tag}.log"), "ab")
+            p = subprocess.Popen(
+                [sys.executable, "-m",
+                 "h2o_kubernetes_tpu.operator.run",
+                 "--store", storedir, "--registry", regdir,
+                 "--pool", "pool", "--workdir", workdir,
+                 "--interval", "0.25", "--ha", "--holder-id", tag],
+                cwd=repo, env=ha_env, stdout=log, stderr=log,
+                start_new_session=True)
+            procs[tag] = p
+            return p
+
+        def spawn_router(tag: str) -> str:
+            logp = os.path.join(td, f"{tag}.log")
+            log = open(logp, "ab")
+            p = subprocess.Popen(
+                [sys.executable, "-m",
+                 "h2o_kubernetes_tpu.operator.router",
+                 "--store", storedir, "--pool", "pool", "--port", "0"],
+                cwd=repo, env=ha_env, stdout=log, stderr=log,
+                start_new_session=True)
+            procs[tag] = p
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                with open(logp, "rb") as f:
+                    txt = f.read().decode(errors="replace")
+                mm = re.search(r"ROUTER_UP port=(\d+)", txt)
+                if mm:
+                    return f"http://127.0.0.1:{mm.group(1)}"
+                _check(p.poll() is None,
+                       f"{tag} died at startup: {txt[-400:]}")
+                time.sleep(0.2)
+            raise ChaosFailure(f"{tag} never printed ROUTER_UP")
+
+        def wait_status(pred, timeout: float, what: str) -> dict:
+            deadline = time.monotonic() + timeout
+            st = store.get_status("pool") or {}
+            while time.monotonic() < deadline:
+                st = store.get_status("pool") or {}
+                if pred(st):
+                    return st
+                time.sleep(0.25)
+            raise ChaosFailure(f"timed out waiting for {what}: {st} "
+                               f"(logs under {td})")
+
+        spawn_operator("op-a")
+        spawn_operator("op-b")
+        st = wait_status(lambda s: s.get("converged"), 600,
+                         "the HA fleet to converge")
+        lease = store.get_lease("pool")
+        _check(lease is not None and not lease.get("released")
+               and lease.get("holder") in ("op-a", "op-b"),
+               f"no live lease after convergence: {lease}")
+        rdoc = store.get_routing("pool")
+        _check(rdoc is not None
+               and int(rdoc.get("table_generation", 0)) >= 1
+               and rdoc.get("keys"),
+               f"holder never published a routing table: {rdoc}")
+
+        url_a = spawn_router("router-a")
+        url_b = spawn_router("router-b")
+        body = _make_bodies(feature_cols, 8, seed=1, pool=1)[0]
+        for u in (url_a, url_b):
+            code = _score_via_router(u, keys[0], body)
+            _check(code == 200,
+                   f"store-backed router {u} not serving the head "
+                   f"tenant (HTTP {code})")
+        # N routers, ONE table: both converge on the store generation
+        gens = [(_get_json(u + "/3/Stats", timeout=5.0) or {})
+                .get("table_generation") for u in (url_a, url_b)]
+        _check(gens[0] is not None and gens[0] == gens[1]
+               and gens[0] >= rdoc["table_generation"],
+               f"routers disagree on table_generation: {gens} vs "
+               f"store {rdoc['table_generation']}")
+
+        storm_out: dict = {}
+        storm_stop = threading.Event()
+
+        def storm():
+            storm_out.update(run_load_zipf(
+                [url_a, url_b], keys, feature_cols, concurrency=4,
+                rows_per_request=8, seconds=900.0, zipf_s=1.1, seed=0,
+                router=True, stop_event=storm_stop))
+
+        st_thread = threading.Thread(target=storm, daemon=True)
+        st_thread.start()
+        time.sleep(4.0)                     # storm established
+
+        # -- phase 1: sustained-pressure rebalance (make-before-break)
+        hot = next(k for k in reversed(keys) if k not in head_keys
+                   and len(rdoc["keys"].get(k) or ()) == 1)
+        hot_src = rdoc["keys"][hot][0]
+        src_reps = [r for r in st["shards"][hot_src]["replicas"]
+                    if r["state"] == "READY"]
+        _check(src_reps, f"no READY replica on shard {hot_src}")
+        src_url = f"http://127.0.0.1:{src_reps[0]['port']}"
+        flood_stop = threading.Event()
+
+        def flood():
+            # 1 ms deadlines 504 inside the hot shard's batcher: the
+            # per-tenant deadline_504 counter attributes the pressure
+            # to `hot` alone — nobody else sheds
+            while not flood_stop.is_set():
+                _post_raw(src_url, hot, body,
+                          headers={"X-H2O-Deadline-Ms": "1"},
+                          timeout=10.0)
+                time.sleep(0.02)
+
+        fl = threading.Thread(target=flood, daemon=True)
+        fl.start()
+        mv = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            stx = store.get_status("pool") or {}
+            mv = ((stx.get("placement") or {}).get("moves")
+                  or {}).get(hot)
+            if mv:
+                break
+            time.sleep(0.3)
+        flood_stop.set()
+        fl.join(timeout=10)
+        _check(mv is not None,
+               f"sustained 504 pressure on '{hot}' never triggered a "
+               f"rebalance move: {store.get_status('pool')}")
+        _check(mv["src"] == hot_src and mv["state"] == "serving",
+               f"move record wrong: {mv} (expected src={hot_src}, "
+               "state=serving)")
+        dst = mv["dst"]
+
+        # make-before-break: while the move is `serving`, BOTH shards
+        # serve the tenant and the destination's predictions are
+        # bitwise-identical to the source's
+        stx = store.get_status("pool")
+        dst_reps = [r for r in stx["shards"][dst]["replicas"]
+                    if r["state"] == "READY"]
+        _check(dst_reps, f"move destination {dst} has no READY "
+               "replica — the 'make' half did not hold")
+        dst_url = f"http://127.0.0.1:{dst_reps[0]['port']}"
+        c_src, b_src = _post_raw(src_url, hot, body)
+        c_dst, b_dst = _post_raw(dst_url, hot, body)
+        _check(c_src == 200 and c_dst == 200,
+               f"mid-move scoring failed: src HTTP {c_src}, "
+               f"dst HTTP {c_dst}")
+        _check(b_src == b_dst,
+               "make-before-break violated: destination predictions "
+               f"differ from source (src {b_src[:80]!r} vs dst "
+               f"{b_dst[:80]!r})")
+        # the routing table prefers dst while src still serves
+        deadline = time.monotonic() + 15
+        pref: list = []
+        while time.monotonic() < deadline:
+            rdoc = store.get_routing("pool") or {}
+            pref = list((rdoc.get("keys") or {}).get(hot) or ())
+            if pref and pref[0] == dst and hot_src in pref:
+                break
+            time.sleep(0.25)
+        _check(pref and pref[0] == dst and hot_src in pref,
+               f"mid-move routing should prefer {dst} with {hot_src} "
+               f"still serving, got {pref}")
+
+        # -- phase 2: SIGKILL a router AND the lease holder together
+        lease = store.get_lease("pool")
+        holder, old_epoch = lease["holder"], int(lease["epoch"])
+        standby = "op-b" if holder == "op-a" else "op-a"
+        pods_before = sorted(p for p, _ in _live_pods_for(workdir))
+        procs["router-a"].kill()
+        procs[holder].kill()
+        lease_at_kill = store.get_lease("pool")   # final heartbeat
+        new_lease = None
+        deadline = time.monotonic() + ttl + 60
+        while time.monotonic() < deadline:
+            new_lease = store.get_lease("pool")
+            if new_lease and new_lease.get("holder") == standby:
+                break
+            time.sleep(0.1)
+        _check(new_lease is not None
+               and new_lease.get("holder") == standby,
+               f"standby {standby} never took the lease: {new_lease}")
+        _check(int(new_lease["epoch"]) == old_epoch + 1,
+               f"takeover must bump the epoch exactly once: "
+               f"{old_epoch} -> {new_lease['epoch']}")
+        lag = float(new_lease["acquired"]) \
+            - float(lease_at_kill["renewed"])
+        _check(lag <= ttl + hb + 2.0,
+               f"takeover took {lag:.1f}s from the dead holder's last "
+               f"heartbeat (ttl={ttl:g} hb={hb:g})")
+
+        # the fence: a routing publish carrying the DEAD holder's
+        # epoch must be rejected — split-brain resolves to one writer
+        try:
+            store.publish_routing("pool", {"keys": {}, "shards": {}},
+                                  epoch=old_epoch)
+            raise ChaosFailure(
+                "a routing publish fenced on the deposed holder's "
+                "epoch was ACCEPTED — split-brain is possible")
+        except StaleGenerationError:
+            pass
+
+        # adoption, not respawn: the new holder converges on the SAME
+        # pod pids and its status carries the new epoch
+        wait_status(lambda s: s.get("converged")
+                    and s.get("lease_epoch") == old_epoch + 1,
+                    300, "the new holder to adopt and reconverge")
+        pods_after = sorted(p for p, _ in _live_pods_for(workdir))
+        _check(pods_after == pods_before,
+               f"takeover changed the pod set (respawn/leak): "
+               f"{pods_before} -> {pods_after}")
+        seen_kinds = set()
+        for pool_name in ["pool"] + list(stx["shards"]):
+            try:
+                seen_kinds.update(e["kind"]
+                                  for e in store.events(pool_name))
+            except KeyError:
+                pass
+        _check("replica_adopted" in seen_kinds,
+               f"no replica_adopted event after takeover: "
+               f"{sorted(seen_kinds)}")
+
+        # -- phase 3: the NEW holder retires the in-flight move (the
+        # move record survived takeover through the status doc)
+        deadline = time.monotonic() + 120
+        retired = False
+        while time.monotonic() < deadline:
+            stx = store.get_status("pool") or {}
+            m3 = ((stx.get("placement") or {}).get("moves")
+                  or {}).get(hot)
+            if m3 and m3.get("state") == "retired":
+                retired = True
+                break
+            time.sleep(0.3)
+        _check(retired,
+               f"the new holder never retired the move of '{hot}': "
+               f"{(stx.get('placement') or {}).get('moves')}")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rdoc = store.get_routing("pool") or {}
+            pref = list((rdoc.get("keys") or {}).get(hot) or ())
+            if pref and pref[0] == dst and hot_src not in pref:
+                break
+            time.sleep(0.25)
+        _check(pref and pref[0] == dst and hot_src not in pref,
+               f"retired source {hot_src} still routed for '{hot}': "
+               f"{pref}")
+        code = _score_via_router(url_b, hot, body)
+        _check(code == 200, f"moved tenant '{hot}' not serving via "
+               f"the surviving router after retirement (HTTP {code})")
+
+        # -- phase 4: loss-driven overrides, then failback hygiene
+        rdoc = store.get_routing("pool")
+        stx = store.get_status("pool")
+        orphan_by_sid = {
+            sid: [k for k in keys if k not in head_keys and k != hot
+                  and list(rdoc["keys"].get(k) or ()) == [sid]]
+            for sid in stx["shards"] if sid != dst}
+        vsid = max(orphan_by_sid, key=lambda s: len(orphan_by_sid[s]))
+        orphans = orphan_by_sid[vsid]
+        _check(len(orphans) >= 2,
+               f"shard {vsid} uniquely holds only {len(orphans)} "
+               "tail tenants — fixture shape wrong")
+        for r in stx["shards"][vsid]["replicas"]:
+            if r.get("pid"):
+                try:
+                    os.kill(r["pid"], signal.SIGKILL)
+                except OSError:
+                    pass
+        store.apply_update(vsid, replicas=0)   # the node pool is gone
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            stx = store.get_status("pool") or {}
+            ov = (stx.get("placement") or {}).get("overrides") or {}
+            if all(k in ov for k in orphans):
+                break
+            time.sleep(0.5)
+        ov = (stx.get("placement") or {}).get("overrides") or {}
+        missing = [k for k in orphans if k not in ov]
+        _check(not missing,
+               f"{len(missing)}/{len(orphans)} lost tenants never "
+               f"re-placed (sample {missing[:5]}): {stx}")
+        for k in orphans[:3]:
+            code = _score_via_router(url_b, k, body)
+            _check(code == 200,
+                   f"re-placed tenant '{k}' not serving via a "
+                   f"survivor (HTTP {code})")
+        # recovery: capacity returns; once the home shard is provably
+        # healthy for H2O_TPU_REBALANCE_FAILBACK_S the override copies
+        # age out — the overrides map EMPTIES
+        store.apply_update(vsid, replicas=1)
+        wait_status(lambda s: s.get("converged"), 600,
+                    "the recovered shard to reconverge")
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            stx = store.get_status("pool") or {}
+            ov = (stx.get("placement") or {}).get("overrides") or {}
+            if not ov:
+                break
+            time.sleep(0.5)
+        _check(not ov,
+               f"failback never emptied the overrides: {ov}")
+        seen_kinds.update(e["kind"] for e in store.events("pool"))
+        _check("tenant_failback" in seen_kinds,
+               f"no tenant_failback event: {sorted(seen_kinds)}")
+        code = _score_via_router(url_b, orphans[0], body)
+        _check(code == 200,
+               f"failed-back tenant not serving from its home shard "
+               f"(HTTP {code})")
+
+        # -- epilogue: the storm's end-to-end contracts
+        storm_stop.set()
+        st_thread.join(timeout=120)
+        _check(storm_out.get("requests", 0) > 300,
+               f"Zipf storm barely ran: {storm_out}")
+        _check(storm_out["errors"] == 0,
+               f"client transport errors across the HA kill: "
+               f"{storm_out['error_sample']}")
+        _check(storm_out.get("target_failovers", 0) > 0,
+               "the router kill never exercised client-side target "
+               "failover — the drill timing is broken")
+        head_5xx = sum(storm_out["by_model"][k]["fivexx"]
+                       for k in head_keys)
+        _check(head_5xx == 0,
+               f"{head_5xx} 5xx on replicated HEAD tenants across the "
+               f"router+holder kill: {storm_out['fivexx_sample']}")
+        rst = _get_json(url_b + "/3/Stats", timeout=5.0)
+        _check(rst is not None,
+               "surviving router /3/Stats unreachable")
+        _check(rst["stats"]["retries"] ==
+               rst["retry_budget"]["granted"],
+               f"retries not token-backed on the surviving router: "
+               f"{rst['stats']} {rst['retry_budget']}")
+        final_gen = (store.get_routing("pool")
+                     or {}).get("table_generation")
+        _check(rst.get("table_generation") is not None
+               and rst["table_generation"] <= final_gen,
+               f"surviving router claims a table generation the store "
+               f"never published: {rst.get('table_generation')} > "
+               f"{final_gen}")
+    finally:
+        import signal as _sig
+
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for pid, _ in _live_pods_for(workdir):
+            try:
+                os.kill(pid, _sig.SIGKILL)
+            except OSError:
+                pass
+        shutil.rmtree(td, ignore_errors=True)
+
+
 SCENARIOS = {
     "persist-503": scenario_persist_503,
     "probe-hang": scenario_probe_hang,
@@ -1853,6 +2334,7 @@ SCENARIOS = {
     "poison-rollback": scenario_poison_rollback,
     "router-shard-kill": scenario_router_shard_kill,
     "trace-failover": scenario_trace_failover,
+    "router-ha-kill": scenario_router_ha_kill,
 }
 
 

@@ -15,6 +15,19 @@ Usage:
                                         # (tools/chaos.py all); combine
                                         # with --all/--slow to append it
     python tools/run_tests.py --timeout 1200   # per-module cap
+    python tools/run_tests.py --tier1-sharded  # THE tier-1 verify:
+                                        # fast tier, per-module
+                                        # timeouts, aggregate
+                                        # DOTS_PASSED=<n> + rc
+
+``--tier1-sharded`` is the ROADMAP verify entry point: the monolithic
+``pytest tests/`` command outgrew any single wall cap on a 1-core box
+(rc 124 at ~76% with zero failures), so the verify now runs the same
+fast tier sharded module-by-module — each module under its own
+``--timeout`` — and aggregates the per-module pytest pass counts into
+one ``DOTS_PASSED=<total>`` line and one exit code (0 only if every
+module passed). Same tests, same markers; only the wall-cap
+granularity changed.
 
 A preflight scan warns (or, with ``--strict-preflight`` /
 ``H2O_TPU_PREFLIGHT_STRICT=1``, fails) when orphaned bench/AutoML
@@ -32,6 +45,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -203,6 +217,11 @@ def main() -> int:
     ap.add_argument("--strict-preflight", action="store_true",
                     help="fail (rc 2) when orphaned bench/automl "
                     "processes are found instead of warning")
+    ap.add_argument("--tier1-sharded", action="store_true",
+                    help="tier-1 verify mode: run the fast tier "
+                    "module-by-module (each under its own --timeout) "
+                    "and print an aggregate DOTS_PASSED=<n> line; "
+                    "exit 0 only if every module passed")
     args = ap.parse_args()
 
     strict = args.strict_preflight or \
@@ -213,9 +232,13 @@ def main() -> int:
     modules = sorted(glob.glob(os.path.join(REPO, "tests", "test_*.py")))
     tiers = (["not slow", "slow"] if args.all
              else ["slow"] if args.slow else ["not slow"])
-    if args.chaos and not (args.all or args.slow):
+    if args.tier1_sharded:
+        tiers = ["not slow"]         # THE tier-1 verify tier
+    if args.chaos and not (args.all or args.slow
+                           or args.tier1_sharded):
         tiers = []                   # drills only
     results = []
+    passed_total = 0
     t0 = time.monotonic()
     # per-test timing lines ([time] …, tests/conftest.py hook): on a
     # module TIMEOUT the partial output still carries every COMPLETED
@@ -274,8 +297,15 @@ def main() -> int:
                 for secs, node in sorted(times, reverse=True)[:5]:
                     print(f"    [slow] {secs:8.2f}s {node}", flush=True)
             dt = time.monotonic() - start
+            # pytest -q summary tail ("30 passed, 1 warning in 27.7s")
+            # → per-module pass count, aggregated into DOTS_PASSED for
+            # --tier1-sharded (the sharded analog of counting dots)
+            m = re.search(r"(\d+) passed", tail)
+            mod_passed = int(m.group(1)) if m else 0
+            passed_total += mod_passed
             results.append({"module": name, "tier": tier,
                             "status": status, "seconds": round(dt, 1),
+                            "passed": mod_passed,
                             "tail": tail[-120:]})
             print(f"[{status:>7}] {name:<32} ({tier}) {dt:6.1f}s "
                   f"{tail[-80:]}", flush=True)
@@ -317,11 +347,17 @@ def main() -> int:
               f"{tail[-80:]}", flush=True)
 
     failed = [r for r in results if r["status"] in ("FAIL", "TIMEOUT")]
-    print(json.dumps({
+    summary = {
         "run_tests": "pass" if not failed else "fail",
         "modules": len(results),
         "failed": [r["module"] for r in failed],
-        "wall_seconds": round(time.monotonic() - t0, 1)}))
+        "wall_seconds": round(time.monotonic() - t0, 1)}
+    if args.tier1_sharded:
+        summary["passed"] = passed_total
+        # same grep-able shape as the old monolithic verify line, so
+        # round tooling keeps one regex across both eras
+        print(f"DOTS_PASSED={passed_total}", flush=True)
+    print(json.dumps(summary))
     return 0 if not failed else 1
 
 

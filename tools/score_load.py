@@ -487,6 +487,7 @@ def run_load_zipf(targets, model_keys: list[str], columns: list[str],
                  "max_resident_models": 0}
     stats_first: dict[str, dict] = {}   # per TARGET: deltas must not
     stats_last: dict[str, dict] = {}    # mix one replica into another
+    target_failovers = [0]    # router mode: transport-level re-sends
 
     def _done() -> bool:
         return stop.is_set() or time.perf_counter() >= deadline
@@ -542,47 +543,68 @@ def run_load_zipf(targets, model_keys: list[str], columns: list[str],
             key = model_keys[int(rng.choice(len(model_keys), p=probs))]
             body = bodies[i % len(bodies)]
             i += 1
-            route = f"{target}/3/Predictions/models/{key}"
-            t0 = time.perf_counter()
-            try:
-                out = _post_json(route, body, timeout=request_timeout)
-                ok = len(out["predict"]) == rows_per_request
-                dt = time.perf_counter() - t0
-                with lock:
-                    rec = per_model[key]
-                    rec["requests"] += 1
-                    if ok:
-                        rec["lat"].append(dt)
-                        latencies.append(dt)
-                    else:
-                        errors.append(f"{key}: short response")
-            except urllib.error.HTTPError as e:
-                ebody = e.read()
-                label = f"{key}: HTTP {e.code} {ebody[:120]!r}"
-                degraded = (router and e.code == 503
-                            and b"placement_pending" in ebody)
-                with lock:
-                    rec = per_model[key]
-                    rec["requests"] += 1
-                    if degraded:
-                        # the router's typed degraded answer: the
-                        # tenant's shard is down and re-placement is
-                        # in flight — expected during the drill's
-                        # failure window, not a 5xx contract breach
-                        rec["degraded"] += 1
-                    elif e.code >= 500:
-                        rec["fivexx"] += 1
-                        fivexx.append(label)
-                    elif e.code == 429:
-                        rec["shed"] += 1
-                    else:
-                        rec["fourxx"] += 1
-                        errors.append(label[:200])
-                if e.code == 429 or degraded:
-                    time.sleep(0.005)   # shed: brief backoff, retry on
-            except Exception as e:  # noqa: BLE001 — record, keep going
-                with lock:
-                    errors.append(f"{key}: {e!r}"[:200])
+            # router mode: a killed router's in-flight requests die at
+            # the TRANSPORT level (reset/refused) — exactly the
+            # failure N interchangeable routers behind a balancer
+            # exist to absorb, so the same request retries on each
+            # remaining ready target before anything lands in
+            # `errors` (a balancer re-dispatches the same way)
+            with lock:
+                alts = [t for t in sorted(ready) if t != target]
+            tries = [target] + (alts if router else [])
+            for ti, tgt in enumerate(tries):
+                route = f"{tgt}/3/Predictions/models/{key}"
+                t0 = time.perf_counter()
+                try:
+                    out = _post_json(route, body,
+                                     timeout=request_timeout)
+                    ok = len(out["predict"]) == rows_per_request
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        rec = per_model[key]
+                        rec["requests"] += 1
+                        if ok:
+                            rec["lat"].append(dt)
+                            latencies.append(dt)
+                        else:
+                            errors.append(f"{key}: short response")
+                    break
+                except urllib.error.HTTPError as e:
+                    ebody = e.read()
+                    label = f"{key}: HTTP {e.code} {ebody[:120]!r}"
+                    degraded = (router and e.code == 503
+                                and (b"placement_pending" in ebody
+                                     or b"table_pending" in ebody))
+                    with lock:
+                        rec = per_model[key]
+                        rec["requests"] += 1
+                        if degraded:
+                            # the router's typed degraded answer: the
+                            # tenant's shard is down and re-placement
+                            # is in flight — expected during the
+                            # drill's failure window, not a 5xx
+                            # contract breach
+                            rec["degraded"] += 1
+                        elif e.code >= 500:
+                            rec["fivexx"] += 1
+                            fivexx.append(label)
+                        elif e.code == 429:
+                            rec["shed"] += 1
+                        else:
+                            rec["fourxx"] += 1
+                            errors.append(label[:200])
+                    if e.code == 429 or degraded:
+                        time.sleep(0.005)   # shed: backoff, retry on
+                    break
+                except Exception as e:  # noqa: BLE001 — failover/record
+                    with lock:
+                        ready.discard(tgt.rstrip("/"))
+                    if ti + 1 < len(tries):
+                        with lock:
+                            target_failovers[0] += 1
+                        continue
+                    with lock:
+                        errors.append(f"{key}: {e!r}"[:200])
 
     t_start = time.perf_counter()
     pt = threading.Thread(target=poller, daemon=True,
@@ -623,6 +645,7 @@ def run_load_zipf(targets, model_keys: list[str], columns: list[str],
         latencies, wall, rows_per_request, concurrency, fivexx, errors,
         zipf_s=zipf_s, models=len(model_keys), shed=shed,
         degraded=sum(r["degraded"] for r in per_model.values()),
+        target_failovers=target_failovers[0],
         by_model={k: {"requests": r["requests"],
                       "fivexx": r["fivexx"], "shed": r["shed"],
                       "degraded": r["degraded"],
