@@ -49,6 +49,7 @@ CLI flags, and H2O-3 runtime options (`H2O.OptArgs` command line,
 | H2O_TPU_CONTRIB_MAX_ROWS | 100000 | per-request row cap on the TreeSHAP contributions route (413 past it; rest.py, docs/SERVING.md "Explainable serving") |
 | H2O_TPU_CONTRIB_CHUNK | 16384 | upper bound on rows per device TreeSHAP dispatch — the kernel's [rows × leaves × depth] working set is chunked under it, pow2-floored so full chunks share one trace key (models/base.py) |
 | H2O_TPU_CONTRIB_SLO_DEFAULT | explain | SLO class for contributions requests when no X-H2O-SLO header is sent (rest.py; the model's scoring registry default deliberately does not apply) |
+| H2O_TPU_SHAP_KERNEL | auto | TreeSHAP serving impl: auto = chip-native Pallas kernel on TPU / lowered-XLA `flat_shap_tab` elsewhere, 1 forces the kernel (interpret mode off-chip), 0 kill switch restoring the XLA path bitwise; read at TRACE time like hist_impl — a cached contributions executable keeps its impl until scorer-cache evict/re-promote (ops/shap_kernel.py, docs/SERVING.md "Explainable serving") |
 | H2O_TPU_JOB_TIMEOUT | 0 (off) | server-side job-poll timeout: RUNNING jobs older than this read FAILED on /3/Jobs (rest.py) |
 | H2O_TPU_SCORE_QUEUE_MAX | 256 | scoring admission-queue bound: requests past it are load-shed with 429 + Retry-After; <=0 unbounded (rest.py, docs/RESILIENCE.md) |
 | H2O_TPU_DRAIN_TIMEOUT | 30 | seconds the SIGTERM drain waits for RUNNING jobs / batcher flush before failing them (runtime/lifecycle.py) |

@@ -629,14 +629,25 @@ class Model:
         of ``predict_contributions``, which keeps the f64 host
         recursion as the parity oracle the way predict() stays
         eager."""
+        from ..ops.shap_kernel import (flat_shap_tab_kernel, kernel_fits,
+                                       resolve_impl)
         from .tree.shap import flat_shap, flat_shap_tab
 
         groups, ctabs = self._contrib_prepare()
         em = self._contrib_enum_mask()
+        # impl resolves at TRACE time (H2O_TPU_SHAP_KERNEL, same
+        # semantics as hist_impl): the executable cached under this
+        # model's scorer key keeps its impl until evict/re-promote.
+        use_kernel = resolve_impl() == "pallas"
+        rows = int(X.shape[0])
         phi = None
         for g, ct in zip(groups, ctabs):
-            p = flat_shap_tab(g, ct, X, em) if ct is not None \
-                else flat_shap(g, X, em)
+            if ct is None:
+                p = flat_shap(g, X, em)
+            elif use_kernel and kernel_fits(g, ct, rows):
+                p = flat_shap_tab_kernel(g, ct, X, em)
+            else:
+                p = flat_shap_tab(g, ct, X, em)
             phi = p if phi is None else phi + p
         scale, init = self._contrib_scale_init()
         phi = phi * jnp.float32(scale)

@@ -538,7 +538,15 @@ def flat_shap_tab(tables: ShapTables, ctab, X, enum_mask):
     precomputed per-slot contributions — O(rows·leaves·depth) simple
     rows-contiguous ops instead of the O(depth²) weight DP per
     element, with the scatter reduced to per-slot [rows] vector adds
-    in the transposed accumulator."""
+    in the transposed accumulator.
+
+    This lowered-XLA form is ALSO the bitwise reference for its
+    chip-native twin ``ops/shap_kernel.flat_shap_tab_kernel`` (the
+    Pallas hand-placement of the same fold/gather/scatter loop, picked
+    on TPU by ``resolve_impl``/H2O_TPU_SHAP_KERNEL in
+    ``Model._contrib_matrix``); any semantic change here must keep the
+    kernel's ordered accumulation in lockstep or the
+    ``shap_kernel_parity`` gate and tier-1 bitwise pins will fail."""
     Xc = jnp.where(enum_mask[None, :] & (X < 0), jnp.float32(jnp.nan), X)
     XT = Xc.T                                           # [F, rows]
     F = X.shape[1]

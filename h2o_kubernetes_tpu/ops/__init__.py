@@ -1,3 +1,4 @@
 from .histogram import build_histogram
+from .shap_kernel import flat_shap_tab_kernel
 
-__all__ = ["build_histogram"]
+__all__ = ["build_histogram", "flat_shap_tab_kernel"]

@@ -1,0 +1,223 @@
+"""Chip-native TreeSHAP kernel (ISSUE 17 tentpole): the Pallas
+hand-placement of `flat_shap_tab` (`ops/shap_kernel.py`) must be
+BITWISE-equal to the lowered-XLA reference on the rich fixtures (NAs,
+grouped high-card enums, weights, DRF 1/T scaling, laplace
+margin_scale), hold additivity, restore the XLA path bitwise under the
+H2O_TPU_SHAP_KERNEL=0 kill switch, survive evict→promote bitwise with
+the kernel resident, and serve registry artifacts through the kernel
+bitwise vs the training-side model.  On CPU the kernel runs in
+INTERPRET mode (`interpret=jax.default_backend() != "tpu"`), so these
+are semantics pins; real-Mosaic lowering is the kernel gate's
+`shap_kernel_parity` job on chip."""
+
+import io
+import pickle
+
+import numpy as np
+import pytest
+
+import h2o_kubernetes_tpu as h2o
+from h2o_kubernetes_tpu.models import DRF, GBM
+from h2o_kubernetes_tpu.models.base import (evict_scorer_cache,
+                                            model_scorer_counters)
+from h2o_kubernetes_tpu.ops.shap_kernel import (flat_shap_tab_kernel,
+                                                kernel_fits,
+                                                resolve_impl)
+
+
+def _rich_frame(n=500, seed=7, nlevels=60):
+    """Same matrix as tests/test_contrib.py: numeric-with-NA +
+    low-card enum + HIGH-card enum (grouped code ranges at nbins=64)
+    + weights + binary response."""
+    rng = np.random.default_rng(seed)
+    x0 = rng.normal(size=n).astype(np.float32)
+    x0[::17] = np.nan
+    x1 = rng.exponential(2.0, size=n).astype(np.float32)
+    g = np.array([f"L{i}" for i in range(nlevels)])[
+        rng.integers(0, nlevels, n)]
+    c = np.array(["a", "b", "c"])[rng.integers(0, 3, n)]
+    w = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    y = np.where(np.nan_to_num(x0) + (c == "a")
+                 + rng.normal(scale=0.5, size=n) > 0, "p", "n")
+    return h2o.Frame.from_arrays(
+        {"x0": x0, "x1": x1, "g": g, "c": c, "w": w, "y": y})
+
+
+def _X(m, fr) -> np.ndarray:
+    return np.asarray(m._design_matrix(fr))[: fr.nrows]
+
+
+def _leg(m, X, env, monkeypatch):
+    """contrib_numpy with the impl FORCED on a fresh pickle copy —
+    the env knob is read at trace time and the scorer cache keys on
+    shape, not impl, so a warm executable would shadow the flip."""
+    mc = pickle.loads(pickle.dumps(m))
+    monkeypatch.setenv("H2O_TPU_SHAP_KERNEL", env)
+    try:
+        return mc.contrib_numpy(X)
+    finally:
+        monkeypatch.delenv("H2O_TPU_SHAP_KERNEL", raising=False)
+
+
+def test_kernel_groups_bitwise_vs_xla_reference(mesh8):
+    """Per virtual-tree-group: the Pallas kernel output is BITWISE
+    the XLA `flat_shap_tab` output on the rich fixture, at a pow2
+    serving shape."""
+    import jax.numpy as jnp
+
+    from h2o_kubernetes_tpu.models.tree.shap import flat_shap_tab
+
+    fr = _rich_frame()
+    m = GBM(ntrees=8, max_depth=4, nbins=64, seed=1).train(
+        y="y", training_frame=fr, weights_column="w")
+    groups, ctabs = m._contrib_prepare()
+    em = m._contrib_enum_mask()
+    Xp = jnp.asarray(_X(m, fr)[:256])
+    ngr = 0
+    for g, ct in zip(groups, ctabs):
+        if ct is None or not kernel_fits(g, ct, 256):
+            continue
+        ngr += 1
+        want = np.asarray(flat_shap_tab(g, ct, Xp, em))
+        got = np.asarray(flat_shap_tab_kernel(g, ct, Xp, em))
+        assert np.array_equal(want, got)
+    assert ngr > 0      # the fixture must actually exercise the kernel
+
+
+def test_kill_switch_restores_xla_bitwise(mesh8, monkeypatch):
+    """=0 (kill switch) equals BOTH the untouched default path on CPU
+    and the forced-kernel leg bitwise — flipping the knob never
+    changes served bytes."""
+    fr = _rich_frame(n=400, seed=13)
+    m = GBM(ntrees=6, max_depth=4, nbins=64, seed=2).train(
+        y="y", training_frame=fr, weights_column="w")
+    X = _X(m, fr)
+    base = m.contrib_numpy(X)       # auto -> xla on cpu
+    off = _leg(m, X, "0", monkeypatch)
+    on = _leg(m, X, "1", monkeypatch)
+    assert np.array_equal(base, off)
+    assert np.array_equal(off, on)
+
+
+@pytest.mark.parametrize("algo", ["gbm", "drf", "laplace"])
+def test_kernel_end_to_end_rich_fixtures(mesh8, monkeypatch, algo):
+    """Forced-kernel serving matches the XLA leg bitwise and holds
+    additivity on every rich fixture class: weighted binomial GBM,
+    DRF (1/T scaling), laplace (margin_scale)."""
+    import jax.numpy as jnp
+
+    if algo == "gbm":
+        fr = _rich_frame(n=400, seed=17)
+        m = GBM(ntrees=6, max_depth=4, nbins=64, seed=1).train(
+            y="y", training_frame=fr, weights_column="w")
+    elif algo == "drf":
+        fr = _rich_frame(n=400, seed=11)
+        m = DRF(ntrees=5, max_depth=3, seed=5).train(
+            y="y", training_frame=fr)
+    else:
+        rng = np.random.default_rng(3)
+        n = 400
+        x = rng.normal(size=n).astype(np.float32)
+        x[::11] = np.nan
+        yv = (2.0 * np.nan_to_num(x)
+              + rng.normal(scale=0.3, size=n)).astype(np.float32)
+        fr = h2o.Frame.from_arrays({"x": x, "y": yv})
+        m = GBM(ntrees=5, max_depth=3, distribution="laplace",
+                seed=2).train(y="y", training_frame=fr)
+        assert m.margin_scale != 1.0
+    X = _X(m, fr)
+    on = _leg(m, X, "1", monkeypatch)
+    off = _leg(m, X, "0", monkeypatch)
+    assert np.array_equal(on, off)
+    margins = np.asarray(m._margins(jnp.asarray(X)))[: fr.nrows]
+    np.testing.assert_allclose(on.sum(axis=1), margins,
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_evict_promote_bitwise_with_kernel_resident(mesh8,
+                                                    monkeypatch):
+    """The kernel executables ride the existing serving machinery:
+    evicting a kernel-resident model and re-scoring re-promotes
+    (persistent XLA cache) and reproduces the SAME bytes."""
+    monkeypatch.setenv("H2O_TPU_SHAP_KERNEL", "1")
+    fr = _rich_frame(n=300, seed=19)
+    m = GBM(ntrees=4, max_depth=3, nbins=64, seed=3).train(
+        y="y", training_frame=fr)
+    X = _X(m, fr)
+    phi1 = m.contrib_numpy(X)
+    ctr0 = model_scorer_counters(m)
+    evict_scorer_cache(m)
+    assert "_shap_tables" not in m.__dict__   # device tables dropped
+    assert "_shap_tables_np" in m.__dict__    # host tables survive
+    phi2 = m.contrib_numpy(X)
+    assert np.array_equal(phi1, phi2)
+    ctr1 = model_scorer_counters(m)
+    assert ctr1["promotions"] > ctr0["promotions"]
+
+
+def test_warm_up_covers_kernel_program(mesh8, monkeypatch):
+    """warm_up(contributions=True) pre-traces the KERNEL program too:
+    warm serving adds zero scorer-cache misses with the kernel on."""
+    monkeypatch.setenv("H2O_TPU_SHAP_KERNEL", "1")
+    fr = _rich_frame(n=300, seed=23)
+    m = GBM(ntrees=3, max_depth=3, nbins=64, seed=3).train(
+        y="y", training_frame=fr)
+    X = _X(m, fr)
+    m.warm_up([256], contributions=True)
+    c0 = model_scorer_counters(m)
+    m.contrib_numpy(X[:50])
+    m.contrib_numpy(X[:200])
+    c1 = model_scorer_counters(m)
+    assert c1["misses"] == c0["misses"]
+
+
+def test_registry_scorer_serves_through_kernel_bitwise(mesh8,
+                                                       monkeypatch):
+    """A registry-loaded FlatTreeScorer under the kernel serves
+    contributions BITWISE-identical to the training-side model (same
+    tables -> same program), and to the XLA leg."""
+    from h2o_kubernetes_tpu.mojo import export_mojo
+    from h2o_kubernetes_tpu.operator.registry import load_artifact
+
+    fr = _rich_frame(n=300, seed=29)
+    m = GBM(ntrees=4, max_depth=3, nbins=64, seed=5).train(
+        y="y", training_frame=fr)
+    X = _X(m, fr)
+    want_xla = _leg(m, X, "0", monkeypatch)
+    monkeypatch.setenv("H2O_TPU_SHAP_KERNEL", "1")
+    want = pickle.loads(pickle.dumps(m)).contrib_numpy(X)
+    buf = io.BytesIO()
+    export_mojo(m, buf)
+    fts = load_artifact(buf.getvalue())
+    got = fts.contrib_numpy(X)
+    assert np.array_equal(got, want)
+    assert np.array_equal(got, want_xla)
+
+
+def test_resolve_impl_and_eligibility():
+    """Knob hygiene: junk env raises (a typo must not silently demote
+    the kernel); ineligible shapes fall back instead of tracing."""
+    import jax.numpy as jnp
+
+    import os
+    assert resolve_impl("pallas") == "pallas"
+    assert resolve_impl("xla") == "xla"
+    os.environ["H2O_TPU_SHAP_KERNEL"] = "1"
+    try:
+        assert resolve_impl() == "pallas"
+        os.environ["H2O_TPU_SHAP_KERNEL"] = "bogus"
+        with pytest.raises(ValueError, match="H2O_TPU_SHAP_KERNEL"):
+            resolve_impl()
+    finally:
+        os.environ.pop("H2O_TPU_SHAP_KERNEL", None)
+    with pytest.raises(ValueError):
+        resolve_impl("segment")
+    # eligibility: no pattern table / non-pow2 / tiny batches say no
+    class G:
+        feat = jnp.zeros((1, 4, 3), jnp.int32)
+
+    ct = jnp.zeros((1, 4, 3, 8), jnp.float32)
+    assert not kernel_fits(G, None)
+    assert not kernel_fits(G, ct, 100)       # non-pow2
+    assert not kernel_fits(G, ct, 64)        # < serving min batch
+    assert kernel_fits(G, ct, 256)

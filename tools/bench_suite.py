@@ -74,7 +74,7 @@ call — what a cold user pays, XLA compile included) and ``seconds``
 (steady state, compile cached; repeated until ≥1 s of measured work
 or 3 calls on the CPU mesh, single repeat on TPU where trains are
 long and chip windows are ~20 min). One JSON line per config + a
-trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r13.json`` at the
+trailing summary; writes ``BENCH_SUITE_{TPU|CPU}_r14.json`` at the
 repo root. Run by tools/tpu_watch.py once per chip window.
 """
 
@@ -381,6 +381,40 @@ def main() -> int:
         parity_err = float(np.abs(phi[:host_rows] - phi_h).max())
         dev_rps = sh_rows / dt
         host_rps = host_rows / host_dt
+        # XLA-vs-kernel leg pair (ISSUE 17): each impl forced via
+        # H2O_TPU_SHAP_KERNEL on a FRESH pickle copy — the scorer
+        # cache keys on shape, not impl, so a warm executable would
+        # otherwise shadow the flip. The kernel leg is recorded ONLY
+        # with a chip attached: off-chip the Pallas kernel runs in
+        # INTERPRET mode, which is a correctness harness, not a
+        # throughput claim.
+        import pickle
+
+        def _impl_leg(env_val):
+            mc = pickle.loads(pickle.dumps(m_sh))
+            os.environ["H2O_TPU_SHAP_KERNEL"] = env_val
+            try:
+                phi_l, dt_l, _, _ = _timed(
+                    lambda: mc.contrib_numpy(X_sh), on_tpu)
+            finally:
+                os.environ.pop("H2O_TPU_SHAP_KERNEL", None)
+            return phi_l, sh_rows / dt_l
+
+        phi_x, xla_rps = _impl_leg("0")
+        legs = {"xla_rows_per_s": round(xla_rps, 1)}
+        if on_tpu:
+            phi_k, k_rps = _impl_leg("1")
+            legs.update(
+                kernel_rows_per_s=round(k_rps, 1),
+                kernel_speedup_vs_xla=round(
+                    k_rps / max(xla_rps, 1e-9), 2),
+                kernel_vs_xla_bitwise=bool(
+                    np.array_equal(phi_k, phi_x)))
+        else:
+            legs.update(
+                kernel_rows_per_s=None,
+                kernel_leg="skipped: no chip attached (interpret "
+                           "mode is excluded from throughput claims)")
         record("gbm_shap_rows_per_sec", dev_rps, "rows/s", dt, calls,
                cdt, rows_shap=sh_rows, ntrees=20, max_depth=5,
                host_rows=host_rows, host_seconds=round(host_dt, 3),
@@ -388,7 +422,7 @@ def main() -> int:
                speedup_vs_host=round(dev_rps / max(host_rps, 1e-9), 1),
                additivity_max_err=add_err,
                host_parity_max_err=parity_err,
-               warm_repeat_misses=warm_misses)
+               warm_repeat_misses=warm_misses, **legs)
         del fr_sh, m_sh, X_sh, phi
 
     if _want("automl_wall_100k"):
@@ -789,7 +823,7 @@ def main() -> int:
     suffix = "" if not only else "_partial"
     path = os.path.join(
         REPO,
-        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r13{suffix}.json")
+        f"BENCH_SUITE_{'TPU' if on_tpu else 'CPU'}_r14{suffix}.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"bench_suite": "done", "configs": len(results),

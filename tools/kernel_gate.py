@@ -9,17 +9,28 @@ bench chip at round's end.  This script closes that hole: on a TPU it
    automatic on tpu) at a bench-like shape and asserts parity vs the
    segment_sum reference path;
 2. same for the BIN-BLOCKED kernel (deep-tree shape past the
-   factorized VMEM cap);
+   factorized VMEM cap) and the TreeSHAP serving kernel
+   (`ops/shap_kernel.py`, bitwise vs the lowered-XLA
+   `flat_shap_tab`);
 3. jit-compiles and runs the fused boost scan (binomial AND
    multinomial) end to end on small shapes.
 
-Prints one JSON line {"gate": "pass"|"fail", ...}; exit code 0 on pass.
-On CPU it still runs (interpret-mode parity) and reports
-platform="cpu" so the ritual can tell the gate did not see a chip.
+Checks are NAMED and individually selectable: `--check NAME` (repeat
+or comma-separate) runs just those — iterating one kernel's parity
+without the full sweep — and `--list` prints the names. The `N/N PASS`
+summary counts only what RAN, and a filtered run says so in the JSON
+(`"filtered": [...]`) so a 2/2 can't masquerade as the full gate.
 
-Usage: python tools/kernel_gate.py  (H2O_TPU_PROBE_BUDGET honored)
+Prints one JSON line {"gate": "pass"|"fail", ...} LAST on stdout
+(tpu_watch parses bottom-up); exit code 0 on pass.  On CPU it still
+runs (interpret-mode parity) and reports platform="cpu" so the ritual
+can tell the gate did not see a chip.
+
+Usage: python tools/kernel_gate.py [--check NAME ...] [--list]
+       (H2O_TPU_PROBE_BUDGET honored)
 """
 
+import argparse
 import json
 import os
 import sys
@@ -28,8 +39,35 @@ import traceback
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+CHECK_NAMES = [
+    "fact_kernel", "fact_kernel_cap", "binblock_kernel",
+    "leaf_totals_kernel", "unit_hess_kernel", "two_term_kernel",
+    "boost_scan_binomial", "boost_scan_multinomial",
+    "flat_scorer_parity", "flat_scorer_parity_multinomial",
+    "shap_parity", "shap_kernel_parity", "efb_parity", "goss_parity",
+]
 
-def main() -> int:
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this check (repeat or comma-"
+                         "separate); default: all")
+    ap.add_argument("--list", action="store_true",
+                    help="print check names and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        print("\n".join(CHECK_NAMES))
+        return 0
+    selected = CHECK_NAMES
+    if args.check:
+        selected = [c.strip() for spec in args.check
+                    for c in spec.split(",") if c.strip()]
+        unknown = [c for c in selected if c not in CHECK_NAMES]
+        if unknown:
+            ap.error(f"unknown check(s) {unknown}; --list shows names")
+
     from h2o_kubernetes_tpu.runtime.backend import ensure_live_backend
 
     ensure_live_backend(budget=float(
@@ -74,195 +112,329 @@ def main() -> int:
         checks.append({"check": name, "ok": ok, "rel_err": err})
         return ok
 
-    # 1. factorized kernel: node·bins within 128·_FACT_MAX_NHI
-    n_nodes_fact = 16
-    assert -(-n_nodes_fact * 256 // 128) <= _FACT_MAX_NHI
-    parity("fact_kernel", 100_000, 10, n_nodes_fact, 256)
-    # 1b. factorized kernel AT the VMEM cap (n_hi == _FACT_MAX_NHI):
-    # validates the [3·C·n_hi, T] stacked-term A fits VMEM on real
-    # Mosaic, where interpret mode can't see allocation failures
-    parity("fact_kernel_cap", 50_000, 2, _FACT_MAX_NHI * 128 // 256,
-           256)
-    # 2. bin-blocked kernel: force past the factorized cap
-    n_nodes_deep = (_FACT_MAX_NHI * 128 // 256) * 2
-    parity("binblock_kernel", 50_000, 4, n_nodes_deep, 256)
-    # 2b. single-bin totals shape (the final-level leaf reduction)
-    parity("leaf_totals_kernel", 100_000, 1, 32, 1)
-
-    # 2c. unit-hessian 2-channel kernel (gaussian/DRF fast path): must
-    # compile on Mosaic and match the 3-channel build with h = 1
-    rows_u, F_u, n_u, B_u = 100_000, 10, 16, 256
-    binned_u = jnp.asarray(
-        rng.integers(0, B_u, size=(rows_u, F_u)).astype(np.uint8))
-    rel_u = jnp.asarray(rng.integers(0, n_u, size=rows_u).astype(
-        np.int32))
-    g_u = jnp.asarray(rng.normal(size=rows_u).astype(np.float32))
-    w_u = jnp.asarray((rng.uniform(size=rows_u) < 0.95).astype(
-        np.float32))
-    ones_u = jnp.ones_like(w_u)
-    want_u = jax.jit(build_histogram, static_argnums=(5, 6, 7))(
-        binned_u, rel_u, g_u, ones_u, w_u, n_u, B_u, "pallas")
-    got_u = expand_unit_hess(jax.jit(
-        build_histogram, static_argnums=(5, 6, 7),
-        static_argnames=("unit_hess",))(
-        binned_u, rel_u, g_u, ones_u, w_u, n_u, B_u, "pallas",
-        unit_hess=True))
-    err_u = float(jnp.max(jnp.abs(got_u - want_u)) /
-                  (jnp.max(jnp.abs(want_u)) + 1e-30))
-    checks.append({"check": "unit_hess_kernel", "ok": err_u < 1e-5,
-                   "rel_err": err_u})
-
-    # 2d. 2-term mantissa throughput mode (H2O_TPU_HIST_TERMS=2): the
-    # stacked A drops a third of its M rows; parity is checked against
-    # the SEGMENT reference (so the check stays meaningful whatever
-    # mode the gate itself runs under) at single-precision-histogram
-    # tolerance (products ~2^-16)
-    import h2o_kubernetes_tpu.ops.histogram as H
-
-    orig_terms = H._TERMS
-    H._TERMS = 2
-    jax.clear_caches()    # _TERMS is not a trace key: force a retrace
-    try:
-        parity("two_term_kernel", 100_000, 10, 16, 256, tol=1e-4)
-    finally:
-        H._TERMS = orig_terms
-        jax.clear_caches()
-
-    # 3. fused boost scans compile + run (binomial and multinomial)
+    # ---- shared lazy fixtures (built once, whichever checks run) ----
     import h2o_kubernetes_tpu as h2o
     from h2o_kubernetes_tpu.models import GBM
 
+    _fix: dict = {}
     n = 4096
     x = rng.normal(size=n).astype(np.float32)
-    y2 = np.where(x > 0, "p", "n")
-    fr2 = h2o.Frame.from_arrays({"x": x, "y": y2})
-    m2 = GBM(ntrees=3, max_depth=4, seed=0).train(
-        y="y", training_frame=fr2)
-    checks.append({"check": "boost_scan_binomial",
-                   "ok": len(m2.scoring_history) > 0})
-    y3 = np.where(x > 0.5, "a", np.where(x < -0.5, "b", "c"))
-    fr3 = h2o.Frame.from_arrays({"x": x, "y": y3})
-    m3 = GBM(ntrees=3, max_depth=3, seed=0).train(
-        y="y", training_frame=fr3)
-    checks.append({"check": "boost_scan_multinomial",
-                   "ok": m3.ntrees == 9})
 
-    # 4. flattened serving scorer (models/tree/core.py flat_margin)
-    # must match the binned heap re-descent BITWISE on chip — the
-    # serving fast path and MOJO export both descend these arrays.
-    # NA + categorical + high-cardinality grouped bins in one frame.
-    xna = x.copy()
-    xna[::13] = np.nan
-    gg = np.array([f"L{i}" for i in range(80)])[
-        rng.integers(0, 80, size=n)]
-    yf = np.where(np.nan_to_num(xna) > 0, "p", "n")
-    frf = h2o.Frame.from_arrays({"x": xna, "g": gg, "y": yf})
-    mf = GBM(ntrees=4, max_depth=4, nbins=64, seed=0).train(
-        y="y", training_frame=frf)
-    Xf = mf._design_matrix(frf)
-    flat_ok = bool(np.array_equal(np.asarray(mf._margins(Xf)),
-                                  np.asarray(mf._margins_binned(Xf))))
-    checks.append({"check": "flat_scorer_parity", "ok": flat_ok})
-    X3 = m3._design_matrix(fr3)
-    flat3_ok = bool(np.array_equal(np.asarray(m3._margins(X3)),
-                                   np.asarray(m3._margins_binned(X3))))
-    checks.append({"check": "flat_scorer_parity_multinomial",
-                   "ok": flat3_ok})
-
-    # 4b. compiled TreeSHAP serving (models/tree/shap.flat_shap) must
-    # match the f64 host recursion on chip AND hold the additivity
-    # invariant on device — the path tables + unwind DP must survive
-    # real lowering, not just CPU interpret. Same NA + high-card
-    # grouped-enum frame as the flat-scorer check.
-    Xf_np = np.asarray(Xf)[: n]
-    contrib = mf.predict_contributions(frf)
-    host_phi = np.stack([contrib.vec(c).to_numpy()
-                         for c in contrib.names], axis=1)
-    dev_phi = mf.contrib_numpy(Xf_np)
-    shap_err = float(np.abs(dev_phi - host_phi).max())
-    margins_f = np.asarray(mf._margins(Xf))[: n]
-    add_err = float(np.abs(dev_phi.sum(axis=1) - margins_f).max())
-    checks.append({"check": "shap_parity",
-                   "ok": shap_err < 1e-4 and add_err < 1e-4,
-                   "host_err": shap_err, "additivity_err": add_err})
-
-    # 5. EFB parity on chip: bundled vs unbundled training must pick
-    # identical splits and produce bitwise-identical predictions on an
-    # exact-sum wide one-hot fixture (models/tree/efb.py — the bundled
-    # histogram runs the SAME pallas kernel at bundled width, and the
-    # decode/remainder math must survive real Mosaic, not just
-    # interpret mode). Single gaussian round on a dyadic response =
-    # every sum exact, so any deviation is a bug, not float noise.
-    ne = 4096
-    ecols = {}
-    cat_e = rng.integers(0, 16, size=(4, ne))
-    for gi in range(4):
-        for k in range(16):
-            ecols[f"c{gi}_{k}"] = (cat_e[gi] == k).astype(np.float32)
-    ecols["c0_0"][::31] = np.nan
-    ecols["dx"] = rng.normal(size=ne).astype(np.float32)
-    ecols["ye"] = ((cat_e[0] == 1).astype(np.float32)
-                   - (cat_e[1] == 2) + (ecols["dx"] > 0)).astype(
-        np.float32)
-    fr_e = h2o.Frame.from_arrays(ecols)
-
-    def _efb_leg(env):
-        os.environ["H2O_TPU_EFB"] = env
-        try:
-            return GBM(ntrees=1, max_depth=5, seed=0).train(
-                y="ye", training_frame=fr_e)
-        finally:
-            os.environ.pop("H2O_TPU_EFB", None)
-
-    m_b = _efb_leg("1")
-    m_u = _efb_leg("0")
-    isp = np.asarray(m_u.trees.is_split)
-    efb_ok = bool(np.array_equal(isp, np.asarray(m_b.trees.is_split)))
-    for fld in ("split_feat", "split_bin", "na_left"):
-        a = np.where(isp, np.asarray(getattr(m_u.trees, fld)), -9)
-        b = np.where(isp, np.asarray(getattr(m_b.trees, fld)), -9)
-        efb_ok &= bool(np.array_equal(a, b))
-    efb_ok &= bool(np.array_equal(
-        np.asarray(m_u.predict_raw(fr_e)),
-        np.asarray(m_b.predict_raw(fr_e))))
-    checks.append({"check": "efb_parity", "ok": efb_ok})
-
-    # 6. GOSS sampled boost program (ISSUE 13): the static-capacity
-    # compaction (jnp.nonzero + gathers inside the shard_map scan),
-    # the hashed per-row draws and the full-row re-descent margin
-    # update must survive real lowering, not just CPU. Pinned two
-    # ways: a+b=1 keeps every row at amplification (1-a)/b = 1, so
-    # the SAMPLED program must reproduce the unsampled m2 BITWISE;
-    # and a really-sampled config must be seeded-deterministic while
-    # actually differing from unsampled.
-    def _goss_leg(a, b):
-        os.environ.update({"H2O_TPU_GOSS": "1",
-                           "H2O_TPU_GOSS_TOP_A": a,
-                           "H2O_TPU_GOSS_RAND_B": b})
-        try:
-            return GBM(ntrees=3, max_depth=4, seed=0).train(
+    def fix_binomial():
+        """fr2/m2: tiny binomial GBM (boost_scan_binomial + goss)."""
+        if "m2" not in _fix:
+            y2 = np.where(x > 0, "p", "n")
+            fr2 = h2o.Frame.from_arrays({"x": x, "y": y2})
+            _fix["fr2"] = fr2
+            _fix["m2"] = GBM(ntrees=3, max_depth=4, seed=0).train(
                 y="y", training_frame=fr2)
+        return _fix["fr2"], _fix["m2"]
+
+    def fix_multinomial():
+        """fr3/m3: tiny multinomial GBM (boost scan + flat scorer)."""
+        if "m3" not in _fix:
+            y3 = np.where(x > 0.5, "a",
+                          np.where(x < -0.5, "b", "c"))
+            fr3 = h2o.Frame.from_arrays({"x": x, "y": y3})
+            _fix["fr3"] = fr3
+            _fix["m3"] = GBM(ntrees=3, max_depth=3, seed=0).train(
+                y="y", training_frame=fr3)
+        return _fix["fr3"], _fix["m3"]
+
+    def fix_rich():
+        """frf/mf/Xf: NA + high-cardinality grouped-enum frame (flat
+        scorer, shap_parity, shap_kernel_parity)."""
+        if "mf" not in _fix:
+            xna = x.copy()
+            xna[::13] = np.nan
+            gg = np.array([f"L{i}" for i in range(80)])[
+                rng.integers(0, 80, size=n)]
+            yf = np.where(np.nan_to_num(xna) > 0, "p", "n")
+            frf = h2o.Frame.from_arrays({"x": xna, "g": gg, "y": yf})
+            mf = GBM(ntrees=4, max_depth=4, nbins=64, seed=0).train(
+                y="y", training_frame=frf)
+            _fix["frf"], _fix["mf"] = frf, mf
+            _fix["Xf"] = mf._design_matrix(frf)
+        return _fix["frf"], _fix["mf"], _fix["Xf"]
+
+    # ------------------------- checks --------------------------------
+
+    def chk_fact_kernel():
+        # factorized kernel: node·bins within 128·_FACT_MAX_NHI
+        n_nodes_fact = 16
+        assert -(-n_nodes_fact * 256 // 128) <= _FACT_MAX_NHI
+        parity("fact_kernel", 100_000, 10, n_nodes_fact, 256)
+
+    def chk_fact_kernel_cap():
+        # factorized kernel AT the VMEM cap (n_hi == _FACT_MAX_NHI):
+        # validates the [3·C·n_hi, T] stacked-term A fits VMEM on real
+        # Mosaic, where interpret mode can't see allocation failures
+        parity("fact_kernel_cap", 50_000, 2,
+               _FACT_MAX_NHI * 128 // 256, 256)
+
+    def chk_binblock_kernel():
+        # bin-blocked kernel: force past the factorized cap
+        n_nodes_deep = (_FACT_MAX_NHI * 128 // 256) * 2
+        parity("binblock_kernel", 50_000, 4, n_nodes_deep, 256)
+
+    def chk_leaf_totals_kernel():
+        # single-bin totals shape (the final-level leaf reduction)
+        parity("leaf_totals_kernel", 100_000, 1, 32, 1)
+
+    def chk_unit_hess_kernel():
+        # unit-hessian 2-channel kernel (gaussian/DRF fast path): must
+        # compile on Mosaic and match the 3-channel build with h = 1
+        rows_u, F_u, n_u, B_u = 100_000, 10, 16, 256
+        binned_u = jnp.asarray(
+            rng.integers(0, B_u, size=(rows_u, F_u)).astype(np.uint8))
+        rel_u = jnp.asarray(rng.integers(0, n_u, size=rows_u).astype(
+            np.int32))
+        g_u = jnp.asarray(rng.normal(size=rows_u).astype(np.float32))
+        w_u = jnp.asarray((rng.uniform(size=rows_u) < 0.95).astype(
+            np.float32))
+        ones_u = jnp.ones_like(w_u)
+        want_u = jax.jit(build_histogram, static_argnums=(5, 6, 7))(
+            binned_u, rel_u, g_u, ones_u, w_u, n_u, B_u, "pallas")
+        got_u = expand_unit_hess(jax.jit(
+            build_histogram, static_argnums=(5, 6, 7),
+            static_argnames=("unit_hess",))(
+            binned_u, rel_u, g_u, ones_u, w_u, n_u, B_u, "pallas",
+            unit_hess=True))
+        err_u = float(jnp.max(jnp.abs(got_u - want_u)) /
+                      (jnp.max(jnp.abs(want_u)) + 1e-30))
+        checks.append({"check": "unit_hess_kernel",
+                       "ok": err_u < 1e-5, "rel_err": err_u})
+
+    def chk_two_term_kernel():
+        # 2-term mantissa throughput mode (H2O_TPU_HIST_TERMS=2): the
+        # stacked A drops a third of its M rows; parity is checked
+        # against the SEGMENT reference (so the check stays meaningful
+        # whatever mode the gate itself runs under) at
+        # single-precision-histogram tolerance (products ~2^-16)
+        import h2o_kubernetes_tpu.ops.histogram as H
+
+        orig_terms = H._TERMS
+        H._TERMS = 2
+        jax.clear_caches()  # _TERMS is not a trace key: force retrace
+        try:
+            parity("two_term_kernel", 100_000, 10, 16, 256, tol=1e-4)
         finally:
-            for k in ("H2O_TPU_GOSS", "H2O_TPU_GOSS_TOP_A",
-                      "H2O_TPU_GOSS_RAND_B"):
-                os.environ.pop(k, None)
+            H._TERMS = orig_terms
+            jax.clear_caches()
 
-    def _trees_equal(ma, mb):
-        return all(np.array_equal(np.asarray(x), np.asarray(y))
-                   for x, y in zip(jax.tree.flatten(ma.trees)[0],
-                                   jax.tree.flatten(mb.trees)[0]))
+    def chk_boost_scan_binomial():
+        _, m2 = fix_binomial()
+        checks.append({"check": "boost_scan_binomial",
+                       "ok": len(m2.scoring_history) > 0})
 
-    m_gid = _goss_leg("0.5", "0.5")
-    goss_ok = _trees_equal(m2, m_gid)
-    m_g1 = _goss_leg("0.2", "0.2")
-    m_g2 = _goss_leg("0.2", "0.2")
-    goss_ok &= _trees_equal(m_g1, m_g2)
-    goss_ok &= not _trees_equal(m2, m_g1)
-    checks.append({"check": "goss_parity", "ok": bool(goss_ok)})
+    def chk_boost_scan_multinomial():
+        _, m3 = fix_multinomial()
+        checks.append({"check": "boost_scan_multinomial",
+                       "ok": m3.ntrees == 9})
 
-    ok = all(c["ok"] for c in checks)
-    print(json.dumps({"gate": "pass" if ok else "fail",
-                      "platform": platform, "checks": checks}))
+    def chk_flat_scorer_parity():
+        # flattened serving scorer (models/tree/core.py flat_margin)
+        # must match the binned heap re-descent BITWISE on chip — the
+        # serving fast path and MOJO export both descend these arrays.
+        # NA + categorical + high-cardinality grouped bins in one
+        # frame.
+        _, mf, Xf = fix_rich()
+        flat_ok = bool(np.array_equal(
+            np.asarray(mf._margins(Xf)),
+            np.asarray(mf._margins_binned(Xf))))
+        checks.append({"check": "flat_scorer_parity", "ok": flat_ok})
+
+    def chk_flat_scorer_parity_multinomial():
+        fr3, m3 = fix_multinomial()
+        X3 = m3._design_matrix(fr3)
+        flat3_ok = bool(np.array_equal(
+            np.asarray(m3._margins(X3)),
+            np.asarray(m3._margins_binned(X3))))
+        checks.append({"check": "flat_scorer_parity_multinomial",
+                       "ok": flat3_ok})
+
+    def chk_shap_parity():
+        # compiled TreeSHAP serving (models/tree/shap.flat_shap) must
+        # match the f64 host recursion on chip AND hold the additivity
+        # invariant on device — the path tables + unwind DP must
+        # survive real lowering, not just CPU interpret. Same NA +
+        # high-card grouped-enum frame as the flat-scorer check.
+        frf, mf, Xf = fix_rich()
+        Xf_np = np.asarray(Xf)[:n]
+        contrib = mf.predict_contributions(frf)
+        host_phi = np.stack([contrib.vec(c).to_numpy()
+                             for c in contrib.names], axis=1)
+        dev_phi = mf.contrib_numpy(Xf_np)
+        shap_err = float(np.abs(dev_phi - host_phi).max())
+        margins_f = np.asarray(mf._margins(Xf))[:n]
+        add_err = float(np.abs(dev_phi.sum(axis=1) - margins_f).max())
+        checks.append({"check": "shap_parity",
+                       "ok": shap_err < 1e-4 and add_err < 1e-4,
+                       "host_err": shap_err, "additivity_err": add_err})
+
+    def chk_shap_kernel_parity():
+        # chip-native TreeSHAP kernel (ops/shap_kernel.py) must be
+        # BITWISE-equal to the lowered-XLA `flat_shap_tab` it
+        # hand-places — per virtual-tree group at a pow2 serving
+        # shape, AND end-to-end through contrib_numpy with the env
+        # knob forcing each impl on a fresh model copy (the scorer
+        # cache keys on shape, not impl, so each leg needs its own
+        # executables). On TPU this compiles real Mosaic
+        # (interpret=False); on CPU it pins the interpret-mode path
+        # tier-1 also covers.
+        import pickle
+
+        from h2o_kubernetes_tpu.models.tree.shap import flat_shap_tab
+        from h2o_kubernetes_tpu.ops.shap_kernel import (
+            flat_shap_tab_kernel, kernel_fits)
+
+        frf, mf, Xf = fix_rich()
+        groups, ctabs = mf._contrib_prepare()
+        em = mf._contrib_enum_mask()
+        Xp = jnp.asarray(np.asarray(Xf)[:1024])
+        ngr = 0
+        ok = True
+        err = 0.0
+        for g, ct in zip(groups, ctabs):
+            if ct is None or not kernel_fits(g, ct, 1024):
+                continue
+            ngr += 1
+            want = np.asarray(flat_shap_tab(g, ct, Xp, em))
+            got = np.asarray(flat_shap_tab_kernel(g, ct, Xp, em))
+            ok &= bool(np.array_equal(want, got))
+            err = max(err, float(np.nanmax(np.abs(want - got))))
+        ok &= ngr > 0   # the rich fixture must actually exercise it
+
+        def _leg(env):
+            mc = pickle.loads(pickle.dumps(mf))
+            os.environ["H2O_TPU_SHAP_KERNEL"] = env
+            try:
+                return mc.contrib_numpy(np.asarray(Xf)[:n])
+            finally:
+                os.environ.pop("H2O_TPU_SHAP_KERNEL", None)
+
+        e2e = bool(np.array_equal(_leg("1"), _leg("0")))
+        checks.append({"check": "shap_kernel_parity",
+                       "ok": bool(ok and e2e),
+                       "kernel_groups": ngr, "e2e_bitwise": e2e,
+                       "max_abs_err": err,
+                       "interpret": platform != "tpu"})
+
+    def chk_efb_parity():
+        # EFB parity on chip: bundled vs unbundled training must pick
+        # identical splits and produce bitwise-identical predictions
+        # on an exact-sum wide one-hot fixture (models/tree/efb.py —
+        # the bundled histogram runs the SAME pallas kernel at bundled
+        # width, and the decode/remainder math must survive real
+        # Mosaic, not just interpret mode). Single gaussian round on a
+        # dyadic response = every sum exact, so any deviation is a
+        # bug, not float noise.
+        ne = 4096
+        ecols = {}
+        cat_e = rng.integers(0, 16, size=(4, ne))
+        for gi in range(4):
+            for k in range(16):
+                ecols[f"c{gi}_{k}"] = (cat_e[gi] == k).astype(
+                    np.float32)
+        ecols["c0_0"][::31] = np.nan
+        ecols["dx"] = rng.normal(size=ne).astype(np.float32)
+        ecols["ye"] = ((cat_e[0] == 1).astype(np.float32)
+                       - (cat_e[1] == 2) + (ecols["dx"] > 0)).astype(
+            np.float32)
+        fr_e = h2o.Frame.from_arrays(ecols)
+
+        def _efb_leg(env):
+            os.environ["H2O_TPU_EFB"] = env
+            try:
+                return GBM(ntrees=1, max_depth=5, seed=0).train(
+                    y="ye", training_frame=fr_e)
+            finally:
+                os.environ.pop("H2O_TPU_EFB", None)
+
+        m_b = _efb_leg("1")
+        m_u = _efb_leg("0")
+        isp = np.asarray(m_u.trees.is_split)
+        efb_ok = bool(np.array_equal(isp,
+                                     np.asarray(m_b.trees.is_split)))
+        for fld in ("split_feat", "split_bin", "na_left"):
+            a = np.where(isp, np.asarray(getattr(m_u.trees, fld)), -9)
+            b = np.where(isp, np.asarray(getattr(m_b.trees, fld)), -9)
+            efb_ok &= bool(np.array_equal(a, b))
+        efb_ok &= bool(np.array_equal(
+            np.asarray(m_u.predict_raw(fr_e)),
+            np.asarray(m_b.predict_raw(fr_e))))
+        checks.append({"check": "efb_parity", "ok": efb_ok})
+
+    def chk_goss_parity():
+        # GOSS sampled boost program (ISSUE 13): the static-capacity
+        # compaction (jnp.nonzero + gathers inside the shard_map
+        # scan), the hashed per-row draws and the full-row re-descent
+        # margin update must survive real lowering, not just CPU.
+        # Pinned two ways: a+b=1 keeps every row at amplification
+        # (1-a)/b = 1, so the SAMPLED program must reproduce the
+        # unsampled m2 BITWISE; and a really-sampled config must be
+        # seeded-deterministic while actually differing from
+        # unsampled.
+        fr2, m2 = fix_binomial()
+
+        def _goss_leg(a, b):
+            os.environ.update({"H2O_TPU_GOSS": "1",
+                               "H2O_TPU_GOSS_TOP_A": a,
+                               "H2O_TPU_GOSS_RAND_B": b})
+            try:
+                return GBM(ntrees=3, max_depth=4, seed=0).train(
+                    y="y", training_frame=fr2)
+            finally:
+                for k in ("H2O_TPU_GOSS", "H2O_TPU_GOSS_TOP_A",
+                          "H2O_TPU_GOSS_RAND_B"):
+                    os.environ.pop(k, None)
+
+        def _trees_equal(ma, mb):
+            return all(np.array_equal(np.asarray(xa), np.asarray(xb))
+                       for xa, xb in zip(jax.tree.flatten(ma.trees)[0],
+                                         jax.tree.flatten(mb.trees)[0]))
+
+        m_gid = _goss_leg("0.5", "0.5")
+        goss_ok = _trees_equal(m2, m_gid)
+        m_g1 = _goss_leg("0.2", "0.2")
+        m_g2 = _goss_leg("0.2", "0.2")
+        goss_ok &= _trees_equal(m_g1, m_g2)
+        goss_ok &= not _trees_equal(m2, m_g1)
+        checks.append({"check": "goss_parity", "ok": bool(goss_ok)})
+
+    registry = {
+        "fact_kernel": chk_fact_kernel,
+        "fact_kernel_cap": chk_fact_kernel_cap,
+        "binblock_kernel": chk_binblock_kernel,
+        "leaf_totals_kernel": chk_leaf_totals_kernel,
+        "unit_hess_kernel": chk_unit_hess_kernel,
+        "two_term_kernel": chk_two_term_kernel,
+        "boost_scan_binomial": chk_boost_scan_binomial,
+        "boost_scan_multinomial": chk_boost_scan_multinomial,
+        "flat_scorer_parity": chk_flat_scorer_parity,
+        "flat_scorer_parity_multinomial":
+            chk_flat_scorer_parity_multinomial,
+        "shap_parity": chk_shap_parity,
+        "shap_kernel_parity": chk_shap_kernel_parity,
+        "efb_parity": chk_efb_parity,
+        "goss_parity": chk_goss_parity,
+    }
+    assert list(registry) == CHECK_NAMES
+    for name in CHECK_NAMES:
+        if name in selected:
+            registry[name]()
+
+    passed = sum(1 for c in checks if c["ok"])
+    total = len(checks)
+    ok = passed == total and total > 0
+    sys.stderr.write(
+        f"kernel_gate: {passed}/{total} PASS"
+        + (" (filtered)" if args.check else "") + "\n")
+    out = {"gate": "pass" if ok else "fail", "platform": platform,
+           "passed": passed, "total": total, "checks": checks}
+    if args.check:
+        out["filtered"] = selected
+    print(json.dumps(out))
     return 0 if ok else 1
 
 

@@ -124,6 +124,15 @@ def capture() -> float | None:
                           f, indent=1)
             log(f"pinned non-interpret parity artifact: {wanted}")
 
+    # round-17 pin: the chip-native TreeSHAP kernel
+    # (ops/shap_kernel.py) has only ever run interpret-mode on CPU —
+    # the first chip window must record the REAL-Mosaic
+    # shap_kernel_parity verdict plus the ≥2× gbm_shap_rows_per_sec
+    # kernel-vs-XLA bar (the ROADMAP acceptance), alongside the
+    # carried goss/shap pins from r16. The speedup is read back from
+    # the on-chip bench_suite artifact captured later this window, so
+    # this block runs AFTER the suite (see _pin_r17 call below).
+
     log("running bench.py on chip")
     ok, bench, tail = run_json([sys.executable, "bench.py"], BENCH_TIMEOUT)
     if bench is None:
@@ -214,8 +223,9 @@ def capture() -> float | None:
     # lowest priority (chip windows are ~20 min; profile + AutoML are
     # the round's named evidence): the non-GBM BASELINE configs (GLM
     # iters/sec, DRF HIGGS on the unit-hess path, XGBoost hist,
-    # lambdarank, DL, Word2Vec)
-    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r13.json")
+    # lambdarank, DL, Word2Vec) — r14 also carries the TreeSHAP
+    # XLA-vs-kernel leg pair the r17 pin below reads back
+    suite_path = os.path.join(REPO, "BENCH_SUITE_TPU_r14.json")
     if not os.path.exists(suite_path):
         log("running bench_suite on chip")
         ok, suite, tail = run_json(
@@ -225,7 +235,43 @@ def capture() -> float | None:
             f"result={json.dumps(suite)[:300] if suite else ''}")
         if not ok:
             log(f"bench_suite tail: {tail}")
+    _pin_r17(gate, suite_path)
     return float(bench.get("value", 0.0))
+
+
+def _pin_r17(gate, suite_path: str) -> None:
+    """Round-17 chip-window pin (see comment at the r16 block): the
+    non-interpret shap_kernel_parity verdict + the ≥2×
+    gbm_shap_rows_per_sec kernel-vs-XLA bar, with the carried
+    goss/shap pins, into TPU_GATE_parity_r17.json."""
+    path = os.path.join(REPO, "TPU_GATE_parity_r17.json")
+    if os.path.exists(path) or gate is None \
+            or gate.get("platform") != "tpu":
+        return
+    wanted = [c for c in gate.get("checks", ())
+              if c.get("check") in ("goss_parity", "shap_parity",
+                                    "shap_kernel_parity")]
+    speedup = None
+    try:
+        with open(suite_path) as f:
+            for row in json.load(f).get("suite", []):
+                if row.get("config") == "gbm_shap_rows_per_sec":
+                    speedup = row.get("kernel_speedup_vs_xla")
+    except (OSError, ValueError):
+        pass
+    bar = {"metric": "gbm_shap_rows_per_sec kernel vs xla",
+           "required_x": 2.0, "measured_x": speedup,
+           "met": bool(speedup is not None and speedup >= 2.0)}
+    with open(path, "w") as f:
+        json.dump({"captured_at": gate.get("captured_at"),
+                   "platform": "tpu", "build": gate.get("build"),
+                   "checks": wanted,
+                   "shap_kernel_speedup_bar": bar,
+                   "ok": bool(wanted
+                              and all(c.get("ok") for c in wanted)
+                              and bar["met"])},
+                  f, indent=1)
+    log(f"pinned r17 parity artifact: checks={len(wanted)} bar={bar}")
 
 
 def main() -> None:
